@@ -1,0 +1,38 @@
+"""alluxio_tpu: a TPU-native data orchestration framework.
+
+A brand-new framework with the capabilities of the reference distributed
+data-orchestration layer (Alluxio 2.5): a journaled metadata master that
+federates mounted under-storages, a fleet of tiered cache workers, a
+filesystem client, and a job service for background data movement — designed
+TPU-first:
+
+- the client page cache's top tier is **TPU HBM** (pages materialize as
+  ``jax.Array`` with refcounted pin leases integrated with JAX liveness);
+- the local data path is **short-circuit mmap over /dev/shm** handed to XLA
+  with no extra host copy, instead of a FUSE -> page-cache -> copy hop;
+- intra-slice distribution uses **ICI collectives** (``shard_map`` ring
+  all-gather of cached shards) instead of socket streams; DCN gRPC covers
+  cross-slice and the control plane.
+
+Layer map mirrors SURVEY.md section 1 (reference layers L0-L8).
+"""
+
+__version__ = "0.1.0"
+
+# Lazy convenience re-exports; submodules are imported on demand so that the
+# pure-control-plane pieces never drag in jax.
+_LAZY = {
+    "FileSystem": "alluxio_tpu.client.file_system",
+    "AlluxioURI": "alluxio_tpu.utils.uri",
+    "Configuration": "alluxio_tpu.conf.configuration",
+    "PropertyKey": "alluxio_tpu.conf.property_key",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name])
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,9 @@
+"""Typed configuration system (reference: ``core/common/.../conf``)."""
+
+from alluxio_tpu.conf.property_key import (  # noqa: F401
+    ConsistencyLevel, Keys, KeyType, PropertyKey, REGISTRY, Scope, Template,
+    Templates, parse_bytes, parse_duration_s,
+)
+from alluxio_tpu.conf.configuration import (  # noqa: F401
+    Configuration, Source, global_configuration, reset_global_configuration,
+)

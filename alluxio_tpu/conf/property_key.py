@@ -1,0 +1,522 @@
+"""Typed configuration property keys.
+
+TPU-native re-design of the reference's typed key registry
+(``core/common/src/main/java/alluxio/conf/PropertyKey.java:1`` — 6254 LoC of
+builder-generated keys with defaults, aliases, scopes and parameterized
+templates).  Here a key is a small frozen dataclass registered in a global
+catalog; parameterized families (e.g. per-tier worker settings, mirroring
+``PropertyKey.Template``, ``PropertyKey.java:5668``) are `Template` factories
+that mint concrete keys on demand.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+class Scope(enum.Flag):
+    """Which process types consume a key (reference: ``conf/Scope.java``)."""
+
+    MASTER = enum.auto()
+    WORKER = enum.auto()
+    CLIENT = enum.auto()
+    JOB_MASTER = enum.auto()
+    JOB_WORKER = enum.auto()
+    SERVER = MASTER | WORKER | JOB_MASTER | JOB_WORKER
+    ALL = SERVER | CLIENT
+    NONE = 0
+
+
+class ConsistencyLevel(enum.Enum):
+    """Cross-cluster consistency requirement for a key's value.
+
+    Mirrors the reference's config-consistency checking
+    (``meta/checkconf/ServerConfigurationChecker.java``).
+    """
+
+    IGNORE = "IGNORE"
+    WARN = "WARN"
+    ENFORCE = "ENFORCE"
+
+
+_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(ms|s|sec|m|min|h|hr|d|day)?\s*$")
+_BYTES_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(b|kb|mb|gb|tb|pb|k|m|g|t|p)?\s*$", re.I)
+
+_DURATION_UNITS = {
+    None: 0.001,  # bare numbers are milliseconds, matching the reference
+    "ms": 0.001,
+    "s": 1.0,
+    "sec": 1.0,
+    "m": 60.0,
+    "min": 60.0,
+    "h": 3600.0,
+    "hr": 3600.0,
+    "d": 86400.0,
+    "day": 86400.0,
+}
+
+_BYTE_UNITS = {
+    None: 1,
+    "b": 1,
+    "k": 1 << 10, "kb": 1 << 10,
+    "m": 1 << 20, "mb": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30,
+    "t": 1 << 40, "tb": 1 << 40,
+    "p": 1 << 50, "pb": 1 << 50,
+}
+
+
+def parse_duration_s(value: Any) -> float:
+    """Parse ``"5s"``, ``"100ms"``, ``"1h"`` (or a bare ms count) to seconds."""
+    if isinstance(value, (int, float)):
+        return float(value) / 1000.0
+    m = _DURATION_RE.match(str(value))
+    if not m:
+        raise ValueError(f"cannot parse duration: {value!r}")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+def parse_bytes(value: Any) -> int:
+    """Parse ``"64MB"``, ``"1g"`` (or a bare byte count) to bytes."""
+    if isinstance(value, int):
+        return value
+    if isinstance(value, float):
+        return int(value)
+    m = _BYTES_RE.match(str(value))
+    if not m:
+        raise ValueError(f"cannot parse byte size: {value!r}")
+    unit = m.group(2).lower() if m.group(2) else None
+    return int(float(m.group(1)) * _BYTE_UNITS[unit])
+
+
+def parse_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    s = str(value).strip().lower()
+    if s in ("true", "1", "yes", "on"):
+        return True
+    if s in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"cannot parse bool: {value!r}")
+
+
+class KeyType(enum.Enum):
+    STRING = "string"
+    INT = "int"
+    FLOAT = "float"
+    BOOL = "bool"
+    BYTES = "bytes"        # human sizes: "64MB"
+    DURATION = "duration"  # human durations: "5s" -> seconds (float)
+    LIST = "list"          # comma separated
+    ENUM = "enum"
+
+
+_PARSERS: Dict[KeyType, Callable[[Any], Any]] = {
+    KeyType.STRING: str,
+    KeyType.INT: lambda v: int(str(v), 0) if not isinstance(v, int) else v,
+    KeyType.FLOAT: float,
+    KeyType.BOOL: parse_bool,
+    KeyType.BYTES: parse_bytes,
+    KeyType.DURATION: parse_duration_s,
+    KeyType.LIST: lambda v: list(v) if isinstance(v, (list, tuple)) else [p for p in str(v).split(",") if p],
+}
+
+
+@dataclass(frozen=True)
+class PropertyKey:
+    """One typed configuration key."""
+
+    name: str
+    key_type: KeyType = KeyType.STRING
+    default: Any = None
+    description: str = ""
+    scope: Scope = Scope.ALL
+    consistency: ConsistencyLevel = ConsistencyLevel.IGNORE
+    aliases: tuple = ()
+    choices: tuple = ()  # for ENUM
+    dynamic: bool = False  # may be updated at runtime (live reconfiguration)
+
+    def parse(self, raw: Any) -> Any:
+        if raw is None:
+            return None
+        if self.key_type is KeyType.ENUM:
+            s = str(raw).upper()
+            if self.choices and s not in self.choices:
+                raise ValueError(
+                    f"{self.name}: invalid value {raw!r}; choices: {self.choices}")
+            return s
+        return _PARSERS[self.key_type](raw)
+
+    def __str__(self) -> str:
+        return self.name
+
+
+class KeyRegistry:
+    """Global catalog of defined keys, with alias resolution."""
+
+    def __init__(self) -> None:
+        self._keys: Dict[str, PropertyKey] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, key: PropertyKey) -> PropertyKey:
+        existing = self._keys.get(key.name)
+        if existing is not None:
+            return existing
+        self._keys[key.name] = key
+        for a in key.aliases:
+            self._aliases[a] = key.name
+        return key
+
+    def get(self, name: str) -> Optional[PropertyKey]:
+        if name in self._keys:
+            return self._keys[name]
+        canonical = self._aliases.get(name)
+        if canonical:
+            return self._keys[canonical]
+        return None
+
+    def is_valid(self, name: str) -> bool:
+        return self.get(name) is not None or Template.match(name) is not None
+
+    def all_keys(self) -> Dict[str, PropertyKey]:
+        return dict(self._keys)
+
+
+REGISTRY = KeyRegistry()
+
+
+def _k(name: str, key_type: KeyType = KeyType.STRING, default: Any = None,
+       description: str = "", scope: Scope = Scope.ALL,
+       consistency: ConsistencyLevel = ConsistencyLevel.IGNORE,
+       aliases: tuple = (), choices: tuple = (), dynamic: bool = False) -> PropertyKey:
+    return REGISTRY.register(PropertyKey(
+        name=name, key_type=key_type, default=default, description=description,
+        scope=scope, consistency=consistency, aliases=aliases, choices=choices,
+        dynamic=dynamic))
+
+
+@dataclass(frozen=True)
+class Template:
+    """A parameterized key family, e.g. per-tier worker storage settings.
+
+    Reference: ``conf/PropertyKey.java:5668`` (``Template`` enum with regex
+    matching).  ``WORKER_TIER_DIRS_PATH.format(0)`` mints the concrete key.
+    """
+
+    pattern: str  # str.format pattern with {} placeholders
+    regex: str
+    key_type: KeyType = KeyType.STRING
+    default_fn: Callable[..., Any] = lambda *a: None
+    scope: Scope = Scope.ALL
+
+    _ALL: "list[Template]" = field(default_factory=list, repr=False)
+
+    def format(self, *args) -> PropertyKey:
+        name = self.pattern.format(*args)
+        existing = REGISTRY.get(name)
+        if existing:
+            return existing
+        return REGISTRY.register(PropertyKey(
+            name=name, key_type=self.key_type, default=self.default_fn(*args),
+            scope=self.scope))
+
+    @classmethod
+    def match(cls, name: str) -> Optional["Template"]:
+        for t in _TEMPLATES:
+            if re.fullmatch(t.regex, name):
+                return t
+        return None
+
+
+_TEMPLATES: list = []
+
+
+def _template(pattern: str, regex: str, key_type: KeyType = KeyType.STRING,
+              default_fn: Callable[..., Any] = lambda *a: None,
+              scope: Scope = Scope.ALL) -> Template:
+    t = Template(pattern=pattern, regex=regex, key_type=key_type,
+                 default_fn=default_fn, scope=scope)
+    _TEMPLATES.append(t)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Key catalog.  Naming follows the reference's dotted style with an `atpu.`
+# prefix.  Only behavior-bearing keys are defined; the catalog grows with the
+# framework.
+# ---------------------------------------------------------------------------
+
+class Keys:
+    # --- cluster / common ---
+    CLUSTER_NAME = _k("atpu.cluster.name", default="default-cluster",
+                      consistency=ConsistencyLevel.ENFORCE)
+    HOME = _k("atpu.home", default="/tmp/alluxio_tpu")
+    LOGS_DIR = _k("atpu.logs.dir", default="/tmp/alluxio_tpu/logs")
+    WEB_THREADS = _k("atpu.web.threads", KeyType.INT, default=4)
+    NETWORK_HOST_RESOLUTION_TIMEOUT = _k(
+        "atpu.network.host.resolution.timeout", KeyType.DURATION, default="5s")
+    USER_BLOCK_SIZE_BYTES_DEFAULT = _k(
+        "atpu.user.block.size.bytes.default", KeyType.BYTES, default="64MB",
+        description="Default block size for new files "
+                    "(reference: alluxio.user.block.size.bytes.default).")
+    TIERED_IDENTITY = _k(
+        "atpu.locality.identity", KeyType.LIST, default=None,
+        description="Ordered locality tiers 'host=h,slice=s,pod=p' "
+                    "(reference: wire/TieredIdentity.java:36; TPU twist: "
+                    "host < ICI slice < pod < DCN).")
+
+    # --- security (reference: core/common/.../security) ---
+    SECURITY_AUTH_TYPE = _k("atpu.security.authentication.type", KeyType.ENUM,
+                            default="SIMPLE", choices=("NOSASL", "SIMPLE", "CUSTOM"),
+                            consistency=ConsistencyLevel.ENFORCE)
+    SECURITY_LOGIN_USERNAME = _k("atpu.security.login.username")
+    SECURITY_AUTHORIZATION_PERMISSION_ENABLED = _k(
+        "atpu.security.authorization.permission.enabled", KeyType.BOOL, default=True)
+    SECURITY_AUTHORIZATION_PERMISSION_UMASK = _k(
+        "atpu.security.authorization.permission.umask", KeyType.INT, default=0o022)
+
+    # --- master ---
+    MASTER_HOSTNAME = _k("atpu.master.hostname", default="localhost", scope=Scope.ALL)
+    MASTER_RPC_PORT = _k("atpu.master.rpc.port", KeyType.INT, default=19998)
+    MASTER_WEB_PORT = _k("atpu.master.web.port", KeyType.INT, default=19999)
+    MASTER_JOURNAL_TYPE = _k("atpu.master.journal.type", KeyType.ENUM,
+                             default="LOCAL", choices=("LOCAL", "UFS", "EMBEDDED", "NOOP"),
+                             scope=Scope.MASTER)
+    MASTER_JOURNAL_FOLDER = _k("atpu.master.journal.folder",
+                               default="/tmp/alluxio_tpu/journal", scope=Scope.MASTER)
+    MASTER_JOURNAL_FLUSH_BATCH_TIME = _k(
+        "atpu.master.journal.flush.batch.time", KeyType.DURATION, default="5ms",
+        scope=Scope.MASTER,
+        description="Batch window for group-commit journal flushes "
+                    "(reference: AsyncJournalWriter).")
+    MASTER_JOURNAL_CHECKPOINT_PERIOD_ENTRIES = _k(
+        "atpu.master.journal.checkpoint.period.entries", KeyType.INT,
+        default=2_000_000, scope=Scope.MASTER)
+    MASTER_JOURNAL_LOG_SIZE_BYTES_MAX = _k(
+        "atpu.master.journal.log.size.bytes.max", KeyType.BYTES, default="64MB",
+        scope=Scope.MASTER)
+    MASTER_METASTORE = _k("atpu.master.metastore", KeyType.ENUM, default="HEAP",
+                          choices=("HEAP", "SQLITE", "CACHING"), scope=Scope.MASTER,
+                          description="Inode/block store backend (reference: "
+                                      "HEAP/ROCKS/caching metastore).")
+    MASTER_METASTORE_DIR = _k("atpu.master.metastore.dir",
+                              default="/tmp/alluxio_tpu/metastore", scope=Scope.MASTER)
+    MASTER_METASTORE_INODE_CACHE_MAX_SIZE = _k(
+        "atpu.master.metastore.inode.cache.max.size", KeyType.INT, default=100_000,
+        scope=Scope.MASTER)
+    MASTER_HEARTBEAT_TIMEOUT = _k("atpu.master.heartbeat.timeout",
+                                  KeyType.DURATION, default="10min", scope=Scope.MASTER)
+    MASTER_WORKER_TIMEOUT = _k("atpu.master.worker.timeout", KeyType.DURATION,
+                               default="5min", scope=Scope.MASTER,
+                               description="Silent-worker expiry "
+                                           "(reference: LostWorkerDetectionHeartbeatExecutor, "
+                                           "DefaultBlockMaster.java:1087).")
+    MASTER_LOST_WORKER_DETECTION_INTERVAL = _k(
+        "atpu.master.lost.worker.detection.interval", KeyType.DURATION, default="10s",
+        scope=Scope.MASTER)
+    MASTER_TTL_CHECK_INTERVAL = _k("atpu.master.ttl.check.interval",
+                                   KeyType.DURATION, default="1h", scope=Scope.MASTER)
+    MASTER_REPLICATION_CHECK_INTERVAL = _k(
+        "atpu.master.replication.check.interval", KeyType.DURATION, default="1min",
+        scope=Scope.MASTER)
+    MASTER_PERSISTENCE_SCHEDULER_INTERVAL = _k(
+        "atpu.master.persistence.scheduler.interval", KeyType.DURATION, default="1s",
+        scope=Scope.MASTER)
+    MASTER_SAFEMODE_WAIT = _k("atpu.master.safemode.wait", KeyType.DURATION,
+                              default="5s", scope=Scope.MASTER,
+                              description="Window after primacy during which "
+                                          "client ops are rejected while workers "
+                                          "re-register (reference: DefaultSafeModeManager).")
+    MASTER_UFS_PATH_CACHE_CAPACITY = _k(
+        "atpu.master.ufs.path.cache.capacity", KeyType.INT, default=100_000,
+        scope=Scope.MASTER)
+    MASTER_BACKUP_DIR = _k("atpu.master.backup.directory",
+                           default="/tmp/alluxio_tpu/backups", scope=Scope.MASTER)
+    MASTER_DAILY_BACKUP_ENABLED = _k("atpu.master.daily.backup.enabled",
+                                     KeyType.BOOL, default=False, scope=Scope.MASTER)
+    MASTER_EMBEDDED_JOURNAL_ADDRESSES = _k(
+        "atpu.master.embedded.journal.addresses", KeyType.LIST, default=None,
+        scope=Scope.MASTER)
+    MASTER_METADATA_SYNC_EXECUTOR_POOL_SIZE = _k(
+        "atpu.master.metadata.sync.executor.pool.size", KeyType.INT, default=8,
+        scope=Scope.MASTER)
+
+    # --- worker ---
+    WORKER_HOSTNAME = _k("atpu.worker.hostname", default="localhost")
+    WORKER_RPC_PORT = _k("atpu.worker.rpc.port", KeyType.INT, default=29999)
+    WORKER_WEB_PORT = _k("atpu.worker.web.port", KeyType.INT, default=30000)
+    WORKER_DATA_FOLDER = _k("atpu.worker.data.folder", default="/tmp/alluxio_tpu/worker")
+    WORKER_RAMDISK_SIZE = _k("atpu.worker.ramdisk.size", KeyType.BYTES, default="1GB")
+    WORKER_TIERED_STORE_LEVELS = _k("atpu.worker.tieredstore.levels", KeyType.INT,
+                                    default=2, scope=Scope.WORKER)
+    WORKER_BLOCK_HEARTBEAT_INTERVAL = _k(
+        "atpu.worker.block.heartbeat.interval", KeyType.DURATION, default="1s",
+        scope=Scope.WORKER)
+    WORKER_ALLOCATOR_CLASS = _k("atpu.worker.allocator.class", KeyType.ENUM,
+                                default="MAX_FREE",
+                                choices=("MAX_FREE", "ROUND_ROBIN", "GREEDY"),
+                                scope=Scope.WORKER)
+    WORKER_ANNOTATOR_CLASS = _k("atpu.worker.block.annotator.class", KeyType.ENUM,
+                                default="LRU", choices=("LRU", "LRFU"),
+                                scope=Scope.WORKER)
+    WORKER_LRFU_STEP_FACTOR = _k("atpu.worker.block.annotator.lrfu.step.factor",
+                                 KeyType.FLOAT, default=0.25, scope=Scope.WORKER)
+    WORKER_LRFU_ATTENUATION_FACTOR = _k(
+        "atpu.worker.block.annotator.lrfu.attenuation.factor", KeyType.FLOAT,
+        default=2.0, scope=Scope.WORKER)
+    WORKER_MANAGEMENT_TIER_ALIGN_ENABLED = _k(
+        "atpu.worker.management.tier.align.enabled", KeyType.BOOL, default=True,
+        scope=Scope.WORKER)
+    WORKER_MANAGEMENT_TIER_PROMOTE_ENABLED = _k(
+        "atpu.worker.management.tier.promote.enabled", KeyType.BOOL, default=True,
+        scope=Scope.WORKER)
+    WORKER_MANAGEMENT_TASK_INTERVAL = _k(
+        "atpu.worker.management.task.interval", KeyType.DURATION, default="1s",
+        scope=Scope.WORKER)
+    WORKER_MANAGEMENT_PROMOTE_QUOTA_PERCENT = _k(
+        "atpu.worker.management.tier.promote.quota.percent", KeyType.INT, default=90,
+        scope=Scope.WORKER)
+    WORKER_REGISTER_LEASE_RETRY_MAX_DURATION = _k(
+        "atpu.worker.register.lease.retry.max.duration", KeyType.DURATION,
+        default="1min", scope=Scope.WORKER)
+    WORKER_FREE_SPACE_TIMEOUT = _k("atpu.worker.free.space.timeout",
+                                   KeyType.DURATION, default="10s", scope=Scope.WORKER)
+    WORKER_SHM_DIR = _k("atpu.worker.shm.dir", default="/dev/shm/alluxio_tpu",
+                        scope=Scope.WORKER,
+                        description="Backing dir for the MEM tier; files here are "
+                                    "mmap-able by same-host clients for the "
+                                    "short-circuit zero-copy read path.")
+
+    # --- client / user ---
+    USER_FILE_WRITE_TYPE_DEFAULT = _k(
+        "atpu.user.file.writetype.default", KeyType.ENUM, default="ASYNC_THROUGH",
+        choices=("MUST_CACHE", "CACHE_THROUGH", "THROUGH", "ASYNC_THROUGH", "NONE"),
+        scope=Scope.CLIENT)
+    USER_FILE_READ_TYPE_DEFAULT = _k(
+        "atpu.user.file.readtype.default", KeyType.ENUM, default="CACHE",
+        choices=("NO_CACHE", "CACHE", "CACHE_PROMOTE"), scope=Scope.CLIENT)
+    USER_FILE_REPLICATION_MIN = _k("atpu.user.file.replication.min", KeyType.INT,
+                                   default=0, scope=Scope.CLIENT)
+    USER_FILE_REPLICATION_MAX = _k("atpu.user.file.replication.max", KeyType.INT,
+                                   default=-1, scope=Scope.CLIENT)
+    USER_FILE_PASSIVE_CACHE_ENABLED = _k(
+        "atpu.user.file.passive.cache.enabled", KeyType.BOOL, default=True,
+        scope=Scope.CLIENT)
+    USER_BLOCK_READ_POLICY = _k(
+        "atpu.user.block.read.location.policy", KeyType.ENUM, default="LOCAL_FIRST",
+        choices=("LOCAL_FIRST", "LOCAL_FIRST_AVOID_EVICTION", "MOST_AVAILABLE",
+                 "ROUND_ROBIN", "DETERMINISTIC_HASH", "SPECIFIC_HOST"),
+        scope=Scope.CLIENT)
+    USER_BLOCK_WRITE_POLICY = _k(
+        "atpu.user.block.write.location.policy", KeyType.ENUM, default="LOCAL_FIRST",
+        choices=("LOCAL_FIRST", "LOCAL_FIRST_AVOID_EVICTION", "MOST_AVAILABLE",
+                 "ROUND_ROBIN", "DETERMINISTIC_HASH", "SPECIFIC_HOST"),
+        scope=Scope.CLIENT)
+    USER_UFS_BLOCK_READ_CONCURRENCY_MAX = _k(
+        "atpu.user.ufs.block.read.concurrency.max", KeyType.INT, default=2147483647,
+        scope=Scope.CLIENT)
+    USER_SHORT_CIRCUIT_ENABLED = _k("atpu.user.short.circuit.enabled", KeyType.BOOL,
+                                    default=True, scope=Scope.CLIENT)
+    USER_STREAMING_READER_CHUNK_SIZE = _k(
+        "atpu.user.streaming.reader.chunk.size.bytes", KeyType.BYTES, default="1MB",
+        scope=Scope.CLIENT)
+    USER_STREAMING_WRITER_CHUNK_SIZE = _k(
+        "atpu.user.streaming.writer.chunk.size.bytes", KeyType.BYTES, default="1MB",
+        scope=Scope.CLIENT)
+    USER_CLIENT_CACHE_ENABLED = _k("atpu.user.client.cache.enabled", KeyType.BOOL,
+                                   default=False, scope=Scope.CLIENT)
+    USER_CLIENT_CACHE_SIZE = _k("atpu.user.client.cache.size", KeyType.BYTES,
+                                default="512MB", scope=Scope.CLIENT)
+    USER_CLIENT_CACHE_PAGE_SIZE = _k("atpu.user.client.cache.page.size",
+                                     KeyType.BYTES, default="1MB", scope=Scope.CLIENT)
+    USER_CLIENT_CACHE_DIR = _k("atpu.user.client.cache.dir",
+                               default="/tmp/alluxio_tpu/client_cache",
+                               scope=Scope.CLIENT)
+    USER_CLIENT_CACHE_EVICTOR = _k("atpu.user.client.cache.evictor.class",
+                                   KeyType.ENUM, default="LRU",
+                                   choices=("LRU", "LFU"), scope=Scope.CLIENT)
+    USER_CLIENT_CACHE_HBM_SIZE = _k(
+        "atpu.user.client.cache.hbm.size", KeyType.BYTES, default="0",
+        scope=Scope.CLIENT,
+        description="Capacity of the HBM page-cache tier (pages as jax.Array). "
+                    "0 disables the device tier. TPU-native addition; no "
+                    "reference analogue.")
+    USER_METADATA_CACHE_MAX_SIZE = _k("atpu.user.metadata.cache.max.size",
+                                      KeyType.INT, default=0, scope=Scope.CLIENT)
+    USER_METADATA_CACHE_EXPIRATION_TIME = _k(
+        "atpu.user.metadata.cache.expiration.time", KeyType.DURATION, default="10min",
+        scope=Scope.CLIENT)
+    USER_CONF_SYNC_INTERVAL = _k("atpu.user.conf.sync.interval", KeyType.DURATION,
+                                 default="1min", scope=Scope.CLIENT)
+    USER_FILE_METADATA_SYNC_INTERVAL = _k(
+        "atpu.user.file.metadata.sync.interval", KeyType.DURATION, default="-1",
+        scope=Scope.CLIENT,
+        description="-1 = never sync on access, 0 = always, >0 = min interval "
+                    "(reference: common options sync interval, InodeSyncStream).")
+    USER_RPC_RETRY_MAX_DURATION = _k("atpu.user.rpc.retry.max.duration",
+                                     KeyType.DURATION, default="2min",
+                                     scope=Scope.CLIENT)
+    USER_RPC_RETRY_BASE_SLEEP = _k("atpu.user.rpc.retry.base.sleep", KeyType.DURATION,
+                                   default="50ms", scope=Scope.CLIENT)
+    USER_RPC_RETRY_MAX_SLEEP = _k("atpu.user.rpc.retry.max.sleep", KeyType.DURATION,
+                                  default="3s", scope=Scope.CLIENT)
+
+    # --- job service ---
+    JOB_MASTER_HOSTNAME = _k("atpu.job.master.hostname", default="localhost")
+    JOB_MASTER_RPC_PORT = _k("atpu.job.master.rpc.port", KeyType.INT, default=20001)
+    JOB_MASTER_JOB_CAPACITY = _k("atpu.job.master.job.capacity", KeyType.INT,
+                                 default=100_000, scope=Scope.JOB_MASTER)
+    JOB_MASTER_WORKER_TIMEOUT = _k("atpu.job.master.worker.timeout",
+                                   KeyType.DURATION, default="1min",
+                                   scope=Scope.JOB_MASTER)
+    JOB_WORKER_RPC_PORT = _k("atpu.job.worker.rpc.port", KeyType.INT, default=30001)
+    JOB_WORKER_THREADPOOL_SIZE = _k("atpu.job.worker.threadpool.size", KeyType.INT,
+                                    default=8, scope=Scope.JOB_WORKER)
+    JOB_WORKER_HEARTBEAT_INTERVAL = _k("atpu.job.worker.heartbeat.interval",
+                                       KeyType.DURATION, default="1s",
+                                       scope=Scope.JOB_WORKER)
+
+    # --- TPU / HBM data path (native additions) ---
+    TPU_MESH_SHAPE = _k("atpu.tpu.mesh.shape", KeyType.LIST, default=None,
+                        description="Logical mesh axes 'data=4,model=2' used by "
+                                    "the sharded prefetch path.")
+    TPU_PREFETCH_BUFFER_BATCHES = _k("atpu.tpu.prefetch.buffer.batches", KeyType.INT,
+                                     default=2,
+                                     description="Device-side double-buffering depth "
+                                                 "for the zero-copy iterator.")
+    TPU_STAGING_BUFFER_BYTES = _k("atpu.tpu.staging.buffer.bytes", KeyType.BYTES,
+                                  default="256MB",
+                                  description="Pinned host staging pool for "
+                                              "UFS->HBM decode paths.")
+
+
+# Parameterized families (reference: PropertyKey.Template, PropertyKey.java:5668)
+class Templates:
+    WORKER_TIER_ALIAS = _template(
+        "atpu.worker.tieredstore.level{}.alias",
+        r"atpu\.worker\.tieredstore\.level(\d+)\.alias",
+        KeyType.STRING, lambda lvl: {0: "MEM", 1: "SSD", 2: "HDD"}.get(int(lvl)),
+        Scope.WORKER)
+    WORKER_TIER_DIRS_PATH = _template(
+        "atpu.worker.tieredstore.level{}.dirs.path",
+        r"atpu\.worker\.tieredstore\.level(\d+)\.dirs\.path",
+        KeyType.LIST, lambda lvl: None, Scope.WORKER)
+    WORKER_TIER_DIRS_QUOTA = _template(
+        "atpu.worker.tieredstore.level{}.dirs.quota",
+        r"atpu\.worker\.tieredstore\.level(\d+)\.dirs\.quota",
+        KeyType.LIST, lambda lvl: None, Scope.WORKER)
+    WORKER_TIER_HIGH_WATERMARK = _template(
+        "atpu.worker.tieredstore.level{}.watermark.high.ratio",
+        r"atpu\.worker\.tieredstore\.level(\d+)\.watermark\.high\.ratio",
+        KeyType.FLOAT, lambda lvl: 0.95, Scope.WORKER)
+    WORKER_TIER_LOW_WATERMARK = _template(
+        "atpu.worker.tieredstore.level{}.watermark.low.ratio",
+        r"atpu\.worker\.tieredstore\.level(\d+)\.watermark\.low\.ratio",
+        KeyType.FLOAT, lambda lvl: 0.7, Scope.WORKER)
+    MASTER_MOUNT_TABLE_OPTION = _template(
+        "atpu.master.mount.table.{}.option.{}",
+        r"atpu\.master\.mount\.table\.(\w+)\.option\.(.+)",
+        KeyType.STRING, lambda *_: None, Scope.MASTER)

@@ -1,0 +1,16 @@
+"""Heartbeat framework with deterministic test control.
+
+Re-design of ``core/common/src/main/java/alluxio/heartbeat/``:
+``HeartbeatThread.java:34`` (named periodic executors),
+``SleepingTimer``/``ScheduledTimer`` and ``HeartbeatScheduler`` — the test
+hook that lets tests *manually tick* any named heartbeat instead of
+sleeping, which is what makes the reference's distributed tests
+deterministic (SURVEY.md section 4).
+
+Catalog of heartbeat names mirrors ``heartbeat/HeartbeatContext.java:32-63``.
+"""
+
+from alluxio_tpu.heartbeat.core import (  # noqa: F401
+    HeartbeatContext, HeartbeatExecutor, HeartbeatScheduler, HeartbeatThread,
+    ScheduledTimer, SleepingTimer,
+)

@@ -1,0 +1,6 @@
+"""Metrics (reference: ``core/common/.../metrics``)."""
+
+from alluxio_tpu.metrics.registry import (  # noqa: F401
+    ClusterAggregator, Counter, Meter, MetricsRegistry, Timer, metrics,
+    reset_metrics,
+)

@@ -1,0 +1,248 @@
+"""Metrics system.
+
+Re-design of the reference's Dropwizard-based ``metrics/MetricsSystem.java:63``
++ ``metrics/MetricKey.java``: a process-wide registry of counters, gauges,
+meters and timers with instance-prefixed names
+(``Master.FilesCreated``, ``Worker.BytesReadLocal``, ``Client...``), a
+Prometheus text exposition (reference: ``PrometheusMetricsServlet.java``),
+and snapshot/aggregation support so workers and clients can ship their
+metrics to the master for cluster-level aggregation
+(reference: ``master/metrics/DefaultMetricsMaster.java``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: int = 1) -> None:
+        self.inc(-n)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Meter:
+    """Rate meter: counts events, reports 1-minute-window rate."""
+
+    __slots__ = ("_count", "_window", "_lock")
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._window: deque = deque()
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._count += n
+            self._window.append((now, n))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        while self._window and now - self._window[0][0] > 60.0:
+            self._window.popleft()
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def one_minute_rate(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            total = sum(n for _, n in self._window)
+            return total / 60.0
+
+
+class Timer:
+    """Latency histogram (reservoir of recent samples) + throughput count."""
+
+    def __init__(self, reservoir: int = 1028) -> None:
+        self._samples: deque = deque(maxlen=reservoir)
+        self._count = 0
+        self._total_s = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total_s += seconds
+            self._samples.append(seconds)
+
+    class _Ctx:
+        def __init__(self, timer: "Timer") -> None:
+            self._timer = timer
+
+        def __enter__(self):
+            self._t0 = time.monotonic()
+            return self
+
+        def __exit__(self, *exc):
+            self._timer.update(time.monotonic() - self._t0)
+            return False
+
+    def time(self) -> "_Ctx":
+        return Timer._Ctx(self)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+        idx = min(len(s) - 1, int(p / 100.0 * len(s)))
+        return s[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"count": self.count, "p50": self.percentile(50),
+                "p95": self.percentile(95), "p99": self.percentile(99),
+                "mean": (self._total_s / self._count) if self._count else 0.0}
+
+
+class MetricsRegistry:
+    def __init__(self, instance: str = "Process") -> None:
+        self.instance = instance
+        self._counters: Dict[str, Counter] = {}
+        self._meters: Dict[str, Meter] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+
+    def _name(self, name: str) -> str:
+        return name if "." in name and name.split(".", 1)[0] in (
+            "Master", "Worker", "Client", "JobMaster", "JobWorker", "Cluster",
+            "Process") else f"{self.instance}.{name}"
+
+    def counter(self, name: str) -> Counter:
+        name = self._name(name)
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def meter(self, name: str) -> Meter:
+        name = self._name(name)
+        with self._lock:
+            return self._meters.setdefault(name, Meter())
+
+    def timer(self, name: str) -> Timer:
+        name = self._name(name)
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        name = self._name(name)
+        with self._lock:
+            self._gauges[name] = fn
+
+    # -- snapshots ----------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        """Flat name -> value map (counters, meter counts, gauges, timer p50s)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            counters = dict(self._counters)
+            meters = dict(self._meters)
+            timers = dict(self._timers)
+            gauges = dict(self._gauges)
+        for n, c in counters.items():
+            out[n] = c.count
+        for n, m in meters.items():
+            out[n] = m.count
+            out[n + ".rate1m"] = m.one_minute_rate
+        for n, t in timers.items():
+            for k, v in t.snapshot().items():
+                out[f"{n}.{k}"] = v
+        for n, g in gauges.items():
+            try:
+                out[n] = float(g())
+            except Exception:
+                pass
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        for name, value in sorted(self.snapshot().items()):
+            metric = name.replace(".", "_").replace("-", "_")
+            lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._meters.clear()
+            self._timers.clear()
+            self._gauges.clear()
+
+
+class ClusterAggregator:
+    """Aggregates metric snapshots reported by workers/clients into
+    ``Cluster.*`` metrics (reference: ``MetricsStore`` +
+    ``DefaultMetricsMaster``)."""
+
+    def __init__(self) -> None:
+        self._reports: Dict[str, Dict[str, float]] = {}
+        self._lock = threading.Lock()
+
+    def report(self, source_id: str, snapshot: Dict[str, float]) -> None:
+        with self._lock:
+            self._reports[source_id] = dict(snapshot)
+
+    def clear_source(self, source_id: str) -> None:
+        with self._lock:
+            self._reports.pop(source_id, None)
+
+    def cluster_snapshot(self) -> Dict[str, float]:
+        agg: Dict[str, float] = {}
+        with self._lock:
+            reports = [dict(r) for r in self._reports.values()]
+        for snap in reports:
+            for name, value in snap.items():
+                if name.endswith(".p50") or name.endswith(".p95") or \
+                        name.endswith(".p99") or name.endswith(".mean"):
+                    continue
+                key = "Cluster." + name.split(".", 1)[-1]
+                agg[key] = agg.get(key, 0.0) + value
+        return agg
+
+
+_default: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def metrics(instance: Optional[str] = None) -> MetricsRegistry:
+    """Process-default registry (set ``instance`` on first call in a process)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry(instance or "Process")
+        elif instance is not None:
+            _default.instance = instance
+        return _default
+
+
+def reset_metrics() -> None:
+    global _default
+    with _default_lock:
+        _default = None

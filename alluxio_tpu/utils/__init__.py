@@ -1,0 +1,4 @@
+"""Foundation utilities (reference: ``core/base`` + ``core/common/util``)."""
+
+from alluxio_tpu.utils.uri import AlluxioURI  # noqa: F401
+from alluxio_tpu.utils import exceptions  # noqa: F401

@@ -1,0 +1,145 @@
+"""Typed exception hierarchy.
+
+Re-design of the reference's ~40-class exception hierarchy
+(``core/base/src/main/java/alluxio/exception/``) plus its gRPC status mapping
+(``exception/status/``). Each exception carries a wire-stable ``code`` so RPC
+boundaries can round-trip typed errors.
+"""
+
+from __future__ import annotations
+
+
+class AlluxioTpuError(Exception):
+    """Base class; ``code`` is the wire-stable status name."""
+
+    code = "INTERNAL"
+
+    def to_wire(self) -> dict:
+        return {"code": self.code, "message": str(self),
+                "type": type(self).__name__}
+
+    @staticmethod
+    def from_wire(d: dict) -> "AlluxioTpuError":
+        cls = _BY_NAME.get(d.get("type"), None)
+        if cls is None:
+            cls = _BY_CODE.get(d.get("code"), AlluxioTpuError)
+        return cls(d.get("message", ""))
+
+
+class FileDoesNotExistError(AlluxioTpuError):
+    code = "NOT_FOUND"
+
+
+class BlockDoesNotExistError(AlluxioTpuError):
+    code = "NOT_FOUND"
+
+
+class FileAlreadyExistsError(AlluxioTpuError):
+    code = "ALREADY_EXISTS"
+
+
+class FileAlreadyCompletedError(AlluxioTpuError):
+    code = "FAILED_PRECONDITION"
+
+
+class FileIncompleteError(AlluxioTpuError):
+    code = "FAILED_PRECONDITION"
+
+
+class DirectoryNotEmptyError(AlluxioTpuError):
+    code = "FAILED_PRECONDITION"
+
+
+class InvalidPathError(AlluxioTpuError):
+    code = "INVALID_ARGUMENT"
+
+
+class InvalidArgumentError(AlluxioTpuError):
+    code = "INVALID_ARGUMENT"
+
+
+class PermissionDeniedError(AlluxioTpuError):
+    code = "PERMISSION_DENIED"
+
+
+class UnauthenticatedError(AlluxioTpuError):
+    code = "UNAUTHENTICATED"
+
+
+class NotFoundError(AlluxioTpuError):
+    code = "NOT_FOUND"
+
+
+class AlreadyExistsError(AlluxioTpuError):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(AlluxioTpuError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class WorkerOutOfSpaceError(ResourceExhaustedError):
+    pass
+
+
+class FailedPreconditionError(AlluxioTpuError):
+    code = "FAILED_PRECONDITION"
+
+
+class UnavailableError(AlluxioTpuError):
+    """Transient; retryable (master in safe mode, worker not registered...)."""
+
+    code = "UNAVAILABLE"
+
+
+class SafeModeError(UnavailableError):
+    pass
+
+
+class DeadlineExceededError(AlluxioTpuError):
+    code = "DEADLINE_EXCEEDED"
+
+
+class CancelledError(AlluxioTpuError):
+    code = "CANCELLED"
+
+
+class AbortedError(AlluxioTpuError):
+    code = "ABORTED"
+
+
+class NotSupportedError(AlluxioTpuError):
+    code = "UNIMPLEMENTED"
+
+
+class UfsError(AlluxioTpuError):
+    code = "INTERNAL"
+
+
+class JournalClosedError(UnavailableError):
+    pass
+
+
+class BackupError(AlluxioTpuError):
+    code = "INTERNAL"
+
+
+class JobDoesNotExistError(NotFoundError):
+    pass
+
+
+class ConnectionFailedError(UnavailableError):
+    pass
+
+
+class RegisterLeaseNotFoundError(UnavailableError):
+    pass
+
+
+_ALL = [v for v in list(globals().values())
+        if isinstance(v, type) and issubclass(v, AlluxioTpuError)]
+_BY_NAME = {c.__name__: c for c in _ALL}
+_BY_CODE = {c.code: c for c in reversed(_ALL)}
+
+#: Status codes that a retry policy should treat as transient.
+RETRYABLE_CODES = frozenset({"UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED"})

@@ -1,0 +1,175 @@
+"""Retry policies.
+
+Re-design of ``core/common/src/main/java/alluxio/retry/``
+(``RetryPolicy.java``, ``ExponentialBackoffRetry.java``,
+``ExponentialTimeBoundedRetry.java``, ``RetryUtils.java``): iterator-style
+policies (`attempt()` returns False when exhausted) plus a functional
+``retry()`` helper that understands the typed exception codes.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+from alluxio_tpu.utils.exceptions import AlluxioTpuError, RETRYABLE_CODES
+
+T = TypeVar("T")
+
+
+class RetryPolicy:
+    """Iterator-style policy: call ``attempt()`` before each try."""
+
+    def attempt(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def attempt_count(self) -> int:
+        raise NotImplementedError
+
+
+class NoRetryPolicy(RetryPolicy):
+    def __init__(self) -> None:
+        self._count = 0
+
+    def attempt(self) -> bool:
+        self._count += 1
+        return self._count <= 1
+
+    @property
+    def attempt_count(self) -> int:
+        return self._count
+
+
+class CountingRetry(RetryPolicy):
+    """N retries with no sleeping."""
+
+    def __init__(self, max_retries: int) -> None:
+        self._max = max_retries
+        self._count = 0
+
+    def attempt(self) -> bool:
+        if self._count > self._max:
+            return False
+        self._count += 1
+        return self._count <= self._max + 1
+
+    @property
+    def attempt_count(self) -> int:
+        return self._count
+
+
+class SleepingRetry(RetryPolicy):
+    def __init__(self, max_retries: int, sleep_s: float,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        self._max = max_retries
+        self._sleep_s = sleep_s
+        self._sleep_fn = sleep_fn
+        self._count = 0
+
+    def attempt(self) -> bool:
+        if self._count == 0:
+            self._count = 1
+            return True
+        if self._count > self._max:
+            return False
+        self._sleep_fn(self._sleep_s)
+        self._count += 1
+        return True
+
+    @property
+    def attempt_count(self) -> int:
+        return self._count
+
+
+class ExponentialBackoffRetry(RetryPolicy):
+    """Exponential backoff with jitter, bounded by retry count."""
+
+    def __init__(self, base_sleep_s: float, max_sleep_s: float, max_retries: int,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None) -> None:
+        self._base = base_sleep_s
+        self._max_sleep = max_sleep_s
+        self._max_retries = max_retries
+        self._sleep_fn = sleep_fn
+        self._rng = rng or random.Random()
+        self._count = 0
+
+    def _next_sleep(self) -> float:
+        backoff = min(self._max_sleep, self._base * (2 ** (self._count - 1)))
+        return backoff * (0.5 + 0.5 * self._rng.random())
+
+    def attempt(self) -> bool:
+        if self._count == 0:
+            self._count = 1
+            return True
+        if self._count > self._max_retries:
+            return False
+        self._sleep_fn(self._next_sleep())
+        self._count += 1
+        return True
+
+    @property
+    def attempt_count(self) -> int:
+        return self._count
+
+
+class ExponentialTimeBoundedRetry(RetryPolicy):
+    """Exponential backoff bounded by wall-clock duration
+    (reference: ``ExponentialTimeBoundedRetry.java``)."""
+
+    def __init__(self, max_duration_s: float, base_sleep_s: float,
+                 max_sleep_s: float,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None) -> None:
+        self._deadline = time_fn() + max_duration_s
+        self._base = base_sleep_s
+        self._max_sleep = max_sleep_s
+        self._time_fn = time_fn
+        self._sleep_fn = sleep_fn
+        self._rng = rng or random.Random()
+        self._count = 0
+
+    def attempt(self) -> bool:
+        now = self._time_fn()
+        if self._count == 0:
+            self._count = 1
+            return True
+        if now >= self._deadline:
+            return False
+        backoff = min(self._max_sleep, self._base * (2 ** (self._count - 1)))
+        sleep = min(backoff * (0.5 + 0.5 * self._rng.random()),
+                    max(0.0, self._deadline - now))
+        self._sleep_fn(sleep)
+        self._count += 1
+        return True
+
+    @property
+    def attempt_count(self) -> int:
+        return self._count
+
+
+def is_retryable(exc: BaseException) -> bool:
+    if isinstance(exc, AlluxioTpuError):
+        return exc.code in RETRYABLE_CODES
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+
+def retry(fn: Callable[[], T], policy: RetryPolicy,
+          retry_on: Callable[[BaseException], bool] = is_retryable) -> T:
+    """Run ``fn`` under ``policy``; re-raise the last error when exhausted.
+
+    Reference: ``retry/RetryUtils.java``.
+    """
+    last: Optional[BaseException] = None
+    while policy.attempt():
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 - filtered by retry_on
+            if not retry_on(e):
+                raise
+            last = e
+    assert last is not None
+    raise last

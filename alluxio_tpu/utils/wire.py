@@ -1,0 +1,262 @@
+"""Wire types crossing RPC boundaries.
+
+Re-designs of ``core/common/src/main/java/alluxio/wire/`` (``FileInfo``,
+``BlockInfo``, ``BlockLocation``, ``WorkerInfo``, ``WorkerNetAddress``,
+``MountPointInfo``) and the locality model ``wire/TieredIdentity.java:36,69``
+— re-thought for TPU topology: locality tiers are ``host`` (same TPU VM,
+short-circuit shm), ``slice`` (same ICI domain, collective transfers), ``pod``
+(same pod, ICI across slices on v4+/DCN otherwise), then DCN.
+
+All types serialize to/from plain dicts (msgpack-friendly) via
+``to_wire``/``from_wire``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+def _wire_dataclass(cls):
+    """Attach dict (de)serialization to a dataclass, recursing into fields."""
+
+    def to_wire(self) -> Dict[str, Any]:
+        out = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if hasattr(v, "to_wire"):
+                v = v.to_wire()
+            elif isinstance(v, list):
+                v = [x.to_wire() if hasattr(x, "to_wire") else x for x in v]
+            elif isinstance(v, dict):
+                v = {k: (x.to_wire() if hasattr(x, "to_wire") else x)
+                     for k, x in v.items()}
+            out[f.name] = v
+        return out
+
+    @classmethod
+    def from_wire(klass, d: Dict[str, Any]):
+        kwargs = {}
+        hints = {f.name: f for f in dataclasses.fields(klass)}
+        for name, f in hints.items():
+            if name not in d:
+                continue
+            v = d[name]
+            sub = _NESTED.get((klass.__name__, name))
+            if sub is not None and v is not None:
+                if isinstance(v, list):
+                    v = [sub.from_wire(x) if isinstance(x, dict) else x for x in v]
+                elif isinstance(v, dict) and not _is_plain_dict_field(f):
+                    v = sub.from_wire(v)
+            kwargs[name] = v
+        return klass(**kwargs)
+
+    cls.to_wire = to_wire
+    cls.from_wire = from_wire
+    return cls
+
+
+def _is_plain_dict_field(f) -> bool:
+    return "Dict" in str(f.type) or "dict" in str(f.type)
+
+
+_NESTED: Dict[tuple, type] = {}
+
+
+@_wire_dataclass
+@dataclass
+class LocalityTier:
+    """One (tier-name, value) locality pair, e.g. ("slice", "slice-0")."""
+
+    tier: str = ""
+    value: str = ""
+
+
+#: Ordered tier names, closest first. TPU-native ordering (SURVEY.md 2.11).
+LOCALITY_ORDER = ("host", "slice", "pod", "region")
+
+
+@_wire_dataclass
+@dataclass
+class TieredIdentity:
+    """Ordered locality identity (reference: ``wire/TieredIdentity.java:36``).
+
+    ``closeness`` replaces the reference's nearest-match resolution
+    (``TieredIdentity.java:69``): lower is closer; tie broken by tier order.
+    """
+
+    tiers: List[LocalityTier] = field(default_factory=list)
+
+    def value(self, tier: str) -> Optional[str]:
+        for t in self.tiers:
+            if t.tier == tier:
+                return t.value
+        return None
+
+    def closeness(self, other: "TieredIdentity") -> int:
+        """0 = same host; k = first k locality tiers differ; large = remote."""
+        for i, name in enumerate(LOCALITY_ORDER):
+            mine, theirs = self.value(name), other.value(name)
+            if mine is not None and mine == theirs:
+                return i
+        return len(LOCALITY_ORDER)
+
+    def nearest(self, candidates: List["TieredIdentity"]) -> Optional[int]:
+        """Index of the closest candidate, or None if empty."""
+        if not candidates:
+            return None
+        scored = [(self.closeness(c), i) for i, c in enumerate(candidates)]
+        return min(scored)[1]
+
+    @staticmethod
+    def from_spec(spec: "List[str] | str | None", hostname: str = "") -> "TieredIdentity":
+        """Parse ``["host=h","slice=s"]`` / ``"host=h,slice=s"`` specs."""
+        tiers: List[LocalityTier] = []
+        if spec:
+            parts = spec.split(",") if isinstance(spec, str) else spec
+            for p in parts:
+                if "=" in p:
+                    k, _, v = p.partition("=")
+                    tiers.append(LocalityTier(k.strip(), v.strip()))
+        if hostname and not any(t.tier == "host" for t in tiers):
+            tiers.insert(0, LocalityTier("host", hostname))
+        return TieredIdentity(tiers)
+
+
+_NESTED[("TieredIdentity", "tiers")] = LocalityTier
+
+
+@_wire_dataclass
+@dataclass
+class WorkerNetAddress:
+    host: str = ""
+    rpc_port: int = 0
+    data_port: int = 0
+    web_port: int = 0
+    domain_socket_path: str = ""
+    #: Same-host shm dir for short-circuit mmap reads (TPU-native analogue of
+    #: the reference's short-circuit block paths).
+    shm_dir: str = ""
+    tiered_identity: TieredIdentity = field(default_factory=TieredIdentity)
+
+    def key(self) -> str:
+        return f"{self.host}:{self.rpc_port}"
+
+
+_NESTED[("WorkerNetAddress", "tiered_identity")] = TieredIdentity
+
+
+@_wire_dataclass
+@dataclass
+class BlockLocation:
+    worker_id: int = 0
+    address: WorkerNetAddress = field(default_factory=WorkerNetAddress)
+    tier_alias: str = "MEM"
+    medium_type: str = ""
+
+
+_NESTED[("BlockLocation", "address")] = WorkerNetAddress
+
+
+@_wire_dataclass
+@dataclass
+class BlockInfo:
+    block_id: int = 0
+    length: int = 0
+    locations: List[BlockLocation] = field(default_factory=list)
+
+
+_NESTED[("BlockInfo", "locations")] = BlockLocation
+
+
+@_wire_dataclass
+@dataclass
+class FileBlockInfo:
+    block_info: BlockInfo = field(default_factory=BlockInfo)
+    offset: int = 0
+    ufs_locations: List[str] = field(default_factory=list)
+
+
+_NESTED[("FileBlockInfo", "block_info")] = BlockInfo
+
+
+@_wire_dataclass
+@dataclass
+class FileInfo:
+    file_id: int = 0
+    name: str = ""
+    path: str = ""
+    ufs_path: str = ""
+    length: int = 0
+    block_size_bytes: int = 0
+    creation_time_ms: int = 0
+    last_modification_time_ms: int = 0
+    last_access_time_ms: int = 0
+    completed: bool = False
+    folder: bool = False
+    pinned: bool = False
+    pinned_media: List[str] = field(default_factory=list)
+    cacheable: bool = True
+    persisted: bool = False
+    persistence_state: str = "NOT_PERSISTED"
+    block_ids: List[int] = field(default_factory=list)
+    in_memory_percentage: int = 0
+    ttl: int = -1
+    ttl_action: str = "DELETE"
+    owner: str = ""
+    group: str = ""
+    mode: int = 0o644
+    mount_point: bool = False
+    mount_id: int = 0
+    replication_min: int = 0
+    replication_max: int = -1
+    file_block_infos: List[FileBlockInfo] = field(default_factory=list)
+    xattr: Dict[str, str] = field(default_factory=dict)
+
+
+_NESTED[("FileInfo", "file_block_infos")] = FileBlockInfo
+
+
+@_wire_dataclass
+@dataclass
+class WorkerInfo:
+    id: int = 0
+    address: WorkerNetAddress = field(default_factory=WorkerNetAddress)
+    state: str = "LIVE"
+    capacity_bytes: int = 0
+    used_bytes: int = 0
+    start_time_ms: int = 0
+    last_contact_ms: int = 0
+    capacity_bytes_on_tiers: Dict[str, int] = field(default_factory=dict)
+    used_bytes_on_tiers: Dict[str, int] = field(default_factory=dict)
+    block_count: int = 0
+
+
+_NESTED[("WorkerInfo", "address")] = WorkerNetAddress
+
+
+@_wire_dataclass
+@dataclass
+class MountPointInfo:
+    ufs_uri: str = ""
+    ufs_type: str = ""
+    ufs_capacity_bytes: int = -1
+    ufs_used_bytes: int = -1
+    read_only: bool = False
+    shared: bool = False
+    mount_id: int = 0
+    properties: Dict[str, str] = field(default_factory=dict)
+
+
+@_wire_dataclass
+@dataclass
+class MasterInfo:
+    leader_master_address: str = ""
+    master_addresses: List[str] = field(default_factory=list)
+    rpc_port: int = 0
+    safe_mode: bool = False
+    start_time_ms: int = 0
+    up_time_ms: int = 0
+    version: str = ""
+    cluster_id: str = ""

@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding tests run
+without TPU hardware (the driver separately dry-run-compiles the multi-chip
+path via ``__graft_entry__.dryrun_multichip``).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def conf(tmp_path):
+    """A fresh Configuration rooted in a temp dir."""
+    from alluxio_tpu.conf import Configuration, Keys
+
+    c = Configuration(load_env=False)
+    c.set(Keys.HOME, str(tmp_path))
+    c.set(Keys.MASTER_JOURNAL_FOLDER, str(tmp_path / "journal"))
+    c.set(Keys.MASTER_METASTORE_DIR, str(tmp_path / "metastore"))
+    c.set(Keys.WORKER_DATA_FOLDER, str(tmp_path / "worker"))
+    c.set(Keys.WORKER_SHM_DIR, str(tmp_path / "shm"))
+    c.set(Keys.USER_CLIENT_CACHE_DIR, str(tmp_path / "client_cache"))
+    c.set(Keys.MASTER_BACKUP_DIR, str(tmp_path / "backups"))
+    return c
+
+
+@pytest.fixture(autouse=True)
+def _reset_heartbeats():
+    from alluxio_tpu.heartbeat import HeartbeatScheduler, HeartbeatThread
+
+    yield
+    HeartbeatThread.reset_timer_policy()
+    HeartbeatScheduler.clear()

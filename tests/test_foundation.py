@@ -1,0 +1,291 @@
+"""L0 foundation tests: conf, uri, retry, ids, collections, wire, metrics,
+heartbeat. Mirrors the reference's unit coverage for ``core/common``
+(e.g. ``core/common/src/test/java/alluxio/conf/InstancedConfigurationTest``,
+``AlluxioURITest``, ``heartbeat/HeartbeatThreadTest``)."""
+
+import threading
+
+import pytest
+
+from alluxio_tpu.conf import (
+    Configuration, Keys, Source, Templates, parse_bytes, parse_duration_s,
+)
+from alluxio_tpu.heartbeat import (
+    HeartbeatExecutor, HeartbeatScheduler, HeartbeatThread,
+)
+from alluxio_tpu.metrics import MetricsRegistry
+from alluxio_tpu.utils import ids
+from alluxio_tpu.utils.collections import (
+    DirectedAcyclicGraph, FieldIndex, IndexedSet, PrefixList,
+)
+from alluxio_tpu.utils.exceptions import (
+    AlluxioTpuError, FileDoesNotExistError, UnavailableError,
+)
+from alluxio_tpu.utils.retry import (
+    CountingRetry, ExponentialBackoffRetry, retry,
+)
+from alluxio_tpu.utils.uri import AlluxioURI
+from alluxio_tpu.utils.wire import (
+    BlockInfo, FileInfo, TieredIdentity, WorkerNetAddress,
+)
+
+
+class TestConfiguration:
+    def test_defaults_and_types(self):
+        c = Configuration(load_env=False)
+        assert c.get(Keys.MASTER_RPC_PORT) == 19998
+        assert c.get_bytes(Keys.USER_BLOCK_SIZE_BYTES_DEFAULT) == 64 << 20
+        assert c.get_duration_s(Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL) == 1.0
+
+    def test_source_priority(self):
+        c = Configuration(load_env=False)
+        c.set(Keys.MASTER_RPC_PORT, 1000, Source.CLUSTER_DEFAULT)
+        c.set(Keys.MASTER_RPC_PORT, 2000, Source.RUNTIME)
+        c.set(Keys.MASTER_RPC_PORT, 1500, Source.SITE_PROPERTY)  # lower, ignored
+        assert c.get(Keys.MASTER_RPC_PORT) == 2000
+        assert c.source(Keys.MASTER_RPC_PORT) == Source.RUNTIME
+
+    def test_human_units(self):
+        assert parse_bytes("64MB") == 64 << 20
+        assert parse_bytes("1g") == 1 << 30
+        assert parse_duration_s("5s") == 5.0
+        assert parse_duration_s("100ms") == 0.1
+        assert parse_duration_s(250) == 0.25
+
+    def test_unknown_key_rejected(self):
+        c = Configuration(load_env=False)
+        with pytest.raises(KeyError):
+            c.set("atpu.not.a.key", 1)
+
+    def test_template_keys(self):
+        c = Configuration(load_env=False)
+        key = Templates.WORKER_TIER_ALIAS.format(0)
+        assert c.get(key) == "MEM"
+        c.set("atpu.worker.tieredstore.level1.alias", "SSD")
+        assert c.get("atpu.worker.tieredstore.level1.alias") == "SSD"
+
+    def test_hash_changes_on_set(self):
+        c = Configuration(load_env=False)
+        h0 = c.hash()
+        c.set(Keys.MASTER_RPC_PORT, 5)
+        assert c.hash() != h0
+
+    def test_site_properties(self, tmp_path):
+        f = tmp_path / "site.properties"
+        f.write_text("# comment\natpu.master.rpc.port = 7777\nbad.key=1\n")
+        c = Configuration(load_env=False)
+        c.load_site_properties(str(f))
+        assert c.get(Keys.MASTER_RPC_PORT) == 7777
+
+
+class TestUri:
+    def test_parse_plain(self):
+        u = AlluxioURI("/a/b/c")
+        assert u.path == "/a/b/c"
+        assert u.name == "c"
+        assert u.depth() == 3
+        assert not u.has_scheme()
+
+    def test_parse_scheme(self):
+        u = AlluxioURI("atpu://host:19998/a/b")
+        assert u.scheme == "atpu"
+        assert u.authority == "host:19998"
+        assert u.path == "/a/b"
+        assert str(u) == "atpu://host:19998/a/b"
+
+    def test_normalization(self):
+        assert AlluxioURI("/a//b/../c/").path == "/a/c"
+        assert AlluxioURI("").path == "/"
+        assert AlluxioURI("/").is_root()
+
+    def test_algebra(self):
+        u = AlluxioURI("/a/b")
+        assert u.parent() == AlluxioURI("/a")
+        assert AlluxioURI("/").parent() is None
+        assert u.join("c/d") == AlluxioURI("/a/b/c/d")
+        assert AlluxioURI("/a").is_ancestor_of(u)
+        assert not u.is_ancestor_of(AlluxioURI("/a"))
+        assert u.path_components() == ("a", "b")
+
+    def test_s3_style(self):
+        u = AlluxioURI("s3://bucket/key/part")
+        assert u.scheme == "s3"
+        assert u.authority == "bucket"
+        assert u.path == "/key/part"
+
+
+class TestRetry:
+    def test_counting(self):
+        p = CountingRetry(3)
+        n = sum(1 for _ in iter(p.attempt, False))
+        assert n == 4  # initial + 3 retries
+
+    def test_retry_helper_recovers(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise UnavailableError("not yet")
+            return "ok"
+
+        assert retry(flaky, ExponentialBackoffRetry(0.001, 0.002, 5,
+                                                    sleep_fn=lambda s: None)) == "ok"
+        assert len(calls) == 3
+
+    def test_retry_helper_gives_up(self):
+        def always():
+            raise UnavailableError("nope")
+
+        with pytest.raises(UnavailableError):
+            retry(always, CountingRetry(2))
+
+    def test_non_retryable_raises_immediately(self):
+        calls = []
+
+        def fatal():
+            calls.append(1)
+            raise FileDoesNotExistError("gone")
+
+        with pytest.raises(FileDoesNotExistError):
+            retry(fatal, CountingRetry(5))
+        assert len(calls) == 1
+
+
+class TestExceptionWire:
+    def test_round_trip(self):
+        e = FileDoesNotExistError("/a/b not found")
+        d = e.to_wire()
+        e2 = AlluxioTpuError.from_wire(d)
+        assert isinstance(e2, FileDoesNotExistError)
+        assert "not found" in str(e2)
+
+
+class TestIds:
+    def test_block_file_math(self):
+        cid = 42
+        b0 = ids.block_id(cid, 0)
+        b1 = ids.block_id(cid, 1)
+        fid = ids.file_id_from_container(cid)
+        assert ids.container_id(b0) == cid
+        assert ids.sequence_number(b1) == 1
+        assert ids.file_id_for_block(b0) == fid
+        assert ids.is_file_id(fid) and not ids.is_file_id(b0)
+
+    def test_generator_restore(self):
+        g = ids.ContainerIdGenerator()
+        a = g.next_container_id()
+        g.restore(100)
+        assert g.next_container_id() == 100
+        assert a == 1
+
+
+class TestCollections:
+    def test_indexed_set(self):
+        class W:
+            def __init__(self, wid, host):
+                self.wid, self.host = wid, host
+
+        s = IndexedSet(FieldIndex("id", lambda w: w.wid, unique=True),
+                       FieldIndex("host", lambda w: w.host))
+        w1, w2 = W(1, "h1"), W(2, "h1")
+        s.add(w1)
+        s.add(w2)
+        assert s.get_first_by("id", 1) is w1
+        assert s.get_by("host", "h1") == {w1, w2}
+        assert len(s) == 2
+        s.remove_by("host", "h1")
+        assert len(s) == 0
+
+    def test_dag(self):
+        d = DirectedAcyclicGraph()
+        d.add("a")
+        d.add("b", ["a"])
+        d.add("c", ["a", "b"])
+        assert d.roots() == ["a"]
+        order = d.topological_order()
+        assert order.index("a") < order.index("b") < order.index("c")
+        with pytest.raises(ValueError):
+            d.add("a")  # duplicate
+
+    def test_prefix_list(self):
+        p = PrefixList(["/tmp/", "/data/raw"])
+        assert p.in_list("/tmp/x")
+        assert p.in_list("/data/raw/y")
+        assert p.out_list("/data/other")
+
+
+class TestWire:
+    def test_round_trips(self):
+        fi = FileInfo(file_id=7, path="/x", length=10, block_ids=[1, 2])
+        assert FileInfo.from_wire(fi.to_wire()) == fi
+        bi = BlockInfo(block_id=9, length=5)
+        assert BlockInfo.from_wire(bi.to_wire()) == bi
+
+    def test_tiered_identity_closeness(self):
+        me = TieredIdentity.from_spec("host=h1,slice=s1,pod=p1")
+        same_host = TieredIdentity.from_spec("host=h1,slice=s1,pod=p1")
+        same_slice = TieredIdentity.from_spec("host=h2,slice=s1,pod=p1")
+        same_pod = TieredIdentity.from_spec("host=h3,slice=s2,pod=p1")
+        remote = TieredIdentity.from_spec("host=h4,slice=s9,pod=p9")
+        assert me.closeness(same_host) == 0
+        assert me.closeness(same_slice) == 1
+        assert me.closeness(same_pod) == 2
+        assert me.closeness(remote) > 2
+        cands = [remote, same_pod, same_slice]
+        assert me.nearest(cands) == 2
+
+    def test_worker_net_address_wire(self):
+        a = WorkerNetAddress(host="h", rpc_port=1,
+                             tiered_identity=TieredIdentity.from_spec("host=h"))
+        b = WorkerNetAddress.from_wire(a.to_wire())
+        assert b.host == "h"
+        assert b.tiered_identity.value("host") == "h"
+
+
+class TestMetrics:
+    def test_counter_meter_timer(self):
+        r = MetricsRegistry("Worker")
+        r.counter("BytesReadLocal").inc(100)
+        r.meter("ops").mark(3)
+        with r.timer("readLatency").time():
+            pass
+        snap = r.snapshot()
+        assert snap["Worker.BytesReadLocal"] == 100
+        assert snap["Worker.ops"] == 3
+        assert "Worker.readLatency.p50" in snap
+
+    def test_prometheus_format(self):
+        r = MetricsRegistry("Master")
+        r.counter("FilesCreated").inc()
+        text = r.to_prometheus()
+        assert "Master_FilesCreated 1" in text
+
+
+class TestHeartbeat:
+    def test_sleeping_timer_runs(self):
+        done = threading.Event()
+
+        class Exec(HeartbeatExecutor):
+            def heartbeat(self):
+                done.set()
+
+        t = HeartbeatThread("test.hb", Exec(), 0.01)
+        t.start()
+        assert done.wait(2.0)
+        t.stop()
+
+    def test_scheduled_timer_deterministic(self):
+        HeartbeatThread.use_scheduled_timers("det.hb")
+        counter = []
+
+        class Exec(HeartbeatExecutor):
+            def heartbeat(self):
+                counter.append(1)
+
+        t = HeartbeatThread("det.hb", Exec(), 100.0)
+        t.start()
+        HeartbeatScheduler.execute("det.hb")
+        HeartbeatScheduler.execute("det.hb")
+        assert len(counter) == 2
+        t.stop()

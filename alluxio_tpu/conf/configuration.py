@@ -68,13 +68,11 @@ class Configuration:
 
     def set(self, key: "PropertyKey | str", value: Any,
             source: Source = Source.RUNTIME) -> None:
-        name = key.name if isinstance(key, PropertyKey) else str(key)
-        if not REGISTRY.is_valid(name):
-            raise KeyError(f"unknown property key: {name}")
-        self._put(name, value, source)
+        # canonicalize aliases so set()/get() agree on the storage name
+        self._put(self._resolve_key(key).name, value, source)
 
     def unset(self, key: "PropertyKey | str") -> None:
-        name = key.name if isinstance(key, PropertyKey) else str(key)
+        name = self._resolve_key(key).name
         with self._lock:
             self._values.pop(name, None)
 

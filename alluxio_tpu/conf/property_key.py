@@ -42,7 +42,7 @@ class ConsistencyLevel(enum.Enum):
     ENFORCE = "ENFORCE"
 
 
-_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(ms|s|sec|m|min|h|hr|d|day)?\s*$")
+_DURATION_RE = re.compile(r"^\s*(-?\d+(?:\.\d+)?)\s*(ms|s|sec|m|min|h|hr|d|day)?\s*$")
 _BYTES_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(b|kb|mb|gb|tb|pb|k|m|g|t|p)?\s*$", re.I)
 
 _DURATION_UNITS = {
@@ -452,7 +452,7 @@ class Keys:
     USER_CONF_SYNC_INTERVAL = _k("atpu.user.conf.sync.interval", KeyType.DURATION,
                                  default="1min", scope=Scope.CLIENT)
     USER_FILE_METADATA_SYNC_INTERVAL = _k(
-        "atpu.user.file.metadata.sync.interval", KeyType.DURATION, default="-1",
+        "atpu.user.file.metadata.sync.interval", KeyType.DURATION, default="-1s",
         scope=Scope.CLIENT,
         description="-1 = never sync on access, 0 = always, >0 = min interval "
                     "(reference: common options sync interval, InodeSyncStream).")

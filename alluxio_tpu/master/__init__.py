@@ -1,0 +1,7 @@
+"""Master control plane (reference: ``core/server/master``)."""
+
+from alluxio_tpu.master.block_master import BlockMaster, WorkerCommand  # noqa: F401
+from alluxio_tpu.master.file_master import FileSystemMaster  # noqa: F401
+from alluxio_tpu.master.inode import Inode, PersistenceState, TtlAction  # noqa: F401
+from alluxio_tpu.master.inode_tree import InodeTree  # noqa: F401
+from alluxio_tpu.master.mount_table import MountInfo, MountTable  # noqa: F401

@@ -1,0 +1,314 @@
+"""The namespace inode tree.
+
+Re-design of ``core/server/master/.../file/meta/InodeTree.java:84`` +
+``InodeTreePersistentState.java:71``.
+
+**Locking rationale.** The reference implements fine-grained per-inode
+read/write locks with lock lists (``InodeLockManager.java:47``,
+``SimpleInodeLockList``) — ~8k LoC of subtle ordering. Here the tree is a
+**single-writer state machine behind one tree-level RW lock**: queries take
+the read lock; every mutation is serialized through the journal and applied
+under the write lock. On a Python control plane (GIL; 1 socket per master
+host) the fine-grained scheme buys nothing, and single-writer application is
+what makes journal replay trivially deterministic — the design SURVEY.md
+section 7 ("hard parts") recommends.
+
+All mutations arrive as journal entries via ``process_entry`` — the tree is
+a ``Journaled`` component; the FileSystemMaster validates + emits entries,
+it never pokes tree state directly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set
+
+from alluxio_tpu.journal.format import EntryType, JournalEntry, Journaled
+from alluxio_tpu.master.inode import Inode, PersistenceState
+from alluxio_tpu.master.metastore import HeapInodeStore, InodeStore
+from alluxio_tpu.master.ttl import TtlBucketList
+from alluxio_tpu.utils.exceptions import (
+    FileDoesNotExistError, InvalidPathError,
+)
+from alluxio_tpu.utils.locks import RWLock
+from alluxio_tpu.utils.uri import AlluxioURI
+
+ROOT_ID_PARENT = -1
+
+
+@dataclass
+class PathLookup:
+    """Resolution of a path: the inodes that exist along it
+    (reference: ``LockedInodePath``)."""
+
+    uri: AlluxioURI
+    inodes: List[Inode] = field(default_factory=list)  # root..deepest existing
+
+    @property
+    def exists(self) -> bool:
+        return len(self.inodes) == self.uri.depth() + 1
+
+    @property
+    def inode(self) -> Inode:
+        if not self.exists:
+            raise FileDoesNotExistError(f"path {self.uri} does not exist")
+        return self.inodes[-1]
+
+    @property
+    def deepest(self) -> Inode:
+        return self.inodes[-1]
+
+    @property
+    def missing_components(self) -> List[str]:
+        comps = self.uri.path_components()
+        return list(comps[len(self.inodes) - 1:])
+
+
+class InodeTree(Journaled):
+    journal_name = "InodeTree"
+
+    def __init__(self, store: Optional[InodeStore] = None) -> None:
+        self._store = store if store is not None else HeapInodeStore()
+        self.lock = RWLock()
+        self._root_id: Optional[int] = None
+        self.ttl_buckets = TtlBucketList()
+        self.pinned_ids: Set[int] = set()
+        self.to_be_persisted_ids: Set[int] = set()
+        self._inode_count = 0
+
+    # ------------------------------------------------------------------ read
+    @property
+    def root(self) -> Optional[Inode]:
+        return self._store.get(self._root_id) if self._root_id is not None else None
+
+    @property
+    def inode_count(self) -> int:
+        return self._inode_count
+
+    def get_inode(self, inode_id: int) -> Optional[Inode]:
+        return self._store.get(inode_id)
+
+    def lookup(self, uri: AlluxioURI) -> PathLookup:
+        """Walk the path from root; returns all inodes that exist."""
+        result = PathLookup(uri=uri)
+        root = self.root
+        if root is None:
+            raise InvalidPathError("inode tree not initialized")
+        result.inodes.append(root)
+        cur = root
+        for name in uri.path_components():
+            child_id = self._store.get_child_id(cur.id, name)
+            if child_id is None:
+                break
+            child = self._store.get(child_id)
+            if child is None:
+                break
+            result.inodes.append(child)
+            cur = child
+        return result
+
+    def get_path(self, inode: Inode) -> AlluxioURI:
+        """Reconstruct the full path of an inode by walking parents."""
+        parts: List[str] = []
+        cur: Optional[Inode] = inode
+        while cur is not None and cur.parent_id != ROOT_ID_PARENT:
+            parts.append(cur.name)
+            cur = self._store.get(cur.parent_id)
+        return AlluxioURI("/" + "/".join(reversed(parts)))
+
+    def child_names(self, inode: Inode) -> List[str]:
+        return self._store.child_names(inode.id)
+
+    def children(self, inode: Inode) -> Iterator[Inode]:
+        for name in self._store.child_names(inode.id):
+            cid = self._store.get_child_id(inode.id, name)
+            if cid is not None:
+                child = self._store.get(cid)
+                if child is not None:
+                    yield child
+
+    def descendants(self, inode: Inode) -> Iterator[Inode]:
+        """Post-order descendants (children before parents) for deletes."""
+        for child in list(self.children(inode)):
+            if child.is_directory:
+                yield from self.descendants(child)
+            yield child
+
+    # ------------------------------------------------- journal application
+    def process_entry(self, entry: JournalEntry) -> bool:
+        t, p = entry.type, entry.payload
+        if t == EntryType.INODE_DIRECTORY or t == EntryType.INODE_FILE:
+            self._apply_create(Inode.from_wire_dict(p))
+        elif t == EntryType.UPDATE_INODE:
+            self._apply_update(p)
+        elif t == EntryType.NEW_BLOCK:
+            self._apply_new_block(p)
+        elif t == EntryType.COMPLETE_FILE:
+            self._apply_complete(p)
+        elif t == EntryType.DELETE_FILE:
+            self._apply_delete(p)
+        elif t == EntryType.RENAME:
+            self._apply_rename(p)
+        elif t == EntryType.SET_ATTRIBUTE:
+            self._apply_set_attribute(p)
+        elif t == EntryType.PERSIST_FILE:
+            self._apply_persist(p)
+        else:
+            return False
+        return True
+
+    def _apply_create(self, inode: Inode) -> None:
+        self._store.put(inode)
+        self._inode_count += 1
+        if inode.parent_id == ROOT_ID_PARENT:
+            self._root_id = inode.id
+        else:
+            self._store.add_child(inode.parent_id, inode.name, inode.id)
+            parent = self._store.get(inode.parent_id)
+            if parent is not None:
+                parent.last_modification_time_ms = max(
+                    parent.last_modification_time_ms, inode.creation_time_ms)
+                self._store.put(parent)
+        if inode.ttl >= 0:
+            self.ttl_buckets.insert(inode.id, inode.creation_time_ms, inode.ttl)
+        if inode.pinned:
+            self.pinned_ids.add(inode.id)
+
+    def _apply_update(self, p: dict) -> None:
+        inode = self._store.get(p["id"])
+        if inode is None:
+            return
+        for k, v in p.items():
+            if k != "id" and hasattr(inode, k):
+                setattr(inode, k, v)
+        self._store.put(inode)
+
+    def _apply_new_block(self, p: dict) -> None:
+        inode = self._store.get(p["file_id"])
+        if inode is None:
+            return
+        inode.block_ids.append(p["block_id"])
+        self._store.put(inode)
+
+    def _apply_complete(self, p: dict) -> None:
+        inode = self._store.get(p["file_id"])
+        if inode is None:
+            return
+        inode.completed = True
+        inode.length = p["length"]
+        inode.last_modification_time_ms = p.get("op_time_ms",
+                                                inode.last_modification_time_ms)
+        if "block_ids" in p and p["block_ids"] is not None:
+            inode.block_ids = list(p["block_ids"])
+        self._store.put(inode)
+
+    def _apply_delete(self, p: dict) -> None:
+        inode = self._store.get(p["id"])
+        if inode is None:
+            return
+        self._store.remove_child(inode.parent_id, inode.name)
+        self._store.remove(inode.id)
+        self._inode_count -= 1
+        self.pinned_ids.discard(inode.id)
+        self.to_be_persisted_ids.discard(inode.id)
+        if inode.ttl >= 0:
+            self.ttl_buckets.remove(inode.id)
+        parent = self._store.get(inode.parent_id)
+        if parent is not None:
+            parent.last_modification_time_ms = max(
+                parent.last_modification_time_ms,
+                p.get("op_time_ms", parent.last_modification_time_ms))
+            self._store.put(parent)
+
+    def _apply_rename(self, p: dict) -> None:
+        inode = self._store.get(p["id"])
+        if inode is None:
+            return
+        self._store.remove_child(inode.parent_id, inode.name)
+        inode.parent_id = p["new_parent_id"]
+        inode.name = p["new_name"]
+        inode.last_modification_time_ms = p.get(
+            "op_time_ms", inode.last_modification_time_ms)
+        self._store.put(inode)
+        self._store.add_child(inode.parent_id, inode.name, inode.id)
+
+    def _apply_set_attribute(self, p: dict) -> None:
+        inode = self._store.get(p["id"])
+        if inode is None:
+            return
+        if "pinned" in p and p["pinned"] is not None:
+            inode.pinned = p["pinned"]
+            if inode.pinned:
+                self.pinned_ids.add(inode.id)
+                inode.pinned_media = list(p.get("pinned_media") or [])
+            else:
+                self.pinned_ids.discard(inode.id)
+                inode.pinned_media = []
+        if "ttl" in p and p["ttl"] is not None:
+            if inode.ttl >= 0:
+                self.ttl_buckets.remove(inode.id)
+            inode.ttl = p["ttl"]
+            inode.ttl_action = p.get("ttl_action") or inode.ttl_action
+            if inode.ttl >= 0:
+                self.ttl_buckets.insert(
+                    inode.id, p.get("op_time_ms", inode.creation_time_ms),
+                    inode.ttl)
+        for k in ("owner", "group", "mode", "replication_min",
+                  "replication_max", "persistence_state"):
+            if p.get(k) is not None:
+                setattr(inode, k, p[k])
+        if p.get("persistence_state") == PersistenceState.TO_BE_PERSISTED:
+            self.to_be_persisted_ids.add(inode.id)
+        elif p.get("persistence_state") is not None:
+            self.to_be_persisted_ids.discard(inode.id)
+        if p.get("xattr") is not None:
+            inode.xattr.update(p["xattr"])
+        if p.get("op_time_ms"):
+            inode.last_modification_time_ms = p["op_time_ms"]
+        self._store.put(inode)
+
+    def _apply_persist(self, p: dict) -> None:
+        inode = self._store.get(p["id"])
+        if inode is None:
+            return
+        inode.persistence_state = PersistenceState.PERSISTED
+        inode.ufs_fingerprint = p.get("ufs_fingerprint", inode.ufs_fingerprint)
+        self.to_be_persisted_ids.discard(inode.id)
+        self._store.put(inode)
+
+    # ---------------------------------------------------------- checkpoint
+    def snapshot(self) -> dict:
+        inode_dicts = []
+        for iid in self._store.all_ids():
+            inode = self._store.get(iid)
+            if inode is not None:
+                inode_dicts.append(inode.to_wire_dict())
+        return {
+            "root_id": self._root_id,
+            "inodes": inode_dicts,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._store.clear()
+        self.ttl_buckets.clear()
+        self.pinned_ids.clear()
+        self.to_be_persisted_ids.clear()
+        self._inode_count = 0
+        self._root_id = snap.get("root_id")
+        for d in snap.get("inodes", []):
+            inode = Inode.from_wire_dict(d)
+            self._store.put(inode)
+            self._inode_count += 1
+            if inode.parent_id != ROOT_ID_PARENT:
+                self._store.add_child(inode.parent_id, inode.name, inode.id)
+            if inode.ttl >= 0:
+                self.ttl_buckets.insert(inode.id, inode.creation_time_ms,
+                                        inode.ttl)
+            if inode.pinned:
+                self.pinned_ids.add(inode.id)
+            if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
+                self.to_be_persisted_ids.add(inode.id)
+
+    def _empty_snapshot(self) -> dict:
+        return {"root_id": None, "inodes": []}

@@ -1,0 +1,13 @@
+"""Under-storage connectors (reference: ``underfs/`` + ``core/common/.../underfs``)."""
+
+from alluxio_tpu.underfs.base import (  # noqa: F401
+    CreateOptions, DeleteOptions, UfsMode, UfsStatus, UnderFileSystem,
+)
+from alluxio_tpu.underfs.local import LocalUnderFileSystem  # noqa: F401
+from alluxio_tpu.underfs.object_base import (  # noqa: F401
+    MemObjectStore, MemUnderFileSystem, ObjectStoreClient,
+    ObjectUnderFileSystem,
+)
+from alluxio_tpu.underfs.registry import (  # noqa: F401
+    UfsManager, create_ufs, register_factory, supported_schemes,
+)

@@ -1,0 +1,134 @@
+"""UFS factory registry + per-process UFS manager.
+
+Re-designs of ``underfs/UnderFileSystemFactoryRegistry.java`` (ServiceLoader
+discovery -> here a plain scheme-keyed registry with entry-point-style
+``register`` calls) and the UFS managers
+(``core/server/common/.../underfs/{UfsManager,AbstractUfsManager}.java``):
+mount-id-keyed cached instances shared by master/worker/job processes,
+with per-UFS maintenance mode (reference: ``MasterUfsManager``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from alluxio_tpu.underfs.base import UfsMode, UnderFileSystem
+from alluxio_tpu.underfs.local import LocalUnderFileSystem
+from alluxio_tpu.underfs.object_base import MemUnderFileSystem
+from alluxio_tpu.utils.exceptions import NotFoundError, NotSupportedError
+
+_FACTORIES: Dict[str, Callable[..., UnderFileSystem]] = {}
+_LOCK = threading.Lock()
+
+
+def register_factory(scheme: str, factory: Callable[..., UnderFileSystem]) -> None:
+    with _LOCK:
+        _FACTORIES[scheme] = factory
+
+
+def _scheme_of(uri: str) -> str:
+    if "://" in uri:
+        return uri.split("://", 1)[0]
+    return ""  # bare path -> local
+
+
+def create_ufs(uri: str, properties: Optional[Dict[str, str]] = None) -> UnderFileSystem:
+    scheme = _scheme_of(uri)
+    with _LOCK:
+        factory = _FACTORIES.get(scheme)
+    if factory is None:
+        raise NotSupportedError(f"no UFS factory for scheme {scheme!r} ({uri})")
+    return factory(uri, properties)
+
+
+def supported_schemes() -> Tuple[str, ...]:
+    with _LOCK:
+        return tuple(sorted(_FACTORIES))
+
+
+# built-ins (reference: ServiceLoader META-INF/services entries per connector)
+register_factory("", LocalUnderFileSystem)
+register_factory("file", LocalUnderFileSystem)
+register_factory("mem", MemUnderFileSystem)
+
+
+def _register_optional() -> None:
+    """Connectors with extra deps register lazily and tolerate absence."""
+    try:
+        from alluxio_tpu.underfs.web import WebUnderFileSystem
+
+        register_factory("http", WebUnderFileSystem)
+        register_factory("https", WebUnderFileSystem)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from alluxio_tpu.underfs.s3 import S3UnderFileSystem
+
+        register_factory("s3", S3UnderFileSystem)
+        register_factory("s3a", S3UnderFileSystem)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from alluxio_tpu.underfs.gcs import GcsUnderFileSystem
+
+        register_factory("gs", GcsUnderFileSystem)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+_register_optional()
+
+
+class UfsManager:
+    """Mount-id-keyed cache of UFS instances (reference: AbstractUfsManager)."""
+
+    def __init__(self) -> None:
+        self._by_mount: Dict[int, UnderFileSystem] = {}
+        self._roots: Dict[int, str] = {}
+        self._modes: Dict[str, UfsMode] = {}  # ufs root -> mode
+        self._lock = threading.RLock()
+
+    def add_mount(self, mount_id: int, ufs_uri: str,
+                  properties: Optional[Dict[str, str]] = None) -> UnderFileSystem:
+        with self._lock:
+            if mount_id in self._by_mount:
+                return self._by_mount[mount_id]
+            ufs = create_ufs(ufs_uri, properties)
+            self._by_mount[mount_id] = ufs
+            self._roots[mount_id] = ufs_uri
+            return ufs
+
+    def remove_mount(self, mount_id: int) -> None:
+        with self._lock:
+            ufs = self._by_mount.pop(mount_id, None)
+            self._roots.pop(mount_id, None)
+        if ufs is not None:
+            ufs.close()
+
+    def get(self, mount_id: int) -> UnderFileSystem:
+        with self._lock:
+            ufs = self._by_mount.get(mount_id)
+        if ufs is None:
+            raise NotFoundError(f"no UFS for mount id {mount_id}")
+        return ufs
+
+    def has(self, mount_id: int) -> bool:
+        with self._lock:
+            return mount_id in self._by_mount
+
+    # -- maintenance mode (reference: MasterUfsManager ufs modes) ----------
+    def set_ufs_mode(self, ufs_root: str, mode: UfsMode) -> None:
+        with self._lock:
+            self._modes[ufs_root] = mode
+
+    def get_ufs_mode(self, ufs_root: str) -> UfsMode:
+        with self._lock:
+            return self._modes.get(ufs_root, UfsMode.READ_WRITE)
+
+    def close(self) -> None:
+        with self._lock:
+            for ufs in self._by_mount.values():
+                ufs.close()
+            self._by_mount.clear()
+            self._roots.clear()

@@ -46,13 +46,17 @@ class IndexedSet(Generic[T]):
         with self._lock:
             if item in self._items:
                 return False
+            # validate every unique constraint BEFORE touching any index so a
+            # violation leaves the set untouched
+            for name, ix in self._indexes.items():
+                if ix.unique:
+                    key = ix.extractor(item)
+                    if self._maps[name].get(key):
+                        raise ValueError(
+                            f"unique index {name} already has key {key!r}")
             for name, ix in self._indexes.items():
                 key = ix.extractor(item)
-                bucket = self._maps[name].setdefault(key, set())
-                if ix.unique and bucket:
-                    raise ValueError(
-                        f"unique index {name} already has key {key!r}")
-                bucket.add(item)
+                self._maps[name].setdefault(key, set()).add(item)
             self._items.add(item)
             return True
 

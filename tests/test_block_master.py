@@ -1,0 +1,122 @@
+"""BlockMaster tests: worker registration, heartbeat protocol, liveness.
+
+Reference analogue: ``core/server/master/src/test/java/alluxio/master/block/
+BlockMasterTest.java``.
+"""
+
+import pytest
+
+from alluxio_tpu.journal import NoopJournalSystem
+from alluxio_tpu.master import BlockMaster, WorkerCommand
+from alluxio_tpu.utils.clock import ManualClock
+from alluxio_tpu.utils.exceptions import BlockDoesNotExistError
+from alluxio_tpu.utils.wire import WorkerNetAddress
+
+
+@pytest.fixture()
+def bm():
+    clock = ManualClock(start_ms=0)
+    m = BlockMaster(NoopJournalSystem(), clock=clock, worker_timeout_ms=10_000)
+    m._test_clock = clock
+    return m
+
+
+def _addr(host="w1", port=29999):
+    return WorkerNetAddress(host=host, rpc_port=port)
+
+
+def _register(bm, addr=None, blocks=None):
+    wid = bm.get_worker_id(addr or _addr())
+    bm.worker_register(wid, {"MEM": 1000}, {"MEM": 0}, blocks or {})
+    return wid
+
+
+class TestWorkerProtocol:
+    def test_register_and_report(self, bm):
+        wid = _register(bm)
+        infos = bm.get_worker_infos()
+        assert len(infos) == 1
+        assert infos[0].id == wid
+        assert infos[0].capacity_bytes == 1000
+
+    def test_worker_id_stable_per_address(self, bm):
+        assert bm.get_worker_id(_addr()) == bm.get_worker_id(_addr())
+        assert bm.get_worker_id(_addr("w2")) != bm.get_worker_id(_addr())
+
+    def test_heartbeat_before_register_commands_register(self, bm):
+        wid = bm.get_worker_id(_addr())
+        resp = bm.worker_heartbeat(wid, {"MEM": 0}, {}, [])
+        assert resp["command"] == WorkerCommand.REGISTER
+
+    def test_commit_block_and_locations(self, bm):
+        wid = _register(bm)
+        bm.commit_block(wid, 512, "MEM", block_id=100, length=512)
+        info = bm.get_block_info(100)
+        assert info.length == 512
+        assert [l.worker_id for l in info.locations] == [wid]
+        assert info.locations[0].tier_alias == "MEM"
+
+    def test_heartbeat_adds_and_removes_locations(self, bm):
+        wid = _register(bm)
+        bm.commit_block_in_ufs(200, 64)  # metadata known, no cached copy
+        resp = bm.worker_heartbeat(wid, {"MEM": 64}, {"MEM": [200]}, [])
+        assert resp["command"] == WorkerCommand.NOTHING
+        assert len(bm.get_block_info(200).locations) == 1
+        bm.worker_heartbeat(wid, {"MEM": 0}, {}, [200])
+        assert bm.get_block_info(200).locations == []
+        assert 200 in bm.lost_blocks()
+
+    def test_unknown_block_in_heartbeat_triggers_free(self, bm):
+        wid = _register(bm)
+        resp = bm.worker_heartbeat(wid, {"MEM": 10}, {"MEM": [999]}, [])
+        assert resp["command"] == WorkerCommand.FREE
+        assert resp["data"] == [999]
+        resp2 = bm.worker_heartbeat(wid, {"MEM": 10}, {}, [])
+        assert resp2["command"] == WorkerCommand.NOTHING
+
+    def test_reregistration_replaces_block_list(self, bm):
+        wid = _register(bm)
+        bm.commit_block(wid, 10, "MEM", 1, 10)
+        bm.commit_block(wid, 20, "MEM", 2, 10)
+        bm.worker_register(wid, {"MEM": 1000}, {"MEM": 10}, {"MEM": [1]})
+        assert len(bm.get_block_info(1).locations) == 1
+        assert bm.get_block_info(2).locations == []
+
+    def test_lost_worker_detection_and_recovery(self, bm):
+        wid = _register(bm)
+        bm.commit_block(wid, 10, "MEM", 1, 10)
+        lost_events = []
+        bm.lost_worker_listeners.append(lambda w: lost_events.append(w.id))
+        bm._test_clock.add_time_ms(20_000)
+        assert bm.detect_lost_workers() == [wid]
+        assert lost_events == [wid]
+        assert bm.worker_count() == 0
+        assert bm.lost_worker_count() == 1
+        assert 1 in bm.lost_blocks()
+        # same address returns: same id, must re-register
+        wid2 = bm.get_worker_id(_addr())
+        assert wid2 == wid
+        resp = bm.worker_heartbeat(wid2, {"MEM": 0}, {}, [])
+        assert resp["command"] == WorkerCommand.REGISTER
+        bm.worker_register(wid2, {"MEM": 1000}, {"MEM": 10}, {"MEM": [1]})
+        assert bm.lost_worker_count() == 0
+        assert len(bm.get_block_info(1).locations) == 1
+
+    def test_remove_blocks_queues_free_command(self, bm):
+        wid = _register(bm)
+        bm.commit_block(wid, 10, "MEM", 5, 10)
+        bm.remove_blocks([5], delete_metadata=True)
+        resp = bm.worker_heartbeat(wid, {"MEM": 10}, {}, [])
+        assert resp["command"] == WorkerCommand.FREE
+        assert resp["data"] == [5]
+        with pytest.raises(BlockDoesNotExistError):
+            bm.get_block_info(5)
+
+    def test_journal_replay_restores_lengths_not_locations(self, bm):
+        wid = _register(bm)
+        bm.commit_block(wid, 10, "MEM", 7, 123)
+        snap = bm.snapshot()
+        m2 = BlockMaster(NoopJournalSystem())
+        m2.restore(snap)
+        assert m2.get_block_info(7).length == 123
+        assert m2.get_block_info(7).locations == []  # soft state

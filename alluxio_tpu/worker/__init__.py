@@ -1,0 +1,8 @@
+"""Worker data plane (reference: ``core/server/worker``)."""
+
+from alluxio_tpu.worker.meta import (  # noqa: F401
+    BlockMeta, BlockMetadataManager, StorageDir, StorageTier, TempBlockMeta,
+)
+from alluxio_tpu.worker.process import BlockWorker, build_store_from_conf  # noqa: F401
+from alluxio_tpu.worker.tiered_store import TieredBlockStore  # noqa: F401
+from alluxio_tpu.worker.ufs_io import UfsBlockDescriptor  # noqa: F401

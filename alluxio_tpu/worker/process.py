@@ -1,0 +1,216 @@
+"""Worker process assembly.
+
+Re-design of ``core/server/worker/.../{AlluxioWorkerProcess.java,
+block/DefaultBlockWorker.java:77,197-242}``: builds the tiered store from
+config (tier templates), wires the master-sync heartbeats, the UFS
+read-through path and the async cache manager, and exposes the block-level
+API the data server handlers call. Transport-independent: the gRPC data
+server (``worker/data_server.py``) and in-process tests drive the same
+object.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+from alluxio_tpu.conf import Configuration, Keys, Templates
+from alluxio_tpu.heartbeat import HeartbeatContext, HeartbeatThread
+from alluxio_tpu.underfs.registry import UfsManager
+from alluxio_tpu.utils import ids as id_utils
+from alluxio_tpu.utils.wire import TieredIdentity, WorkerNetAddress
+from alluxio_tpu.worker.allocator import Allocator
+from alluxio_tpu.worker.annotator import BlockAnnotator
+from alluxio_tpu.worker.master_sync import (
+    BlockMasterSync, PinListSync, StorageChecker,
+)
+from alluxio_tpu.worker.management import ManagementTaskCoordinator
+from alluxio_tpu.worker.meta import BlockMetadataManager
+from alluxio_tpu.worker.tiered_store import BlockReader, TieredBlockStore
+from alluxio_tpu.worker.ufs_io import (
+    AsyncCacheManager, UfsBlockDescriptor, UfsBlockReader,
+)
+
+LOG = logging.getLogger(__name__)
+
+
+class LocalBlockLease:
+    """Short-circuit lease: path + held shared lock; close() releases."""
+
+    def __init__(self, path: str, length: int, lock) -> None:
+        self.path = path
+        self.length = length
+        self._lock = lock
+
+    def close(self) -> None:
+        self._lock.close()
+
+    def __enter__(self) -> "LocalBlockLease":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def build_store_from_conf(conf: Configuration) -> TieredBlockStore:
+    """Tier layout from the template keys
+    (reference: WORKER_TIERED_STORE_LEVELS + per-level templates)."""
+    meta = BlockMetadataManager()
+    levels = conf.get_int(Keys.WORKER_TIERED_STORE_LEVELS)
+    data_folder = conf.get(Keys.WORKER_DATA_FOLDER)
+    shm_dir = conf.get(Keys.WORKER_SHM_DIR)
+    ram_size = conf.get_bytes(Keys.WORKER_RAMDISK_SIZE)
+    for lvl in range(levels):
+        alias = conf.get(Templates.WORKER_TIER_ALIAS.format(lvl)) or \
+            {0: "MEM", 1: "SSD", 2: "HDD"}.get(lvl, f"TIER{lvl}")
+        tier = meta.add_tier(alias)
+        paths = conf.get_list(Templates.WORKER_TIER_DIRS_PATH.format(lvl))
+        quotas = conf.get_list(Templates.WORKER_TIER_DIRS_QUOTA.format(lvl))
+        if not paths:
+            if alias == "MEM":
+                paths = [os.path.join(shm_dir, "mem")]
+                quotas = quotas or [str(ram_size)]
+            else:
+                paths = [os.path.join(data_folder, alias.lower())]
+                quotas = quotas or [str(4 * ram_size)]
+        for i, p in enumerate(paths):
+            from alluxio_tpu.conf.property_key import parse_bytes
+
+            quota = parse_bytes(quotas[i]) if i < len(quotas) else ram_size
+            tier.add_dir(p, quota, medium_type=alias)
+    allocator = Allocator.create(conf.get(Keys.WORKER_ALLOCATOR_CLASS), meta)
+    ann_kind = conf.get(Keys.WORKER_ANNOTATOR_CLASS)
+    if ann_kind == "LRFU":
+        annotator = BlockAnnotator.create(
+            "LRFU", step_factor=conf.get_float(Keys.WORKER_LRFU_STEP_FACTOR),
+            attenuation_factor=conf.get_float(
+                Keys.WORKER_LRFU_ATTENUATION_FACTOR))
+    else:
+        annotator = BlockAnnotator.create(ann_kind)
+    return TieredBlockStore(meta, allocator, annotator)
+
+
+class BlockWorker:
+    """The worker: tiered store + protocols. Reference: DefaultBlockWorker."""
+
+    def __init__(self, conf: Configuration, block_master_client,
+                 fs_master_client=None,
+                 ufs_manager: Optional[UfsManager] = None,
+                 address: Optional[WorkerNetAddress] = None) -> None:
+        self._conf = conf
+        self.store = build_store_from_conf(conf)
+        self.ufs_manager = ufs_manager or UfsManager()
+        host = conf.get(Keys.WORKER_HOSTNAME)
+        self.address = address or WorkerNetAddress(
+            host=host,
+            rpc_port=conf.get_int(Keys.WORKER_RPC_PORT),
+            shm_dir=conf.get(Keys.WORKER_SHM_DIR),
+            tiered_identity=TieredIdentity.from_spec(
+                conf.get(Keys.TIERED_IDENTITY), hostname=host))
+        self._master_sync = BlockMasterSync(self.store, self.address,
+                                            block_master_client)
+        self._pin_sync = PinListSync(self.store, fs_master_client) \
+            if fs_master_client is not None else None
+        self._storage_checker = StorageChecker(self.store)
+        self._mgmt = ManagementTaskCoordinator(
+            self.store,
+            align=conf.get_bool(Keys.WORKER_MANAGEMENT_TIER_ALIGN_ENABLED),
+            promote=conf.get_bool(Keys.WORKER_MANAGEMENT_TIER_PROMOTE_ENABLED),
+            quota_percent=conf.get_int(
+                Keys.WORKER_MANAGEMENT_PROMOTE_QUOTA_PERCENT))
+        self._ufs_reader = UfsBlockReader(self.store)
+        self.async_cache = AsyncCacheManager(
+            self.store, lambda mount_id: self.ufs_manager.get(mount_id))
+        self._threads: List[HeartbeatThread] = []
+        self._started = False
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def worker_id(self) -> Optional[int]:
+        return self._master_sync.worker_id
+
+    def start(self) -> None:
+        """Register then start heartbeats
+        (reference: ``DefaultBlockWorker.start:197-242``)."""
+        self._master_sync.register_with_master()
+        hb_interval = self._conf.get_duration_s(
+            Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL)
+        mgmt_interval = self._conf.get_duration_s(
+            Keys.WORKER_MANAGEMENT_TASK_INTERVAL)
+        self._threads = [
+            HeartbeatThread(HeartbeatContext.WORKER_BLOCK_SYNC,
+                            self._master_sync, hb_interval),
+            HeartbeatThread(HeartbeatContext.WORKER_STORAGE_HEALTH,
+                            self._storage_checker, 60.0),
+            HeartbeatThread(HeartbeatContext.WORKER_MANAGEMENT_TASKS,
+                            self._mgmt, mgmt_interval),
+        ]
+        if self._pin_sync is not None:
+            self._threads.append(
+                HeartbeatThread(HeartbeatContext.WORKER_PIN_LIST_SYNC,
+                                self._pin_sync, hb_interval))
+        for t in self._threads:
+            t.start()
+        self._started = True
+
+    def stop(self) -> None:
+        for t in self._threads:
+            t.stop()
+        self.async_cache.close()
+
+    # -- data-plane API (called by the data server / local clients) --------
+    def create_block(self, session_id: int, block_id: int, *,
+                     initial_bytes: int, tier_alias: str = "") -> str:
+        """Returns the temp-block *path* — the short-circuit write lease
+        (reference: ``CreateLocalBlock`` in block_worker.proto:127-152)."""
+        temp = self.store.create_block(session_id, block_id,
+                                       initial_bytes=initial_bytes,
+                                       tier_alias=tier_alias)
+        return temp.path
+
+    def get_temp_writer(self, session_id: int, block_id: int):
+        return self.store.get_temp_writer(session_id, block_id)
+
+    def commit_block(self, session_id: int, block_id: int,
+                     pinned: bool = False) -> None:
+        """Commit locally then report to the master (reference:
+        ``DefaultBlockWorker.commitBlock`` -> BlockMasterClient.commitBlock)."""
+        meta = self.store.commit_block(session_id, block_id, pinned)
+        client = self._master_sync._client
+        if self._master_sync.worker_id is not None:
+            used = self.store.meta.get_tier(meta.tier_alias).used_bytes
+            client.commit_block(self._master_sync.worker_id, used,
+                                meta.tier_alias, block_id, meta.length)
+
+    def abort_block(self, session_id: int, block_id: int) -> None:
+        self.store.abort_block(session_id, block_id)
+
+    def open_reader(self, block_id: int) -> BlockReader:
+        """Local committed-block reader (holds the shared lock)."""
+        return self.store.get_reader(block_id)
+
+    def open_local_block(self, block_id: int) -> "LocalBlockLease":
+        """Short-circuit read lease: the committed block file's path plus a
+        shared lock held until the lease closes, so eviction cannot unlink
+        the file mid-mmap (reference: ``OpenLocalBlock`` +
+        ``ShortCircuitBlockReadHandler`` keep a block lock for the stream's
+        lifetime)."""
+        lock = self.store.pin_block(block_id)
+        meta = self.store.get_block_meta(block_id)
+        if meta is None:  # raced with eviction between pin and lookup
+            lock.close()
+            from alluxio_tpu.utils.exceptions import BlockDoesNotExistError
+
+            raise BlockDoesNotExistError(f"block {block_id} not cached")
+        return LocalBlockLease(meta.path, meta.length, lock)
+
+    def read_ufs_block(self, desc: UfsBlockDescriptor, *,
+                       cache: bool = True) -> bytes:
+        """Cold read-through (reference: UnderFileSystemBlockReader)."""
+        ufs = self.ufs_manager.get(desc.mount_id)
+        return self._ufs_reader.read_block(ufs, desc, cache=cache)
+
+    def cleanup_session(self, session_id: int) -> None:
+        self.store.cleanup_session(session_id)

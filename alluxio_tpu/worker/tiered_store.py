@@ -1,0 +1,387 @@
+"""TieredBlockStore: the worker's cache of block files across storage tiers.
+
+Re-design of ``core/server/worker/.../block/TieredBlockStore.java:85`` (lock
+hierarchy documented ``:58-83``): temp-block create/commit/abort lifecycle,
+eviction-on-allocation in annotator order with cascade demotion to the next
+tier, move/free, and lock-guarded reads.
+
+Storage layout: one file per block, ``<dir>/<block_id>``; temp blocks at
+``<dir>/.tmp/<session>_<block_id>``. The MEM tier sits on ``/dev/shm`` so a
+same-host client can ``mmap`` the committed file and hand the pages to XLA
+without a copy (the short-circuit read path; reference:
+``OpenLocalBlock`` leases in ``block_worker.proto:18-21``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.worker.allocator import ANY_TIER, Allocator
+from alluxio_tpu.worker.annotator import BlockAnnotator
+from alluxio_tpu.worker.lock_manager import BlockLock, BlockLockManager
+from alluxio_tpu.worker.meta import (
+    BlockMeta, BlockMetadataManager, StorageDir, TempBlockMeta,
+)
+from alluxio_tpu.utils.exceptions import (
+    AlreadyExistsError, BlockDoesNotExistError, InvalidArgumentError,
+    WorkerOutOfSpaceError,
+)
+
+
+class BlockWriter:
+    """Appender for a temp block file."""
+
+    def __init__(self, temp: TempBlockMeta, store: "TieredBlockStore") -> None:
+        self._temp = temp
+        self._store = store
+        self._f = open(temp.path, "ab")
+        self.written = os.path.getsize(temp.path)
+
+    def append(self, data: bytes) -> int:
+        needed = self.written + len(data) - self._temp.bytes_reserved
+        if needed > 0:
+            self._store.request_space(self._temp.session_id,
+                                      self._temp.block_id, needed)
+        self._f.write(data)
+        self.written += len(data)
+        return len(data)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class BlockReader:
+    """Positioned reader over a committed block file, holding a read lock."""
+
+    def __init__(self, meta: BlockMeta, lock: BlockLock) -> None:
+        self._meta = meta
+        self._lock = lock
+        self._fd = os.open(meta.path, os.O_RDONLY)
+        self.length = meta.length
+        self.path = meta.path
+        self.tier_alias = meta.tier_alias
+
+    def read(self, offset: int, length: int) -> bytes:
+        return os.pread(self._fd, length, offset)
+
+    def close(self) -> None:
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        self._lock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class TieredBlockStore:
+    def __init__(self, meta: BlockMetadataManager, allocator: Allocator,
+                 annotator: BlockAnnotator,
+                 eviction_retries: int = 3) -> None:
+        self.meta = meta
+        self._allocator = allocator
+        self.annotator = annotator
+        self._locks = BlockLockManager()
+        self._eviction_retries = eviction_retries
+        #: commit-time pins (commit_block(pinned=True))
+        self.pinned_blocks: Set[int] = set()
+        #: master-driven pins, wholesale-replaced by PinListSync each tick
+        self.master_pinned_blocks: Set[int] = set()
+        #: serialized allocation/eviction decisions (metadata lock; IO and
+        #: reads proceed outside it — mirroring the reference's hierarchy)
+        self._alloc_lock = threading.RLock()
+        self._listeners: List[Callable[[str, int], None]] = []
+        self._m = metrics()
+
+    # -- observability ------------------------------------------------------
+    def add_listener(self, fn: Callable[[str, int], None]) -> None:
+        """fn(event, block_id); events: committed/removed/moved/evicted."""
+        self._listeners.append(fn)
+
+    def _emit(self, event: str, block_id: int) -> None:
+        for fn in self._listeners:
+            try:
+                fn(event, block_id)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- write path ---------------------------------------------------------
+    def create_block(self, session_id: int, block_id: int, *,
+                     initial_bytes: int, tier_alias: str = ANY_TIER
+                     ) -> TempBlockMeta:
+        """Allocate a temp block, evicting on demand
+        (reference: ``createBlock`` + ``freeSpace``, TieredBlockStore.java:80-82)."""
+        with self._alloc_lock:
+            if self.meta.get_block(block_id) is not None or \
+                    self.meta.get_temp(block_id) is not None:
+                raise AlreadyExistsError(f"block {block_id} already exists")
+            d = self._allocate_with_eviction(initial_bytes, tier_alias)
+            temp = TempBlockMeta(block_id=block_id, session_id=session_id,
+                                 dir=d, bytes_reserved=initial_bytes)
+            d.reserve(initial_bytes)
+            d.add_temp(temp)
+        # touch the file outside the metadata lock
+        open(temp.path, "wb").close()
+        return temp
+
+    def get_temp_writer(self, session_id: int, block_id: int) -> BlockWriter:
+        temp = self.meta.get_temp(block_id)
+        if temp is None or temp.session_id != session_id:
+            raise BlockDoesNotExistError(
+                f"no temp block {block_id} for session {session_id}")
+        return BlockWriter(temp, self)
+
+    def request_space(self, session_id: int, block_id: int,
+                      additional: int) -> None:
+        with self._alloc_lock:
+            temp = self.meta.get_temp(block_id)
+            if temp is None or temp.session_id != session_id:
+                raise BlockDoesNotExistError(f"no temp block {block_id}")
+            if not temp.dir.reserve(additional):
+                freed = self._free_space_in_dir(temp.dir, additional)
+                if not temp.dir.reserve(additional):
+                    raise WorkerOutOfSpaceError(
+                        f"cannot reserve {additional}B in "
+                        f"{temp.dir.tier.alias}:{temp.dir.index} "
+                        f"(freed {freed}B)")
+            temp.bytes_reserved += additional
+
+    def commit_block(self, session_id: int, block_id: int,
+                     pinned: bool = False) -> BlockMeta:
+        """Temp -> committed: rename into place, fix accounting, annotate."""
+        with self._alloc_lock:
+            temp = self.meta.get_temp(block_id)
+            if temp is None:
+                raise BlockDoesNotExistError(f"no temp block {block_id}")
+            if temp.session_id != session_id:
+                raise InvalidArgumentError(
+                    f"temp block {block_id} belongs to another session")
+            length = os.path.getsize(temp.path)
+            final = BlockMeta(block_id=block_id, length=length, dir=temp.dir)
+            os.replace(temp.path, final.path)
+            temp.dir.remove_temp(block_id)
+            # reconcile reservation with the actual on-disk size: release
+            # over-reservation; for short-circuit writes that overshot the
+            # reservation, force-account the shortfall (the bytes are already
+            # on disk) and restore headroom by freeing
+            delta = temp.bytes_reserved - length
+            if delta > 0:
+                temp.dir.release(delta)
+            elif delta < 0:
+                if not temp.dir.reserve(-delta):
+                    temp.dir.force_reserve(-delta)
+                    overshoot = temp.dir.used_bytes - temp.dir.capacity_bytes
+                    if overshoot > 0:
+                        self._free_space_in_dir(temp.dir, overshoot)
+            temp.dir.add_block(final)
+            if pinned:
+                self.pinned_blocks.add(block_id)
+        self.annotator.on_commit(block_id)
+        self._m.counter("Worker.BlocksCommitted").inc()
+        self._emit("committed", block_id)
+        return final
+
+    def abort_block(self, session_id: int, block_id: int) -> None:
+        with self._alloc_lock:
+            temp = self.meta.get_temp(block_id)
+            if temp is None:
+                raise BlockDoesNotExistError(f"no temp block {block_id}")
+            if temp.session_id != session_id:
+                raise InvalidArgumentError("wrong session")
+            temp.dir.remove_temp(block_id)
+            temp.dir.release(temp.bytes_reserved)
+        if os.path.exists(temp.path):
+            os.remove(temp.path)
+
+    def cleanup_session(self, session_id: int) -> None:
+        """Abort all of a dead session's temp blocks
+        (reference: ``SessionCleaner``)."""
+        for tier in self.meta.tiers:
+            for d in tier.dirs:
+                for temp in d.temp_blocks_of_session(session_id):
+                    try:
+                        self.abort_block(session_id, temp.block_id)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    # -- read path ----------------------------------------------------------
+    def get_reader(self, block_id: int) -> BlockReader:
+        lock = self._locks.lock_read(block_id)
+        try:
+            meta = self.meta.get_block(block_id)
+            if meta is None:
+                raise BlockDoesNotExistError(f"block {block_id} not cached")
+            reader = BlockReader(meta, lock)
+        except BaseException:
+            lock.close()  # never leak the read lock (unremovable block)
+            raise
+        self.annotator.on_access(block_id)
+        self._m.counter("Worker.BlocksAccessed").inc()
+        return reader
+
+    def pin_block(self, block_id: int) -> Optional[BlockLock]:
+        """Shared-lock lease without opening the file — backs the
+        short-circuit read lease so eviction cannot unlink a file a client
+        is mmapping (reference: OpenLocalBlock holds a block lock for the
+        stream's lifetime)."""
+        lock = self._locks.lock_read(block_id)
+        if self.meta.get_block(block_id) is None:
+            lock.close()
+            raise BlockDoesNotExistError(f"block {block_id} not cached")
+        self.annotator.on_access(block_id)
+        return lock
+
+    def get_block_meta(self, block_id: int) -> Optional[BlockMeta]:
+        return self.meta.get_block(block_id)
+
+    def has_block(self, block_id: int) -> bool:
+        return self.meta.get_block(block_id) is not None
+
+    def access_block(self, block_id: int) -> None:
+        self.annotator.on_access(block_id)
+
+    # -- removal / movement -------------------------------------------------
+    def remove_block(self, block_id: int, timeout: Optional[float] = 5.0) -> None:
+        lock = self._locks.lock_write(block_id, timeout)
+        if lock is None:
+            raise InvalidArgumentError(f"block {block_id} is busy")
+        try:
+            with self._alloc_lock:
+                meta = self.meta.get_block(block_id)
+                if meta is None:
+                    raise BlockDoesNotExistError(f"block {block_id} not cached")
+                meta.dir.remove_block(block_id)
+                meta.dir.release(meta.length)
+                self.pinned_blocks.discard(block_id)
+                self.master_pinned_blocks.discard(block_id)
+            if os.path.exists(meta.path):
+                os.remove(meta.path)
+        finally:
+            lock.close()
+        self.annotator.on_remove(block_id)
+        self._emit("removed", block_id)
+
+    def move_block(self, block_id: int, dst_tier_alias: str) -> BlockMeta:
+        """Move a committed block to another tier (promote/demote)."""
+        lock = self._locks.lock_write(block_id, 5.0)
+        if lock is None:
+            raise InvalidArgumentError(f"block {block_id} is busy")
+        try:
+            with self._alloc_lock:
+                meta = self.meta.get_block(block_id)
+                if meta is None:
+                    raise BlockDoesNotExistError(f"block {block_id} not cached")
+                if meta.tier_alias == dst_tier_alias:
+                    return meta
+                dst = self._allocate_with_eviction(meta.length, dst_tier_alias)
+                new_meta = BlockMeta(block_id=block_id, length=meta.length,
+                                     dir=dst)
+                dst.reserve(meta.length)
+                os.replace(meta.path, new_meta.path)
+                meta.dir.remove_block(block_id)
+                meta.dir.release(meta.length)
+                dst.add_block(new_meta)
+            self._emit("moved", block_id)
+            return new_meta
+        finally:
+            lock.close()
+
+    # -- eviction -----------------------------------------------------------
+    def _allocate_with_eviction(self, size: int, tier_alias: str) -> StorageDir:
+        d = self._allocator.allocate(size, tier_alias)
+        for _ in range(self._eviction_retries):
+            if d is not None:
+                return d
+            freed = self._free_space_on_tier(size, tier_alias)
+            d = self._allocator.allocate(size, tier_alias)
+            if freed == 0 and d is None:
+                break
+        if d is None:
+            raise WorkerOutOfSpaceError(
+                f"cannot allocate {size}B on tier {tier_alias or 'ANY'}")
+        return d
+
+    def _free_space_on_tier(self, size: int, tier_alias: str) -> int:
+        tiers = self.meta.tiers if tier_alias == ANY_TIER else \
+            [self.meta.get_tier(tier_alias)]
+        freed = 0
+        for tier in tiers:
+            for d in tier.dirs:
+                freed += self._free_space_in_dir(d, size)
+                if freed >= size:
+                    return freed
+        return freed
+
+    def _free_space_in_dir(self, d: StorageDir, need: int) -> int:
+        """Evict coldest blocks from one dir; demote to the tier below when
+        it has room, else drop (re-fetchable cache by design)."""
+        victims = self.annotator.sorted_blocks(d.block_ids())
+        freed = 0
+        below = self.meta.tier_below(d.tier.alias)
+        for bid in victims:
+            if freed >= need:
+                break
+            if bid in self.pinned_blocks or bid in self.master_pinned_blocks:
+                continue
+            lock = self._locks.try_lock_write(bid)
+            if lock is None:
+                continue  # in use by a reader; skip (reference retries)
+            try:
+                meta = d.get_block(bid)
+                if meta is None:
+                    continue
+                demoted = False
+                if below is not None:
+                    for dst in below.dirs:
+                        if dst.available_bytes >= meta.length and \
+                                dst.reserve(meta.length):
+                            new_meta = BlockMeta(block_id=bid,
+                                                 length=meta.length, dir=dst)
+                            os.replace(meta.path, new_meta.path)
+                            dst.add_block(new_meta)
+                            demoted = True
+                            break
+                if not demoted and os.path.exists(meta.path):
+                    os.remove(meta.path)
+                d.remove_block(bid)
+                d.release(meta.length)
+                freed += meta.length
+                if not demoted:
+                    self.annotator.on_remove(bid)
+                    self._emit("evicted", bid)
+                    self._m.counter("Worker.BlocksEvicted").inc()
+                else:
+                    self._emit("moved", bid)
+            finally:
+                lock.close()
+        return freed
+
+    def free_space(self, tier_alias: str, bytes_to_free: int) -> int:
+        """Explicit free (Free command from master / watermark restore)."""
+        with self._alloc_lock:
+            return self._free_space_on_tier(bytes_to_free, tier_alias)
+
+    # -- reporting ----------------------------------------------------------
+    def block_report(self) -> Dict[str, List[int]]:
+        return self.meta.blocks_on_tiers()
+
+    def store_meta(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        return self.meta.capacity_on_tiers(), self.meta.used_on_tiers()

@@ -1,0 +1,142 @@
+"""Worker-side UFS block IO: cold reads with concurrent caching.
+
+Re-design of ``core/server/worker/.../block/{UnderFileSystemBlockStore.java,
+UnderFileSystemBlockReader.java:50}`` + the async cache manager
+(``worker/block/AsyncCacheRequestManager.java:52,88``): when a client reads
+a block that is not cached, the worker streams it from the UFS at the block
+offset and *concurrently* writes it into the local top tier, so the next
+reader is warm. ``AsyncCacheManager`` executes client-issued cache requests
+off the read path (passive caching).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from alluxio_tpu.underfs.base import UnderFileSystem
+from alluxio_tpu.utils import ids as id_utils
+from alluxio_tpu.utils.exceptions import AlreadyExistsError
+from alluxio_tpu.worker.tiered_store import TieredBlockStore
+
+LOG = logging.getLogger(__name__)
+
+_CHUNK = 4 << 20
+
+
+@dataclass
+class UfsBlockDescriptor:
+    """Where a block lives in its UFS file."""
+
+    block_id: int
+    ufs_path: str
+    offset: int
+    length: int
+    mount_id: int = 0
+
+
+class UfsBlockReader:
+    """Read-through: serve from UFS while caching into the local store."""
+
+    def __init__(self, store: TieredBlockStore) -> None:
+        self._store = store
+
+    def read_block(self, ufs: UnderFileSystem, desc: UfsBlockDescriptor, *,
+                   cache: bool = True, tier_alias: str = "") -> bytes:
+        """Fetch the whole block (the TPU read path wants whole pages into
+        a staging buffer, not tiny chunks)."""
+        data = ufs.read_range(desc.ufs_path, desc.offset, desc.length)
+        if cache:
+            self.cache_block(desc.block_id, data, tier_alias)
+        return data
+
+    def cache_block(self, block_id: int, data: bytes,
+                    tier_alias: str = "") -> bool:
+        session = id_utils.create_session_id()
+        try:
+            self._store.create_block(session, block_id,
+                                     initial_bytes=len(data),
+                                     tier_alias=tier_alias)
+        except AlreadyExistsError:
+            return False
+        except Exception:  # noqa: BLE001 - cache fill is best-effort
+            LOG.debug("cache fill for block %s failed", block_id, exc_info=True)
+            return False
+        try:
+            with self._store.get_temp_writer(session, block_id) as w:
+                w.append(data)
+            self._store.commit_block(session, block_id)
+            return True
+        except Exception:  # noqa: BLE001
+            try:
+                self._store.abort_block(session, block_id)
+            except Exception:  # noqa: BLE001
+                pass
+            return False
+
+
+class AsyncCacheManager:
+    """Executes passive-cache requests off the read path
+    (reference: ``AsyncCacheRequestManager.java:88``). A client that read a
+    block remotely (or straight from UFS) asks its local worker to cache it
+    in the background."""
+
+    def __init__(self, store: TieredBlockStore,
+                 ufs_resolver: Callable[[int], UnderFileSystem],
+                 num_threads: int = 1) -> None:
+        self._store = store
+        self._reader = UfsBlockReader(store)
+        self._ufs_resolver = ufs_resolver
+        self._queue: "queue.Queue[Optional[UfsBlockDescriptor]]" = queue.Queue()
+        self._inflight: Dict[int, bool] = {}
+        self._lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._run, daemon=True,
+                                          name=f"async-cache-{i}")
+                         for i in range(num_threads)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, desc: UfsBlockDescriptor) -> bool:
+        with self._lock:
+            if desc.block_id in self._inflight or \
+                    self._store.has_block(desc.block_id):
+                return False
+            self._inflight[desc.block_id] = True
+        self._queue.put(desc)
+        return True
+
+    def _run(self) -> None:
+        while True:
+            desc = self._queue.get()
+            if desc is None:
+                return
+            try:
+                ufs = self._ufs_resolver(desc.mount_id)
+                self._reader.read_block(ufs, desc, cache=True)
+            except Exception:  # noqa: BLE001
+                LOG.debug("async cache of block %s failed", desc.block_id,
+                          exc_info=True)
+            finally:
+                with self._lock:
+                    self._inflight.pop(desc.block_id, None)
+                self._queue.task_done()
+
+    def wait_idle(self, timeout_s: float = 10.0) -> bool:
+        """Block until the queue drains or the deadline passes; returns
+        True if idle."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._queue.all_tasks_done:
+                if self._queue.unfinished_tasks == 0:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        for _ in self._threads:
+            self._queue.put(None)

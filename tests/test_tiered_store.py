@@ -1,0 +1,222 @@
+"""Tiered block store tests: lifecycle, eviction, annotators, management.
+
+Reference analogues: ``core/server/worker/src/test/java/alluxio/worker/block/
+TieredBlockStoreTest.java``, ``allocator/*Test``, ``annotator/*Test``,
+``tests/.../server/tieredstore``.
+"""
+
+import os
+import threading
+
+import pytest
+
+from alluxio_tpu.utils.exceptions import (
+    AlreadyExistsError, BlockDoesNotExistError, WorkerOutOfSpaceError,
+)
+from alluxio_tpu.worker.allocator import Allocator
+from alluxio_tpu.worker.annotator import BlockAnnotator, LRFUAnnotator
+from alluxio_tpu.worker.management import AlignTask, WatermarkRestoreTask
+from alluxio_tpu.worker.meta import BlockMetadataManager
+from alluxio_tpu.worker.tiered_store import TieredBlockStore
+
+KB = 1024
+SESSION = 7
+
+
+def make_store(tmp_path, *, mem_cap=10 * KB, ssd_cap=100 * KB,
+               allocator="MAX_FREE", annotator="LRU"):
+    meta = BlockMetadataManager()
+    mem = meta.add_tier("MEM")
+    mem.add_dir(str(tmp_path / "mem0"), mem_cap)
+    if ssd_cap:
+        ssd = meta.add_tier("SSD")
+        ssd.add_dir(str(tmp_path / "ssd0"), ssd_cap)
+    return TieredBlockStore(meta, Allocator.create(allocator, meta),
+                            BlockAnnotator.create(annotator))
+
+
+def put_block(store, block_id, data, tier=""):
+    store.create_block(SESSION, block_id, initial_bytes=len(data),
+                       tier_alias=tier)
+    with store.get_temp_writer(SESSION, block_id) as w:
+        w.append(data)
+    return store.commit_block(SESSION, block_id)
+
+
+class TestLifecycle:
+    def test_create_write_commit_read(self, tmp_path):
+        store = make_store(tmp_path)
+        meta = put_block(store, 1, b"hello world", tier="MEM")
+        assert meta.length == 11
+        assert meta.tier_alias == "MEM"
+        with store.get_reader(1) as r:
+            assert r.read(0, 5) == b"hello"
+            assert r.read(6, 5) == b"world"
+        assert store.meta.get_tier("MEM").used_bytes == 11
+
+    def test_double_create_rejected(self, tmp_path):
+        store = make_store(tmp_path)
+        put_block(store, 1, b"x")
+        with pytest.raises(AlreadyExistsError):
+            store.create_block(SESSION, 1, initial_bytes=1)
+
+    def test_abort_releases_space(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=KB, ssd_cap=0)
+        store.create_block(SESSION, 1, initial_bytes=KB)
+        store.abort_block(SESSION, 1)
+        assert store.meta.get_tier("MEM").used_bytes == 0
+        store.create_block(SESSION, 2, initial_bytes=KB)  # space back
+
+    def test_commit_reconciles_reservation(self, tmp_path):
+        store = make_store(tmp_path)
+        store.create_block(SESSION, 1, initial_bytes=1000)
+        with store.get_temp_writer(SESSION, 1) as w:
+            w.append(b"tiny")
+        store.commit_block(SESSION, 1)
+        assert store.meta.get_tier("MEM").used_bytes == 4
+
+    def test_writer_grows_reservation(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=10 * KB)
+        store.create_block(SESSION, 1, initial_bytes=KB)
+        with store.get_temp_writer(SESSION, 1) as w:
+            w.append(b"a" * (2 * KB))  # beyond initial reservation
+        meta = store.commit_block(SESSION, 1)
+        assert meta.length == 2 * KB
+
+    def test_session_cleanup(self, tmp_path):
+        store = make_store(tmp_path)
+        store.create_block(SESSION, 1, initial_bytes=KB)
+        store.create_block(SESSION + 1, 2, initial_bytes=KB)
+        store.cleanup_session(SESSION)
+        with pytest.raises(BlockDoesNotExistError):
+            store.get_temp_writer(SESSION, 1)
+        store.get_temp_writer(SESSION + 1, 2)  # other session untouched
+
+    def test_remove_block(self, tmp_path):
+        store = make_store(tmp_path)
+        meta = put_block(store, 1, b"data")
+        path = meta.path
+        store.remove_block(1)
+        assert not os.path.exists(path)
+        with pytest.raises(BlockDoesNotExistError):
+            store.get_reader(1)
+
+
+class TestEviction:
+    def test_lru_eviction_on_allocation(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=3 * KB, ssd_cap=0)
+        for i in range(3):
+            put_block(store, i, bytes([i]) * KB, tier="MEM")
+        store.get_reader(0).close()  # block 0 most recent; 1 is LRU
+        put_block(store, 99, b"n" * KB, tier="MEM")
+        cached = set(store.block_report()["MEM"])
+        assert 99 in cached and 0 in cached
+        assert 1 not in cached  # LRU victim
+
+    def test_eviction_demotes_to_lower_tier(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=100 * KB)
+        put_block(store, 1, b"a" * KB, tier="MEM")
+        put_block(store, 2, b"b" * KB, tier="MEM")
+        put_block(store, 3, b"c" * KB, tier="MEM")  # evicts 1 downward
+        report = store.block_report()
+        assert 1 in report["SSD"]
+        assert 3 in report["MEM"]
+        with store.get_reader(1) as r:  # still readable after demotion
+            assert r.read(0, 1) == b"a"
+
+    def test_pinned_blocks_skip_eviction(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=0)
+        put_block(store, 1, b"a" * KB, tier="MEM")
+        put_block(store, 2, b"b" * KB, tier="MEM")
+        store.pinned_blocks = {1, 2}
+        with pytest.raises(WorkerOutOfSpaceError):
+            put_block(store, 3, b"c" * KB, tier="MEM")
+
+    def test_blocks_being_read_not_evicted(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=0)
+        put_block(store, 1, b"a" * KB, tier="MEM")
+        put_block(store, 2, b"b" * KB, tier="MEM")
+        r1 = store.get_reader(1)  # hold read locks on both
+        r2 = store.get_reader(2)
+        with pytest.raises(WorkerOutOfSpaceError):
+            put_block(store, 3, b"c" * KB, tier="MEM")
+        r1.close()
+        r2.close()
+        put_block(store, 4, b"d" * KB, tier="MEM")  # now evictable
+        assert 4 in store.block_report()["MEM"]
+
+    def test_oversize_allocation_fails(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=KB, ssd_cap=0)
+        with pytest.raises(WorkerOutOfSpaceError):
+            store.create_block(SESSION, 1, initial_bytes=10 * KB,
+                               tier_alias="MEM")
+
+
+class TestAllocators:
+    def test_max_free_prefers_emptier_dir(self, tmp_path):
+        meta = BlockMetadataManager()
+        mem = meta.add_tier("MEM")
+        d0 = mem.add_dir(str(tmp_path / "d0"), 10 * KB)
+        d1 = mem.add_dir(str(tmp_path / "d1"), 10 * KB)
+        d0.reserve(5 * KB)
+        alloc = Allocator.create("MAX_FREE", meta)
+        assert alloc.allocate(KB, "MEM") is d1
+
+    def test_round_robin_rotates(self, tmp_path):
+        meta = BlockMetadataManager()
+        mem = meta.add_tier("MEM")
+        dirs = [mem.add_dir(str(tmp_path / f"d{i}"), 10 * KB) for i in range(3)]
+        alloc = Allocator.create("ROUND_ROBIN", meta)
+        picks = [alloc.allocate(KB, "MEM") for _ in range(3)]
+        assert picks == dirs
+
+    def test_greedy_tops_down(self, tmp_path):
+        store_meta = BlockMetadataManager()
+        mem = store_meta.add_tier("MEM")
+        mem.add_dir(str(tmp_path / "m"), KB)
+        ssd = store_meta.add_tier("SSD")
+        ssd.add_dir(str(tmp_path / "s"), 100 * KB)
+        alloc = Allocator.create("GREEDY", store_meta)
+        assert alloc.allocate(10 * KB).tier.alias == "SSD"
+
+
+class TestAnnotators:
+    def test_lru_order(self):
+        ann = BlockAnnotator.create("LRU")
+        for b in (1, 2, 3):
+            ann.on_access(b)
+        ann.on_access(1)
+        assert ann.sorted_blocks([1, 2, 3]) == [2, 3, 1]
+
+    def test_lrfu_frequency_beats_single_recency(self):
+        ann = LRFUAnnotator(step_factor=0.25, attenuation_factor=2.0)
+        for _ in range(5):
+            ann.on_access(1)  # hot block
+        ann.on_access(2)  # touched once, most recently
+        order = ann.sorted_blocks([1, 2])
+        assert order == [2, 1]  # 2 evicted first despite recency
+
+    def test_unknown_blocks_coldest(self):
+        ann = BlockAnnotator.create("LRU")
+        ann.on_access(1)
+        assert ann.sorted_blocks([1, 42]) == [42, 1]
+
+
+class TestManagement:
+    def test_align_swaps_out_of_order_blocks(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=KB, ssd_cap=100 * KB)
+        put_block(store, 1, b"a" * KB, tier="MEM")
+        put_block(store, 2, b"b" * KB, tier="SSD")
+        for _ in range(3):
+            store.access_block(2)  # SSD block is hotter
+        AlignTask(store).run()
+        report = store.block_report()
+        assert 2 in report["MEM"] and 1 in report["SSD"]
+
+    def test_watermark_restore_frees_to_low(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=10 * KB, ssd_cap=0)
+        for i in range(10):
+            put_block(store, i, bytes([i]) * KB, tier="MEM")
+        WatermarkRestoreTask(store, high=0.95, low=0.5).run()
+        used = store.meta.get_tier("MEM").used_bytes
+        assert used <= 5 * KB

@@ -1,0 +1,6 @@
+"""Filesystem client (reference: ``core/client``)."""
+
+from alluxio_tpu.client.file_system import FileSystem  # noqa: F401
+from alluxio_tpu.client.streams import (  # noqa: F401
+    FileInStream, FileOutStream, ReadType, WriteType,
+)

@@ -1,0 +1,172 @@
+"""Client-side block store: source selection + stream construction.
+
+Re-design of ``core/client/fs/src/main/java/alluxio/client/block/
+AlluxioBlockStore.java:63`` + the ladder in ``stream/BlockInStream.java:80-124``,
+including the **passive cache trigger** (``AlluxioFileInStream.java:137``
+triggerAsyncCaching): when a read was served remotely or from UFS, ask the
+nearest local worker to cache the block in the background.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from alluxio_tpu.client.block_streams import (
+    BlockInStream, BlockOutStream, GrpcBlockInStream, GrpcBlockOutStream,
+    LocalBlockInStream, LocalBlockOutStream, is_local_worker,
+)
+from alluxio_tpu.client.policy import BlockLocationPolicy
+from alluxio_tpu.rpc.clients import BlockMasterClient, WorkerClient
+from alluxio_tpu.utils import ids as id_utils
+from alluxio_tpu.utils.exceptions import UnavailableError
+from alluxio_tpu.utils.wire import (
+    BlockInfo, FileBlockInfo, FileInfo, TieredIdentity, WorkerInfo,
+    WorkerNetAddress,
+)
+
+
+class BlockStoreClient:
+    def __init__(self, block_master: BlockMasterClient, *,
+                 identity: Optional[TieredIdentity] = None,
+                 read_policy: Optional[BlockLocationPolicy] = None,
+                 write_policy: Optional[BlockLocationPolicy] = None,
+                 ufs_read_policy: Optional[BlockLocationPolicy] = None,
+                 short_circuit: bool = True,
+                 passive_cache: bool = True) -> None:
+        self._bm = block_master
+        self._identity = identity or TieredIdentity.from_spec(
+            None, hostname=socket.gethostname())
+        self._read_policy = read_policy or BlockLocationPolicy.create(
+            "LOCAL_FIRST", identity=self._identity)
+        self._write_policy = write_policy or BlockLocationPolicy.create(
+            "LOCAL_FIRST", identity=self._identity)
+        self._ufs_read_policy = ufs_read_policy or BlockLocationPolicy.create(
+            "DETERMINISTIC_HASH", shards=1)
+        self._short_circuit = short_circuit
+        self._passive_cache = passive_cache
+        self.session_id = id_utils.create_session_id()
+        #: worker that served the most recent write (sync-persist targets it;
+        #: LOCAL_FIRST keeps one file's blocks on one worker)
+        self.last_write_worker: Optional[WorkerClient] = None
+        self._workers: Dict[str, WorkerClient] = {}
+        self._lock = threading.Lock()
+        #: workers that recently failed reads (reference:
+        #: AlluxioFileInStream failed-worker memory, :94-95)
+        self._failed_workers: Dict[str, float] = {}
+
+    # -- worker client cache -------------------------------------------------
+    def worker_client(self, address: WorkerNetAddress) -> WorkerClient:
+        key = f"{address.host}:{address.data_port or address.rpc_port}"
+        with self._lock:
+            c = self._workers.get(key)
+            if c is None:
+                c = WorkerClient(key)
+                self._workers[key] = c
+            return c
+
+    def _live_workers(self) -> List[WorkerInfo]:
+        return [w for w in self._bm.get_worker_infos()
+                if w.address.key() not in self._failed_workers]
+
+    def mark_failed(self, address: WorkerNetAddress) -> None:
+        import time
+
+        self._failed_workers[address.key()] = time.monotonic()
+
+    # -- read ladder ---------------------------------------------------------
+    def open_block(self, fbi: FileBlockInfo, *,
+                   ufs_info: Optional[dict] = None,
+                   cache_cold_reads: bool = True) -> BlockInStream:
+        """Build the best stream for one block
+        (reference: ``BlockInStream.create``, ``BlockInStream.java:97``)."""
+        info = fbi.block_info
+        local_hostname = socket.gethostname()
+        # 1) short-circuit a same-host cached copy
+        if self._short_circuit:
+            for loc in info.locations:
+                if is_local_worker(loc.address, local_hostname):
+                    try:
+                        return LocalBlockInStream(
+                            self.worker_client(loc.address), self.session_id,
+                            info.block_id)
+                    except Exception:  # noqa: BLE001 - fall through ladder
+                        pass
+        # 2) remote cached copy, nearest first; the UFS descriptor rides
+        # along so a stale location (block evicted since the master's last
+        # heartbeat) self-heals server-side via read-through
+        if info.locations:
+            addrs = [l.address for l in info.locations
+                     if l.address.key() not in self._failed_workers]
+            if addrs:
+                idx = self._identity.nearest(
+                    [a.tiered_identity for a in addrs])
+                address = addrs[idx if idx is not None else 0]
+                stream = GrpcBlockInStream(
+                    self.worker_client(address), info.block_id, info.length,
+                    ufs=ufs_info, cache=cache_cold_reads)
+                self._maybe_passive_cache(info, ufs_info)
+                return stream
+        # 3) UFS fallback through a policy-chosen worker (caches read-through)
+        if ufs_info is None:
+            raise UnavailableError(
+                f"block {info.block_id} has no cached copy and no UFS source")
+        workers = self._live_workers()
+        address = self._ufs_read_policy.pick(workers, block_id=info.block_id,
+                                             block_size=info.length)
+        if address is None:
+            raise UnavailableError("no live workers for UFS read")
+        return GrpcBlockInStream(self.worker_client(address), info.block_id,
+                                 info.length, ufs=ufs_info,
+                                 cache=cache_cold_reads)
+
+    def _maybe_passive_cache(self, info: BlockInfo,
+                             ufs_info: Optional[dict]) -> None:
+        """Reading remotely: ask a local worker to cache a copy
+        (reference: AsyncCache RPC, ``AlluxioFileInStream.java:137``)."""
+        if not self._passive_cache or ufs_info is None:
+            return
+        local_hostname = socket.gethostname()
+        for w in self._live_workers():
+            if is_local_worker(w.address, local_hostname) and not any(
+                    loc.address.key() == w.address.key()
+                    for loc in info.locations):
+                try:
+                    self.worker_client(w.address).async_cache(
+                        info.block_id, ufs_info["ufs_path"],
+                        ufs_info["offset"], ufs_info["length"],
+                        ufs_info.get("mount_id", 0))
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+                return
+
+    # -- write ---------------------------------------------------------------
+    def open_block_writer(self, block_id: int, *, size_hint: int,
+                          tier: str = "", pinned: bool = False
+                          ) -> BlockOutStream:
+        workers = self._live_workers()
+        address = self._write_policy.pick(workers, block_id=block_id,
+                                          block_size=size_hint)
+        if address is None:
+            raise UnavailableError("no live workers to write to")
+        client = self.worker_client(address)
+        self.last_write_worker = client
+        if self._short_circuit and is_local_worker(address,
+                                                   socket.gethostname()):
+            try:
+                return LocalBlockOutStream(client, self.session_id, block_id,
+                                           size_hint=size_hint, tier=tier,
+                                           pinned=pinned)
+            except Exception:  # noqa: BLE001
+                pass
+        return GrpcBlockOutStream(client, self.session_id, block_id,
+                                  tier=tier, pinned=pinned)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        for c in self._workers.values():
+            try:
+                c.cleanup_session(self.session_id)
+            except Exception:  # noqa: BLE001
+                pass

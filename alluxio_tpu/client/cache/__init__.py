@@ -1,0 +1,8 @@
+"""Client-embedded page cache with an HBM top tier
+(reference: ``core/client/fs/.../cache``; HBM tier is TPU-native)."""
+
+from alluxio_tpu.client.cache.manager import LocalCacheManager  # noqa: F401
+from alluxio_tpu.client.cache.meta import PageId, PageInfo  # noqa: F401
+from alluxio_tpu.client.cache.page_store import (  # noqa: F401
+    LocalPageStore, MemPageStore,
+)

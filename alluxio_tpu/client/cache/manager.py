@@ -1,0 +1,165 @@
+"""LocalCacheManager: the client-embedded page cache.
+
+Re-design of ``core/client/fs/src/main/java/alluxio/client/file/cache/
+{CacheManager.java:82,LocalCacheManager.java:75}`` with the TPU twist: an
+optional **HBM tier above the host tier**. Layout:
+
+    HBM (jax.Array pages, pin-leased)   <- get_device() hits
+    HOST/DISK (LocalPageStore | MemPageStore, LRU/LFU evicted)
+
+``put`` lands pages in the host store; ``get_device`` promotes a host page
+into HBM on access (clock-like warm-up) and serves device-resident arrays
+on repeat access — the second epoch of a training run never touches host
+memory for warm pages.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from alluxio_tpu.client.cache.evictor import CacheEvictor
+from alluxio_tpu.client.cache.hbm_store import DevicePageLease, HbmPageStore
+from alluxio_tpu.client.cache.meta import PageId, PageInfo, PageMetaStore
+from alluxio_tpu.client.cache.page_store import (
+    LocalPageStore, MemPageStore, PageStore,
+)
+from alluxio_tpu.metrics import metrics
+
+
+class LocalCacheManager:
+    def __init__(self, store: PageStore, *, capacity_bytes: int,
+                 page_size: int = 1 << 20,
+                 evictor: Optional[CacheEvictor] = None,
+                 hbm_store: Optional[HbmPageStore] = None) -> None:
+        self._store = store
+        self._capacity = capacity_bytes
+        self.page_size = page_size
+        self._evictor = evictor or CacheEvictor.create("LRU")
+        self._meta = PageMetaStore()
+        self._hbm = hbm_store
+        self._lock = threading.RLock()
+        self._m = metrics()
+
+    @staticmethod
+    def from_conf(conf) -> "LocalCacheManager":
+        from alluxio_tpu.conf import Keys
+
+        store = LocalPageStore(conf.get(Keys.USER_CLIENT_CACHE_DIR))
+        hbm_bytes = conf.get_bytes(Keys.USER_CLIENT_CACHE_HBM_SIZE)
+        hbm = HbmPageStore(hbm_bytes) if hbm_bytes > 0 else None
+        return LocalCacheManager(
+            store, capacity_bytes=conf.get_bytes(Keys.USER_CLIENT_CACHE_SIZE),
+            page_size=conf.get_bytes(Keys.USER_CLIENT_CACHE_PAGE_SIZE),
+            evictor=CacheEvictor.create(conf.get(Keys.USER_CLIENT_CACHE_EVICTOR)),
+            hbm_store=hbm)
+
+    # -- host-tier put/get ---------------------------------------------------
+    def put(self, page_id: PageId, data: bytes) -> bool:
+        with self._lock:
+            if self._meta.has(page_id):
+                return True
+            while self._meta.bytes_in_tier("HOST") + len(data) > self._capacity:
+                victim = self._evictor.evict()
+                if victim is None:
+                    return False
+                self._delete_host(victim)
+            self._store.put(page_id, data)
+            self._meta.add(PageInfo(page_id, len(data), tier="HOST"))
+            self._evictor.update_on_put(page_id)
+            self._m.counter("Client.PagesCached").inc()
+            return True
+
+    def get(self, page_id: PageId, offset: int = 0,
+            length: int = -1) -> Optional[bytes]:
+        with self._lock:
+            if not self._meta.has(page_id):
+                self._m.counter("Client.PageCacheMisses").inc()
+                return None
+        data = self._store.get(page_id, offset, length)
+        if data is None:  # store lost it (restart, purge)
+            with self._lock:
+                self._meta.remove(page_id)
+                self._evictor.update_on_delete(page_id)
+            self._m.counter("Client.PageCacheMisses").inc()
+            return None
+        self._evictor.update_on_get(page_id)
+        self._m.counter("Client.PageCacheHits").inc()
+        return data
+
+    def has(self, page_id: PageId) -> bool:
+        return self._meta.has(page_id)
+
+    def _delete_host(self, page_id: PageId) -> None:
+        self._store.delete(page_id)
+        self._meta.remove(page_id)
+        self._evictor.update_on_delete(page_id)
+        self._m.counter("Client.PagesEvicted").inc()
+
+    def delete(self, page_id: PageId) -> bool:
+        with self._lock:
+            existed = self._meta.has(page_id)
+            if existed:
+                self._delete_host(page_id)
+        if self._hbm is not None:
+            self._hbm.delete(page_id)
+        return existed
+
+    def delete_file(self, file_id: str) -> int:
+        n = 0
+        for pid in list(self._meta.pages_of_file(file_id)):
+            if self.delete(pid):
+                n += 1
+        return n
+
+    # -- HBM tier ------------------------------------------------------------
+    @property
+    def hbm(self) -> Optional[HbmPageStore]:
+        return self._hbm
+
+    def get_device(self, page_id: PageId,
+                   host_fallback=None) -> Optional[DevicePageLease]:
+        """Device-resident get: HBM hit returns the jax.Array lease; on
+        miss, promote from the host tier (or ``host_fallback()`` bytes)
+        into HBM, then serve. None if the page is nowhere."""
+        if self._hbm is None:
+            return None
+        lease = self._hbm.get(page_id)
+        if lease is not None:
+            self._m.counter("Client.HbmPageHits").inc()
+            return lease
+        data = self.get(page_id)
+        if data is None and host_fallback is not None:
+            data = host_fallback()
+            if data is not None:
+                self.put(page_id, data)
+        if data is None:
+            return None
+        self._m.counter("Client.HbmPagePromotions").inc()
+        if self._hbm.put(page_id, data):
+            return self._hbm.get(page_id)
+        return None
+
+    # -- maintenance ---------------------------------------------------------
+    def restore(self) -> int:
+        """Re-adopt pages an earlier process left in a LocalPageStore."""
+        n = 0
+        if isinstance(self._store, LocalPageStore):
+            for pid, size in self._store.restore_pages():
+                self._meta.add(PageInfo(pid, size, tier="HOST"))
+                self._evictor.update_on_put(pid)
+                n += 1
+        return n
+
+    def stats(self) -> dict:
+        return {
+            "pages": len(self._meta),
+            "host_bytes": self._meta.bytes_in_tier("HOST"),
+            "hbm_bytes": self._hbm.used_bytes if self._hbm else 0,
+            "hbm_pinned": self._hbm.pinned_count() if self._hbm else 0,
+        }
+
+    def close(self) -> None:
+        self._store.close()
+        if self._hbm is not None:
+            self._hbm.close()

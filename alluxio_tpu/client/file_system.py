@@ -1,0 +1,225 @@
+"""Public FileSystem client API.
+
+Re-design of ``core/client/fs/src/main/java/alluxio/client/file/
+{FileSystem.java:79,BaseFileSystem.java:92,FileSystemContext.java:91}``:
+one facade over the master clients + block store, with an optional
+client-side metadata cache (``MetadataCachingBaseFileSystem``) and the
+config-hash live-reinit handshake (``FileSystemContextReinitializer.java:44``).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, List, Optional
+
+from alluxio_tpu.client.block_store import BlockStoreClient
+from alluxio_tpu.client.policy import BlockLocationPolicy
+from alluxio_tpu.client.streams import FileInStream, FileOutStream, WriteType
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.rpc.clients import (
+    BlockMasterClient, FsMasterClient, MetaMasterClient,
+)
+from alluxio_tpu.utils.uri import AlluxioURI
+from alluxio_tpu.utils.wire import FileInfo, MountPointInfo, TieredIdentity
+
+
+class _MetadataCache:
+    """Path -> (FileInfo, expiry) cache
+    (reference: ``client/file/MetadataCache.java``)."""
+
+    def __init__(self, max_size: int, ttl_s: float) -> None:
+        self._max = max_size
+        self._ttl = ttl_s
+        self._entries: Dict[str, tuple] = {}
+
+    def get(self, path: str) -> Optional[FileInfo]:
+        e = self._entries.get(path)
+        if e is None:
+            return None
+        info, expiry = e
+        if time.monotonic() > expiry:
+            del self._entries[path]
+            return None
+        return info
+
+    def put(self, path: str, info: FileInfo) -> None:
+        if len(self._entries) >= self._max:
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[path] = (info, time.monotonic() + self._ttl)
+
+    def invalidate(self, path: str) -> None:
+        self._entries.pop(path, None)
+        parent = AlluxioURI(path).parent()
+        if parent is not None:
+            self._entries.pop(parent.path, None)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+class FileSystem:
+    """The user-facing client (reference: ``FileSystem.Factory.create``)."""
+
+    def __init__(self, master_address: str,
+                 conf: Optional[Configuration] = None) -> None:
+        self._conf = conf or Configuration()
+        self.fs_master = FsMasterClient(master_address)
+        self.block_master = BlockMasterClient(master_address)
+        self.meta_master = MetaMasterClient(master_address)
+        identity = TieredIdentity.from_spec(
+            self._conf.get(Keys.TIERED_IDENTITY),
+            hostname=socket.gethostname())
+        self.store = BlockStoreClient(
+            self.block_master, identity=identity,
+            read_policy=BlockLocationPolicy.create(
+                self._conf.get(Keys.USER_BLOCK_READ_POLICY),
+                identity=identity),
+            write_policy=BlockLocationPolicy.create(
+                self._conf.get(Keys.USER_BLOCK_WRITE_POLICY),
+                identity=identity),
+            short_circuit=self._conf.get_bool(Keys.USER_SHORT_CIRCUIT_ENABLED),
+            passive_cache=self._conf.get_bool(
+                Keys.USER_FILE_PASSIVE_CACHE_ENABLED))
+        md_cache_size = self._conf.get_int(Keys.USER_METADATA_CACHE_MAX_SIZE)
+        self._md_cache = _MetadataCache(
+            md_cache_size,
+            self._conf.get_duration_s(Keys.USER_METADATA_CACHE_EXPIRATION_TIME)
+        ) if md_cache_size > 0 else None
+        self._sync_interval_ms = int(1000 * self._conf.get_duration_s(
+            Keys.USER_FILE_METADATA_SYNC_INTERVAL))
+        self._config_hash: Optional[str] = None
+        self._page_cache = None
+        if self._conf.get_bool(Keys.USER_CLIENT_CACHE_ENABLED):
+            from alluxio_tpu.client.cache.manager import LocalCacheManager
+
+            self._page_cache = LocalCacheManager.from_conf(self._conf)
+
+    # ------------------------------------------------------------- metadata
+    def get_status(self, path: "str | AlluxioURI") -> FileInfo:
+        p = AlluxioURI(path).path
+        if self._md_cache is not None:
+            hit = self._md_cache.get(p)
+            if hit is not None:
+                return hit
+        info = self.fs_master.get_status(
+            p, sync_interval_ms=self._sync_interval_ms)
+        if self._md_cache is not None:
+            self._md_cache.put(p, info)
+        return info
+
+    def exists(self, path: "str | AlluxioURI") -> bool:
+        return self.fs_master.exists(AlluxioURI(path).path)
+
+    def list_status(self, path: "str | AlluxioURI",
+                    recursive: bool = False) -> List[FileInfo]:
+        return self.fs_master.list_status(
+            AlluxioURI(path).path, recursive=recursive,
+            sync_interval_ms=self._sync_interval_ms)
+
+    def create_directory(self, path: "str | AlluxioURI", **opts) -> FileInfo:
+        self._invalidate(path)
+        return self.fs_master.create_directory(AlluxioURI(path).path, **opts)
+
+    def delete(self, path: "str | AlluxioURI", recursive: bool = False,
+               alluxio_only: bool = False) -> None:
+        self._invalidate(path)
+        self.fs_master.delete(AlluxioURI(path).path, recursive=recursive,
+                              alluxio_only=alluxio_only)
+
+    def rename(self, src: "str | AlluxioURI", dst: "str | AlluxioURI") -> None:
+        self._invalidate(src)
+        self._invalidate(dst)
+        self.fs_master.rename(AlluxioURI(src).path, AlluxioURI(dst).path)
+
+    def mount(self, path: "str | AlluxioURI", ufs_uri: str, **opts) -> None:
+        self._invalidate(path)
+        self.fs_master.mount(AlluxioURI(path).path, ufs_uri, **opts)
+
+    def unmount(self, path: "str | AlluxioURI") -> None:
+        self._invalidate(path)
+        self.fs_master.unmount(AlluxioURI(path).path)
+
+    def get_mount_points(self) -> List[MountPointInfo]:
+        return self.fs_master.get_mount_points()
+
+    def set_attribute(self, path: "str | AlluxioURI", **opts) -> None:
+        self._invalidate(path)
+        self.fs_master.set_attribute(AlluxioURI(path).path, **opts)
+
+    def free(self, path: "str | AlluxioURI", recursive: bool = False,
+             forced: bool = False) -> List[int]:
+        return self.fs_master.free(AlluxioURI(path).path,
+                                   recursive=recursive, forced=forced)
+
+    def persist(self, path: "str | AlluxioURI") -> None:
+        self.fs_master.schedule_async_persistence(AlluxioURI(path).path)
+
+    def _invalidate(self, path) -> None:
+        if self._md_cache is not None:
+            self._md_cache.invalidate(AlluxioURI(path).path)
+
+    # ----------------------------------------------------------------- data
+    def open_file(self, path: "str | AlluxioURI", *,
+                  cache: Optional[bool] = None) -> FileInStream:
+        info = self.get_status(path)
+        if info.folder:
+            from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+            raise InvalidArgumentError(f"{path} is a directory")
+        if cache is None:
+            cache = self._conf.get(Keys.USER_FILE_READ_TYPE_DEFAULT) != \
+                "NO_CACHE"
+        stream = FileInStream(self.fs_master, self.store, info, cache=cache)
+        if self._page_cache is not None:
+            from alluxio_tpu.client.cache.stream import CachingFileInStream
+
+            return CachingFileInStream(stream, self._page_cache)
+        return stream
+
+    def create_file(self, path: "str | AlluxioURI", *,
+                    write_type: Optional[str] = None,
+                    block_size_bytes: Optional[int] = None,
+                    tier: str = "", pinned: bool = False,
+                    **opts) -> FileOutStream:
+        self._invalidate(path)
+        wt = write_type or self._conf.get(Keys.USER_FILE_WRITE_TYPE_DEFAULT)
+        persist_on_complete = wt == WriteType.ASYNC_THROUGH
+        info = self.fs_master.create_file(
+            AlluxioURI(path).path, block_size_bytes=block_size_bytes,
+            persist_on_complete=persist_on_complete, **opts)
+        return FileOutStream(self.fs_master, self.store, info,
+                             write_type=wt, tier=tier, pinned=pinned)
+
+    def read_all(self, path: "str | AlluxioURI") -> bytes:
+        with self.open_file(path) as f:
+            return f.read()
+
+    def write_all(self, path: "str | AlluxioURI", data: bytes,
+                  **opts) -> None:
+        with self.create_file(path, **opts) as f:
+            f.write(data)
+
+    # -------------------------------------------------- live reconfiguration
+    def check_config_sync(self) -> bool:
+        """Config-hash handshake: pull cluster defaults when the master's
+        hash moves (reference: ``ConfigHashSync.java:36``). Returns True if
+        config was re-synced."""
+        h = self.meta_master.get_config_hash()
+        if self._config_hash is None:
+            self._config_hash = h
+            return False
+        if h != self._config_hash:
+            from alluxio_tpu.conf import Source
+
+            resp = self.meta_master.get_configuration()
+            self._conf.merge(resp["properties"], Source.CLUSTER_DEFAULT)
+            self._config_hash = resp["hash"]
+            return True
+        return False
+
+    # -------------------------------------------------------------- cleanup
+    def close(self) -> None:
+        self.store.close()
+        if self._page_cache is not None:
+            self._page_cache.close()

@@ -1,0 +1,235 @@
+"""File-level streams over per-block streams.
+
+Re-design of ``core/client/fs/src/main/java/alluxio/client/file/
+{AlluxioFileInStream.java:66,AlluxioFileOutStream.java:56}``: a seekable
+read stream that walks block streams (with failed-worker retry), and a
+write stream that allocates a new block id per block boundary and completes
+the file on close. Write types mirror the reference
+(``MUST_CACHE``/``ASYNC_THROUGH``/``CACHE_THROUGH``/``THROUGH``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from alluxio_tpu.client.block_store import BlockStoreClient
+from alluxio_tpu.client.block_streams import BlockInStream, BlockOutStream
+from alluxio_tpu.rpc.clients import FsMasterClient
+from alluxio_tpu.utils.exceptions import InvalidArgumentError
+from alluxio_tpu.utils.wire import FileBlockInfo, FileInfo
+
+
+class WriteType:
+    MUST_CACHE = "MUST_CACHE"
+    CACHE_THROUGH = "CACHE_THROUGH"
+    THROUGH = "THROUGH"
+    ASYNC_THROUGH = "ASYNC_THROUGH"
+    NONE = "NONE"
+
+
+class ReadType:
+    NO_CACHE = "NO_CACHE"
+    CACHE = "CACHE"
+    CACHE_PROMOTE = "CACHE_PROMOTE"
+
+
+class FileInStream:
+    """Seekable whole-file reader (reference: AlluxioFileInStream)."""
+
+    def __init__(self, fs_master: FsMasterClient, store: BlockStoreClient,
+                 info: FileInfo, *, cache: bool = True) -> None:
+        self._fs = fs_master
+        self._store = store
+        self.info = info
+        self._cache = cache
+        self._pos = 0
+        self._block_infos: Optional[List[FileBlockInfo]] = None
+        self._current: Optional[BlockInStream] = None
+        self._current_index = -1
+
+    # -- metadata ------------------------------------------------------------
+    @property
+    def length(self) -> int:
+        return self.info.length
+
+    def _blocks(self) -> List[FileBlockInfo]:
+        if self._block_infos is None:
+            self._block_infos = self._fs.get_file_block_info_list(
+                self.info.path)
+        return self._block_infos
+
+    def _ufs_info_for(self, index: int) -> Optional[dict]:
+        if not self.info.ufs_path or not self.info.persisted:
+            return None
+        bs = self.info.block_size_bytes
+        fbi = self._blocks()[index]
+        return {"ufs_path": self.info.ufs_path, "offset": index * bs,
+                "length": fbi.block_info.length,
+                "mount_id": self.info.mount_id}
+
+    # -- stream protocol -----------------------------------------------------
+    def seek(self, pos: int) -> None:
+        if pos < 0 or pos > self.length:
+            raise InvalidArgumentError(f"seek {pos} out of [0, {self.length}]")
+        self._pos = pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self.length - self._pos
+        out = bytearray()
+        while n > 0 and self._pos < self.length:
+            chunk = self._read_from_block(self._pos, n)
+            if not chunk:
+                break
+            out.extend(chunk)
+            self._pos += len(chunk)
+            n -= len(chunk)
+        return bytes(out)
+
+    def pread(self, offset: int, n: int) -> bytes:
+        """Positioned read without moving the cursor
+        (reference: positioned read, ``block_worker.proto:68``)."""
+        out = bytearray()
+        pos = offset
+        while n > 0 and pos < self.length:
+            chunk = self._read_from_block(pos, n)
+            if not chunk:
+                break
+            out.extend(chunk)
+            pos += len(chunk)
+            n -= len(chunk)
+        return bytes(out)
+
+    def _read_from_block(self, pos: int, n: int) -> bytes:
+        bs = self.info.block_size_bytes
+        index = pos // bs
+        offset_in_block = pos % bs
+        stream = self._block_stream(index)
+        readable = stream.length - offset_in_block
+        if readable <= 0:
+            return b""
+        return stream.pread(offset_in_block, min(n, readable))
+
+    def _block_stream(self, index: int) -> BlockInStream:
+        if index == self._current_index and self._current is not None:
+            return self._current
+        if self._current is not None:
+            self._current.close()
+            self._current = None
+        fbi = self._blocks()[index]
+        self._current = self._store.open_block(
+            fbi, ufs_info=self._ufs_info_for(index),
+            cache_cold_reads=self._cache)
+        self._current_index = index
+        return self._current
+
+    def block_stream(self, index: int) -> BlockInStream:
+        """Expose the per-block stream — the zero-copy JAX path uses this to
+        mmap whole blocks instead of byte-copy reads."""
+        return self._block_stream(index)
+
+    def close(self) -> None:
+        if self._current is not None:
+            self._current.close()
+            self._current = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class FileOutStream:
+    """Whole-file writer (reference: AlluxioFileOutStream)."""
+
+    def __init__(self, fs_master: FsMasterClient, store: BlockStoreClient,
+                 info: FileInfo, *, write_type: str = WriteType.ASYNC_THROUGH,
+                 tier: str = "", pinned: bool = False) -> None:
+        self._fs = fs_master
+        self._store = store
+        self.info = info
+        self._write_type = write_type
+        self._tier = tier
+        self._pinned = pinned
+        self._block_size = info.block_size_bytes
+        self._current: Optional[BlockOutStream] = None
+        self._current_written = 0
+        self._block_ids: List[int] = []
+        self.written = 0
+        self._closed = False
+
+    def write(self, data: bytes) -> int:
+        if self._closed:
+            raise InvalidArgumentError("stream closed")
+        view = memoryview(data)
+        while len(view) > 0:
+            if self._current is None:
+                block_id = self._fs.get_new_block_id(self.info.path)
+                self._current = self._store.open_block_writer(
+                    block_id, size_hint=self._block_size,
+                    tier=self._tier, pinned=self._pinned)
+                self._block_ids.append(block_id)
+                self._current_written = 0
+            room = self._block_size - self._current_written
+            chunk = view[:room]
+            self._current.write(bytes(chunk))
+            self._current_written += len(chunk)
+            self.written += len(chunk)
+            view = view[len(chunk):]
+            if self._current_written >= self._block_size:
+                self._current.close()
+                self._current = None
+        return len(data)
+
+    def cancel(self) -> None:
+        if self._current is not None:
+            self._current.close(cancel=True)
+            self._current = None
+        self._closed = True
+        self._fs.delete(self.info.path)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._current is not None:
+            self._current.close()
+            self._current = None
+        self._fs.complete_file(self.info.path, length=self.written)
+        if self._write_type == WriteType.ASYNC_THROUGH:
+            self._fs.schedule_async_persistence(self.info.path)
+        elif self._write_type in (WriteType.THROUGH, WriteType.CACHE_THROUGH):
+            self._persist_sync()
+            if self._write_type == WriteType.THROUGH:
+                # THROUGH keeps no cached copy (reference semantics)
+                self._fs.free(self.info.path, forced=True)
+
+    def _persist_sync(self) -> None:
+        """Synchronous persist via the worker holding the cached blocks
+        (reference: CACHE_THROUGH's UfsFileWriteHandler path; here the
+        worker-side persist executor writes the UFS file in one shot)."""
+        st = self._fs.get_status(self.info.path)
+        if not st.ufs_path:
+            return
+        worker = self._store.last_write_worker
+        if worker is None:
+            return
+        fingerprint = worker.persist_file(st.ufs_path, self._block_ids,
+                                          st.mount_id)
+        self._fs.mark_persisted(self.info.path, ufs_fingerprint=fingerprint)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None:
+            self.cancel()
+        else:
+            self.close()
+        return False
+

@@ -1,0 +1,119 @@
+"""Master process assembly.
+
+Re-design of ``core/server/master/.../{AlluxioMaster.java:35,
+AlluxioMasterProcess.java:97,156,197,300}``: journal boot -> gain primacy ->
+replay -> start masters + heartbeats -> serve RPC, with a **safe-mode
+window** after primacy during which client ops are rejected while workers
+re-register (reference: ``DefaultSafeModeManager``).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List, Optional
+
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.heartbeat import (
+    HeartbeatContext, HeartbeatExecutor, HeartbeatThread,
+)
+from alluxio_tpu.journal.system import create_journal_system
+from alluxio_tpu.master.block_master import BlockMaster
+from alluxio_tpu.master.file_master import FileSystemMaster
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.rpc.core import RpcServer
+from alluxio_tpu.rpc.master_service import (
+    block_master_service, fs_master_service, meta_master_service,
+)
+from alluxio_tpu.utils.clock import Clock, SystemClock
+
+
+class _Exec(HeartbeatExecutor):
+    def __init__(self, fn) -> None:
+        self._fn = fn
+
+    def heartbeat(self) -> None:
+        self._fn()
+
+
+class MasterProcess:
+    def __init__(self, conf: Configuration, *,
+                 clock: Optional[Clock] = None,
+                 root_ufs_uri: Optional[str] = None) -> None:
+        self._conf = conf
+        self._clock = clock or SystemClock()
+        self.journal = create_journal_system(
+            conf.get(Keys.MASTER_JOURNAL_TYPE),
+            conf.get(Keys.MASTER_JOURNAL_FOLDER),
+            max_log_size=conf.get_bytes(Keys.MASTER_JOURNAL_LOG_SIZE_BYTES_MAX),
+            checkpoint_period_entries=conf.get_int(
+                Keys.MASTER_JOURNAL_CHECKPOINT_PERIOD_ENTRIES))
+        self.block_master = BlockMaster(
+            self.journal, clock=self._clock,
+            worker_timeout_ms=conf.get_ms(Keys.MASTER_WORKER_TIMEOUT))
+        self.fs_master = FileSystemMaster(
+            self.block_master, self.journal, clock=self._clock,
+            default_block_size=conf.get_bytes(
+                Keys.USER_BLOCK_SIZE_BYTES_DEFAULT))
+        self._root_ufs_uri = root_ufs_uri or conf.get(Keys.HOME) + \
+            "/underFSStorage"
+        self.rpc_server: Optional[RpcServer] = None
+        self._threads: List[HeartbeatThread] = []
+        self.cluster_id = str(uuid.uuid4())
+        self.start_time_ms = 0
+        self._safe_mode_until = float("inf")
+        self.rpc_port: Optional[int] = None
+
+    # -- safe mode ----------------------------------------------------------
+    def in_safe_mode(self) -> bool:
+        return time.monotonic() < self._safe_mode_until
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        """Boot to serving; returns the bound RPC port."""
+        self.start_time_ms = self._clock.millis()
+        self.journal.start()
+        self.journal.gain_primacy()
+        self.fs_master.start(self._root_ufs_uri)
+        self._safe_mode_until = time.monotonic() + self._conf.get_duration_s(
+            Keys.MASTER_SAFEMODE_WAIT)
+        metrics("Master")
+        self._start_heartbeats()
+        self.rpc_server = RpcServer(
+            bind_host="0.0.0.0",
+            port=self._conf.get_int(Keys.MASTER_RPC_PORT))
+        self.rpc_server.add_service(fs_master_service(self.fs_master))
+        self.rpc_server.add_service(block_master_service(self.block_master))
+        self.rpc_server.add_service(meta_master_service(
+            self._conf, cluster_id=self.cluster_id,
+            start_time_ms=self.start_time_ms,
+            safe_mode_fn=self.in_safe_mode))
+        self.rpc_port = self.rpc_server.start()
+        return self.rpc_port
+
+    def _start_heartbeats(self) -> None:
+        conf = self._conf
+        self._threads = [
+            HeartbeatThread(
+                HeartbeatContext.MASTER_LOST_WORKER_DETECTION,
+                _Exec(self.block_master.detect_lost_workers),
+                conf.get_duration_s(Keys.MASTER_LOST_WORKER_DETECTION_INTERVAL)),
+            HeartbeatThread(
+                HeartbeatContext.MASTER_TTL_CHECK,
+                _Exec(self.fs_master.check_ttl_expired),
+                conf.get_duration_s(Keys.MASTER_TTL_CHECK_INTERVAL)),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        for t in self._threads:
+            t.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.fs_master.stop()
+        self.journal.stop()
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self.rpc_port}"

@@ -1,0 +1,3 @@
+"""Test cluster harnesses (reference: ``minicluster/``)."""
+
+from alluxio_tpu.minicluster.local_cluster import LocalCluster  # noqa: F401

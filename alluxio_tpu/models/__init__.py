@@ -1,0 +1,6 @@
+"""Flagship consumer models driven by the data plane."""
+
+from alluxio_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig, forward, images_to_tokens, init_params, loss_fn,
+    param_shardings,
+)

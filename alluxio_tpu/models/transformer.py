@@ -1,0 +1,147 @@
+"""Flagship consumer model: a compact ViT-style transformer, TPU-first.
+
+This is the model the benchmarks and graft entry drive end-to-end: the
+data plane's output (decoded image batches from cached blocks) feeds it.
+Pure-JAX parameter pytree with explicit sharding rules so the same
+forward runs single-chip or pjit-sharded over a mesh (dp over batch, tp
+over heads/MLP, sp via ring attention for long sequences).
+
+Design notes (per the TPU guide): all matmuls are bf16 einsums shaped to
+tile the MXU (model dims multiples of 128 at real sizes); no Python-level
+control flow inside jit; layers scanned where depth is large.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from alluxio_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from alluxio_tpu.parallel.ring_attention import (
+    reference_attention, ring_attention_local,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_or_patch_dim: int = 768   # input projection dim (patch bytes)
+    d_model: int = 256
+    n_heads: int = 8
+    d_ff: int = 1024
+    n_layers: int = 4
+    n_classes: int = 1000
+    max_len: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
+    keys = jax.random.split(key, 4 + cfg.n_layers)
+    scale = 0.02
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * scale).astype(cfg.dtype)
+
+    params: Dict[str, Any] = {
+        "embed": dense(keys[0], (cfg.vocab_or_patch_dim, cfg.d_model)),
+        "pos": dense(keys[1], (cfg.max_len, cfg.d_model)),
+        "head": dense(keys[2], (cfg.d_model, cfg.n_classes)),
+        "final_ln": {"scale": jnp.ones(cfg.d_model, cfg.dtype)},
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[4 + i], 6)
+        params["layers"].append({
+            "ln1": {"scale": jnp.ones(cfg.d_model, cfg.dtype)},
+            "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.d_head)),
+            "wo": dense(k[1], (cfg.n_heads, cfg.d_head, cfg.d_model)),
+            "ln2": {"scale": jnp.ones(cfg.d_model, cfg.dtype)},
+            "w1": dense(k[2], (cfg.d_model, cfg.d_ff)),
+            "w2": dense(k[3], (cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def param_shardings(cfg: TransformerConfig) -> Dict[str, Any]:
+    """PartitionSpecs: tensor-parallel over heads/FF (``model`` axis),
+    replicated elsewhere — the megatron-style split XLA turns into
+    all-reduces on ICI."""
+    layer = {
+        "ln1": {"scale": P()},
+        "wqkv": P(None, None, MODEL_AXIS, None),
+        "wo": P(MODEL_AXIS, None, None),
+        "ln2": {"scale": P()},
+        "w1": P(None, MODEL_AXIS),
+        "w2": P(MODEL_AXIS, None),
+    }
+    return {
+        "embed": P(),
+        "pos": P(),
+        "head": P(),
+        "final_ln": {"scale": P()},
+        "layers": [layer for _ in range(cfg.n_layers)],
+    }
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def _attention(x, layer, cfg: TransformerConfig, *,
+               seq_axis: Optional[str] = None):
+    qkv = jnp.einsum("btd,dshk->sbthk", x, layer["wqkv"])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    if seq_axis is not None:
+        out = ring_attention_local(q, k, v, axis_name=seq_axis, causal=False)
+    else:
+        out = reference_attention(q, k, v, causal=False)
+    return jnp.einsum("bthk,hkd->btd", out, layer["wo"])
+
+
+def _mlp(x, layer):
+    h = jnp.einsum("btd,df->btf", x, layer["w1"])
+    h = jax.nn.gelu(h)
+    return jnp.einsum("btf,fd->btd", h, layer["w2"])
+
+
+def forward(params, tokens, cfg: TransformerConfig, *,
+            seq_axis: Optional[str] = None):
+    """tokens: (B, T, vocab_or_patch_dim) float inputs (e.g. flattened
+    patches from the decode op). Returns (B, n_classes) logits."""
+    x = jnp.einsum("btp,pd->btd", tokens.astype(cfg.dtype), params["embed"])
+    t = x.shape[1]
+    x = x + params["pos"][:t][None]
+    for layer in params["layers"]:
+        x = x + _attention(_rms_norm(x, layer["ln1"]["scale"]), layer, cfg,
+                           seq_axis=seq_axis)
+        x = x + _mlp(_rms_norm(x, layer["ln2"]["scale"]), layer)
+    x = _rms_norm(x, params["final_ln"]["scale"])
+    pooled = jnp.mean(x, axis=1)
+    return jnp.einsum("bd,dc->bc", pooled, params["head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, labels, cfg: TransformerConfig, *,
+            seq_axis: Optional[str] = None):
+    logits = forward(params, tokens, cfg, seq_axis=seq_axis)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+    return nll
+
+
+def images_to_tokens(images, patch: int = 16):
+    """(B,H,W,C) -> (B, T, patch*patch*C): patchify outside the model so
+    the embed einsum is one big MXU matmul."""
+    b, h, w, c = images.shape
+    ph, pw = h // patch, w // patch
+    x = images.reshape(b, ph, patch, pw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, ph * pw, patch * patch * c)

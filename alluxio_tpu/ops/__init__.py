@@ -1,0 +1,5 @@
+"""On-device data ops (decode, batch assembly, kernels)."""
+
+from alluxio_tpu.ops.decode import (  # noqa: F401
+    decode_image_records, encode_image_records, image_record_bytes, sum_bytes,
+)

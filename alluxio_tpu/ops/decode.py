@@ -1,0 +1,66 @@
+"""Jitted record decode ops: raw cached bytes -> model-ready batches.
+
+The data-plane's on-device tail: everything here stays inside ``jit`` so
+XLA fuses the cast/normalize into the first matmul's input pipeline (no
+separate HBM round-trip for elementwise work — the guide's rule of keeping
+HBM-bound elementwise ops fused).
+
+Record format for the image path mirrors fixed-size TFRecord-style
+samples: ``label(4B little-endian) || H*W*C uint8 pixels``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+@functools.partial(jax.jit, static_argnames=("height", "width", "channels"))
+def decode_image_records(records: jax.Array, *, height: int, width: int,
+                         channels: int = 3):
+    """(batch, record_bytes) uint8 -> ((batch,H,W,C) bf16 normalized, labels).
+
+    Cast + scale + normalize fuse into one pass; output is bf16 for the MXU.
+    """
+    labels = (records[:, 0].astype(jnp.int32)
+              | (records[:, 1].astype(jnp.int32) << 8)
+              | (records[:, 2].astype(jnp.int32) << 16)
+              | (records[:, 3].astype(jnp.int32) << 24))
+    pixels = records[:, 4:4 + height * width * channels]
+    imgs = pixels.reshape(-1, height, width, channels).astype(jnp.float32)
+    imgs = imgs / 255.0
+    mean = jnp.asarray(IMAGENET_MEAN, dtype=jnp.float32)
+    std = jnp.asarray(IMAGENET_STD, dtype=jnp.float32)
+    imgs = (imgs - mean) / std
+    return imgs.astype(jnp.bfloat16), labels
+
+
+def image_record_bytes(height: int, width: int, channels: int = 3) -> int:
+    return 4 + height * width * channels
+
+
+def encode_image_records(images, labels) -> bytes:
+    """Host-side encoder (writers/tests): the inverse of
+    ``decode_image_records``. numpy-only; never inside jit."""
+    import numpy as np
+
+    images = np.asarray(images, dtype=np.uint8)
+    labels = np.asarray(labels, dtype=np.int32)
+    n = images.shape[0]
+    flat = images.reshape(n, -1)
+    out = np.empty((n, 4 + flat.shape[1]), dtype=np.uint8)
+    out[:, :4] = labels.astype("<i4").view(np.uint8).reshape(n, 4)
+    out[:, 4:] = flat
+    return out.tobytes()
+
+
+@jax.jit
+def sum_bytes(block: jax.Array):
+    """Forces a full device-side read of a cached block (benchmarks use
+    this to measure HBM-tier serving bandwidth)."""
+    return jnp.sum(block.astype(jnp.uint32))

@@ -1,0 +1,9 @@
+"""SPMD distribution over device meshes (TPU-native; SURVEY.md 2.11)."""
+
+from alluxio_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS, MODEL_AXIS, make_mesh, named_sharding, replicated,
+    shard_host_batch,
+)
+from alluxio_tpu.parallel.ring_attention import (  # noqa: F401
+    reference_attention, ring_attention,
+)

@@ -1,0 +1,75 @@
+"""Device mesh management + TPU locality mapping.
+
+The SPMD substrate for the framework's distribution strategies
+(SURVEY.md 2.11): data-parallel block striping -> ``data`` axis shardings;
+replication fan-out -> replicated shardings over ICI; locality scheduling ->
+``TieredIdentity`` derived from mesh coordinates (host < slice < pod).
+
+Axes convention: ``data`` (batch / sequence shards), ``model`` (tensor
+parallel). Meshes come from ``jax.devices()`` reshaped; on multi-host
+deployments the same code runs under ``jax.distributed`` with the global
+device set.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from alluxio_tpu.utils.wire import LocalityTier, TieredIdentity
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(axis_sizes: Optional[Dict[str, int]] = None, *,
+              devices=None):
+    """Build a Mesh; default = all devices on the data axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_sizes:
+        axis_sizes = {DATA_AXIS: len(devices)}
+    names = tuple(axis_sizes.keys())
+    shape = tuple(axis_sizes.values())
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"mesh shape {axis_sizes} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, names)
+
+
+def named_sharding(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh):
+    return named_sharding(mesh)
+
+
+def identity_for_device(device) -> TieredIdentity:
+    """Map a device's topology coordinates onto the locality tiers the
+    placement policies understand (reference: ``TieredIdentityFactory``;
+    here locality comes from the TPU topology instead of rack scripts)."""
+    tiers = [LocalityTier("host", f"host-{getattr(device, 'process_index', 0)}")]
+    coords = getattr(device, "coords", None)
+    slice_index = getattr(device, "slice_index", None)
+    if slice_index is not None:
+        tiers.append(LocalityTier("slice", f"slice-{slice_index}"))
+    elif coords is not None:
+        tiers.append(LocalityTier("slice", f"slice-{coords[-1]}"))
+    tiers.append(LocalityTier("pod", "pod-0"))
+    return TieredIdentity(tiers)
+
+
+def shard_host_batch(mesh, host_array, *, axis: str = DATA_AXIS):
+    """Place one host array as a mesh-sharded jax.Array (batch dim split
+    over ``axis``): the device-side entry of the data-parallel read path."""
+    import jax
+
+    return jax.device_put(host_array, named_sharding(mesh, axis))

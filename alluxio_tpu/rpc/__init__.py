@@ -1,0 +1,6 @@
+"""RPC layer (reference: ``core/common/.../grpc`` + proto services)."""
+
+from alluxio_tpu.rpc.core import RpcChannel, RpcServer, ServiceDefinition  # noqa: F401
+from alluxio_tpu.rpc.clients import (  # noqa: F401
+    BlockMasterClient, FsMasterClient, MetaMasterClient, WorkerClient,
+)

@@ -1,0 +1,261 @@
+"""Typed, retrying RPC clients.
+
+Re-design of ``client/file/RetryHandlingFileSystemMasterClient.java``,
+``client/block/RetryHandlingBlockMasterClient.java`` and
+``AbstractMasterClient``: every call runs under an exponential time-bounded
+retry on transient errors; surfaces mirror the in-process adapters so the
+rest of the stack cannot tell transport from direct calls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+from alluxio_tpu.rpc.core import RpcChannel
+from alluxio_tpu.rpc.master_service import (
+    BLOCK_SERVICE, FS_SERVICE, META_SERVICE,
+)
+from alluxio_tpu.rpc.worker_service import WORKER_SERVICE
+from alluxio_tpu.utils.retry import ExponentialTimeBoundedRetry, retry
+from alluxio_tpu.utils.wire import (
+    BlockInfo, FileBlockInfo, FileInfo, MountPointInfo, WorkerInfo,
+    WorkerNetAddress,
+)
+
+
+class _BaseClient:
+    service = ""
+
+    def __init__(self, address: str, *, retry_duration_s: float = 30.0,
+                 base_sleep_s: float = 0.05, max_sleep_s: float = 3.0) -> None:
+        self._channel = RpcChannel(address)
+        self._retry_duration_s = retry_duration_s
+        self._base_sleep_s = base_sleep_s
+        self._max_sleep_s = max_sleep_s
+
+    def _call(self, method: str, request: dict, timeout: float = 30.0):
+        return retry(
+            lambda: self._channel.call(self.service, method, request,
+                                       timeout=timeout),
+            ExponentialTimeBoundedRetry(self._retry_duration_s,
+                                        self._base_sleep_s,
+                                        self._max_sleep_s))
+
+
+class FsMasterClient(_BaseClient):
+    service = FS_SERVICE
+
+    def get_status(self, path: str, sync_interval_ms: int = -1) -> FileInfo:
+        return FileInfo.from_wire(self._call(
+            "get_status", {"path": str(path),
+                           "sync_interval_ms": sync_interval_ms}))
+
+    def exists(self, path: str) -> bool:
+        return self._call("exists", {"path": str(path)})["exists"]
+
+    def list_status(self, path: str, recursive: bool = False,
+                    sync_interval_ms: int = -1) -> List[FileInfo]:
+        resp = self._call("list_status", {
+            "path": str(path), "recursive": recursive,
+            "sync_interval_ms": sync_interval_ms})
+        return [FileInfo.from_wire(d) for d in resp["infos"]]
+
+    def create_file(self, path: str, **opts) -> FileInfo:
+        return FileInfo.from_wire(self._call(
+            "create_file", {"path": str(path), **opts}))
+
+    def create_directory(self, path: str, **opts) -> FileInfo:
+        return FileInfo.from_wire(self._call(
+            "create_directory", {"path": str(path), **opts}))
+
+    def get_new_block_id(self, path: str) -> int:
+        return self._call("get_new_block_id", {"path": str(path)})["block_id"]
+
+    def complete_file(self, path: str, length: Optional[int] = None,
+                      ufs_fingerprint: str = "") -> None:
+        self._call("complete_file", {"path": str(path), "length": length,
+                                     "ufs_fingerprint": ufs_fingerprint})
+
+    def delete(self, path: str, recursive: bool = False,
+               alluxio_only: bool = False) -> None:
+        self._call("delete", {"path": str(path), "recursive": recursive,
+                              "alluxio_only": alluxio_only})
+
+    def rename(self, src: str, dst: str) -> None:
+        self._call("rename", {"src": str(src), "dst": str(dst)})
+
+    def free(self, path: str, recursive: bool = False,
+             forced: bool = False) -> List[int]:
+        return self._call("free", {"path": str(path), "recursive": recursive,
+                                   "forced": forced})["freed_blocks"]
+
+    def mount(self, path: str, ufs_uri: str, *, read_only: bool = False,
+              shared: bool = False,
+              properties: Optional[Dict[str, str]] = None) -> None:
+        self._call("mount", {"path": str(path), "ufs_uri": ufs_uri,
+                             "read_only": read_only, "shared": shared,
+                             "properties": properties})
+
+    def unmount(self, path: str) -> None:
+        self._call("unmount", {"path": str(path)})
+
+    def get_mount_points(self) -> List[MountPointInfo]:
+        resp = self._call("get_mount_points", {})
+        return [MountPointInfo.from_wire(d) for d in resp["mounts"]]
+
+    def set_attribute(self, path: str, **opts) -> None:
+        self._call("set_attribute", {"path": str(path), **opts})
+
+    def get_file_block_info_list(self, path: str) -> List[FileBlockInfo]:
+        resp = self._call("get_file_block_info_list", {"path": str(path)})
+        return [FileBlockInfo.from_wire(d) for d in resp["infos"]]
+
+    def schedule_async_persistence(self, path: str) -> None:
+        self._call("schedule_async_persistence", {"path": str(path)})
+
+    def get_pinned_file_ids(self) -> List[int]:
+        return self._call("get_pinned_file_ids", {})["ids"]
+
+    def sync_metadata(self, path: str) -> bool:
+        return self._call("sync_metadata", {"path": str(path)})["changed"]
+
+    def mark_persisted(self, path: str, ufs_fingerprint: str = "") -> None:
+        self._call("mark_persisted", {"path": str(path),
+                                      "ufs_fingerprint": ufs_fingerprint})
+
+    def file_system_heartbeat(self, worker_id: int,
+                              persisted_files: List[int]) -> None:
+        self._call("file_system_heartbeat", {
+            "worker_id": worker_id, "persisted_files": persisted_files})
+
+
+class BlockMasterClient(_BaseClient):
+    """Surface-compatible with ``InProcessBlockMasterClient``."""
+
+    service = BLOCK_SERVICE
+
+    def get_worker_id(self, address: WorkerNetAddress) -> int:
+        return self._call("get_worker_id",
+                          {"address": address.to_wire()})["worker_id"]
+
+    def register(self, worker_id: int, capacity: Dict[str, int],
+                 used: Dict[str, int], blocks: Dict[str, List[int]],
+                 address: Optional[WorkerNetAddress] = None) -> None:
+        self._call("register", {
+            "worker_id": worker_id, "capacity": capacity, "used": used,
+            "blocks": blocks,
+            "address": address.to_wire() if address else None})
+
+    def heartbeat(self, worker_id: int, used: Dict[str, int],
+                  added: Dict[str, List[int]], removed: List[int],
+                  metrics_snapshot: Optional[Dict[str, float]] = None) -> dict:
+        return self._call("heartbeat", {
+            "worker_id": worker_id, "used": used, "added": added,
+            "removed": removed, "metrics": metrics_snapshot})
+
+    def commit_block(self, worker_id: int, used_on_tier: int, tier: str,
+                     block_id: int, length: int) -> None:
+        self._call("commit_block", {
+            "worker_id": worker_id, "used_on_tier": used_on_tier,
+            "tier": tier, "block_id": block_id, "length": length})
+
+    def get_block_info(self, block_id: int) -> BlockInfo:
+        return BlockInfo.from_wire(self._call("get_block_info",
+                                              {"block_id": block_id}))
+
+    def get_block_infos(self, block_ids: List[int]) -> List[BlockInfo]:
+        resp = self._call("get_block_infos", {"block_ids": block_ids})
+        return [BlockInfo.from_wire(d) for d in resp["infos"]]
+
+    def get_worker_infos(self, include_lost: bool = False) -> List[WorkerInfo]:
+        resp = self._call("get_worker_infos", {"include_lost": include_lost})
+        return [WorkerInfo.from_wire(d) for d in resp["infos"]]
+
+    def get_capacity(self) -> Dict[str, int]:
+        return self._call("get_capacity", {})
+
+
+class MetaMasterClient(_BaseClient):
+    service = META_SERVICE
+
+    def get_configuration(self) -> dict:
+        return self._call("get_configuration", {})
+
+    def get_config_hash(self) -> str:
+        return self._call("get_config_hash", {})["hash"]
+
+    def get_master_info(self) -> dict:
+        return self._call("get_master_info", {})
+
+
+class WorkerClient(_BaseClient):
+    """Data-plane client for one worker (reference: block streams +
+    short-circuit RPCs in ``client/block/stream``)."""
+
+    service = WORKER_SERVICE
+
+    def read_block(self, block_id: int, *, offset: int = 0, length: int = -1,
+                   chunk_size: int = 1 << 20,
+                   ufs: Optional[dict] = None,
+                   cache: bool = True) -> Iterator[dict]:
+        return self._channel.call_stream(self.service, "read_block", {
+            "block_id": block_id, "offset": offset, "length": length,
+            "chunk_size": chunk_size, "ufs": ufs, "cache": cache})
+
+    def read_block_bytes(self, block_id: int, **kwargs) -> bytes:
+        return b"".join(msg["data"] for msg in
+                        self.read_block(block_id, **kwargs))
+
+    def write_block(self, block_id: int, session_id: int, data: bytes, *,
+                    tier: str = "", chunk_size: int = 1 << 20,
+                    pinned: bool = False) -> int:
+        def gen():
+            yield {"block_id": block_id, "session_id": session_id,
+                   "tier": tier, "size_hint": len(data), "pinned": pinned}
+            for i in range(0, len(data), chunk_size):
+                yield {"data": data[i:i + chunk_size]}
+
+        resp = self._channel.call_stream_in(self.service, "write_block", gen())
+        return resp["length"]
+
+    def open_local_block(self, session_id: int, block_id: int) -> dict:
+        return self._call("open_local_block", {"session_id": session_id,
+                                               "block_id": block_id})
+
+    def close_local_block(self, session_id: int, block_id: int) -> None:
+        self._call("close_local_block", {"session_id": session_id,
+                                         "block_id": block_id})
+
+    def create_local_block(self, session_id: int, block_id: int, *,
+                           size_hint: int, tier: str = "") -> str:
+        return self._call("create_local_block", {
+            "session_id": session_id, "block_id": block_id,
+            "size_hint": size_hint, "tier": tier})["path"]
+
+    def complete_local_block(self, session_id: int, block_id: int, *,
+                             cancel: bool = False,
+                             pinned: bool = False) -> None:
+        self._call("complete_local_block", {
+            "session_id": session_id, "block_id": block_id,
+            "cancel": cancel, "pinned": pinned})
+
+    def async_cache(self, block_id: int, ufs_path: str, offset: int,
+                    length: int, mount_id: int = 0) -> bool:
+        return self._call("async_cache", {
+            "block_id": block_id, "ufs_path": ufs_path, "offset": offset,
+            "length": length, "mount_id": mount_id})["accepted"]
+
+    def remove_block(self, block_id: int) -> None:
+        self._call("remove_block", {"block_id": block_id})
+
+    def move_block(self, block_id: int, tier: str) -> None:
+        self._call("move_block", {"block_id": block_id, "tier": tier})
+
+    def cleanup_session(self, session_id: int) -> None:
+        self._call("cleanup_session", {"session_id": session_id})
+
+    def persist_file(self, ufs_path: str, block_ids: List[int],
+                     mount_id: int = 0) -> str:
+        return self._call("persist_file", {
+            "ufs_path": ufs_path, "block_ids": block_ids,
+            "mount_id": mount_id}, timeout=300.0)["fingerprint"]

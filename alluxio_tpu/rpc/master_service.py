@@ -1,0 +1,154 @@
+"""Master-side RPC services.
+
+Re-design of the reference's master service handlers
+(``file/FileSystemMaster{Client,Worker,Job}ServiceHandler.java``,
+``block/BlockMasterClientServiceHandler`` + ``grpc/file_system_master.proto
+:475-676``, ``grpc/block_master.proto:120-286``, ``grpc/meta_master.proto``):
+thin translation between wire dicts and the master objects, with per-RPC
+metrics (the reference's ``RpcUtils`` wrappers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from alluxio_tpu.conf import Configuration, Source
+from alluxio_tpu.master.block_master import BlockMaster
+from alluxio_tpu.master.file_master import FileSystemMaster
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.rpc.core import RpcServer, ServiceDefinition
+from alluxio_tpu.utils.wire import WorkerNetAddress
+
+FS_SERVICE = "atpu.FileSystemMaster"
+BLOCK_SERVICE = "atpu.BlockMaster"
+META_SERVICE = "atpu.MetaMaster"
+
+
+def _timed(name: str, fn):
+    m = metrics()
+
+    def wrapper(req):
+        with m.timer(f"Master.rpc.{name}").time():
+            return fn(req)
+
+    return wrapper
+
+
+def fs_master_service(fsm: FileSystemMaster) -> ServiceDefinition:
+    svc = ServiceDefinition(FS_SERVICE)
+
+    def u(name, fn):
+        svc.unary(name, _timed(name, fn))
+
+    u("get_status", lambda r: fsm.get_status(
+        r["path"], sync_interval_ms=r.get("sync_interval_ms", -1)).to_wire())
+    u("exists", lambda r: {"exists": fsm.exists(r["path"])})
+    u("list_status", lambda r: {"infos": [
+        i.to_wire() for i in fsm.list_status(
+            r["path"], recursive=r.get("recursive", False),
+            sync_interval_ms=r.get("sync_interval_ms", -1))]})
+    u("create_file", lambda r: fsm.create_file(
+        r["path"], block_size_bytes=r.get("block_size_bytes"),
+        recursive=r.get("recursive", True), ttl=r.get("ttl", -1),
+        ttl_action=r.get("ttl_action", "DELETE"), mode=r.get("mode", 0o644),
+        owner=r.get("owner", ""), group=r.get("group", ""),
+        replication_min=r.get("replication_min", 0),
+        replication_max=r.get("replication_max", -1),
+        cacheable=r.get("cacheable", True),
+        persist_on_complete=r.get("persist_on_complete", False)).to_wire())
+    u("create_directory", lambda r: fsm.create_directory(
+        r["path"], recursive=r.get("recursive", True),
+        allow_exists=r.get("allow_exists", False),
+        mode=r.get("mode", 0o755)).to_wire())
+    u("get_new_block_id", lambda r: {
+        "block_id": fsm.get_new_block_id_for_file(r["path"])})
+    u("complete_file", lambda r: (
+        fsm.complete_file(r["path"], length=r.get("length"),
+                          ufs_fingerprint=r.get("ufs_fingerprint", "")),
+        {})[-1])
+    u("delete", lambda r: (
+        fsm.delete(r["path"], recursive=r.get("recursive", False),
+                   alluxio_only=r.get("alluxio_only", False)), {})[-1])
+    u("rename", lambda r: (fsm.rename(r["src"], r["dst"]), {})[-1])
+    u("free", lambda r: {"freed_blocks": fsm.free(
+        r["path"], recursive=r.get("recursive", False),
+        forced=r.get("forced", False))})
+    u("mount", lambda r: (fsm.mount(
+        r["path"], r["ufs_uri"], read_only=r.get("read_only", False),
+        shared=r.get("shared", False),
+        properties=r.get("properties")), {})[-1])
+    u("unmount", lambda r: (fsm.unmount(r["path"]), {})[-1])
+    u("get_mount_points", lambda r: {
+        "mounts": [m.to_wire() for m in fsm.get_mount_points()]})
+    u("set_attribute", lambda r: (fsm.set_attribute(
+        r["path"], pinned=r.get("pinned"),
+        pinned_media=r.get("pinned_media"), ttl=r.get("ttl"),
+        ttl_action=r.get("ttl_action"), mode=r.get("mode"),
+        owner=r.get("owner"), group=r.get("group"),
+        replication_min=r.get("replication_min"),
+        replication_max=r.get("replication_max"),
+        recursive=r.get("recursive", False),
+        xattr=r.get("xattr")), {})[-1])
+    u("get_file_block_info_list", lambda r: {"infos": [
+        i.to_wire() for i in fsm.get_file_block_info_list(r["path"])]})
+    u("schedule_async_persistence", lambda r: (
+        fsm.schedule_async_persistence(r["path"]), {})[-1])
+    u("get_pinned_file_ids", lambda r: {
+        "ids": sorted(fsm.get_pinned_file_ids())})
+    u("sync_metadata", lambda r: {"changed": fsm.sync_metadata(r["path"])})
+    u("mark_persisted", lambda r: (
+        fsm.mark_persisted(r["path"],
+                           ufs_fingerprint=r.get("ufs_fingerprint", "")),
+        {})[-1])
+    u("file_system_heartbeat", lambda r: (
+        fsm.file_system_heartbeat(r["worker_id"],
+                                  r.get("persisted_files", [])), {})[-1])
+    return svc
+
+
+def block_master_service(bm: BlockMaster) -> ServiceDefinition:
+    svc = ServiceDefinition(BLOCK_SERVICE)
+
+    def u(name, fn):
+        svc.unary(name, _timed(name, fn))
+
+    u("get_worker_id", lambda r: {"worker_id": bm.get_worker_id(
+        WorkerNetAddress.from_wire(r["address"]))})
+    u("register", lambda r: (bm.worker_register(
+        r["worker_id"], r["capacity"], r["used"], r["blocks"],
+        WorkerNetAddress.from_wire(r["address"]) if r.get("address")
+        else None), {})[-1])
+    u("heartbeat", lambda r: bm.worker_heartbeat(
+        r["worker_id"], r["used"], r.get("added", {}),
+        r.get("removed", []), r.get("metrics")))
+    u("commit_block", lambda r: (bm.commit_block(
+        r["worker_id"], r["used_on_tier"], r["tier"], r["block_id"],
+        r["length"]), {})[-1])
+    u("get_block_info", lambda r: bm.get_block_info(r["block_id"]).to_wire())
+    u("get_block_infos", lambda r: {"infos": [
+        b.to_wire() for b in bm.get_block_infos(r["block_ids"])]})
+    u("get_worker_infos", lambda r: {"infos": [
+        w.to_wire() for w in bm.get_worker_infos(
+            include_lost=r.get("include_lost", False))]})
+    u("get_capacity", lambda r: {"capacity": bm.capacity_bytes(),
+                                 "used": bm.used_bytes()})
+    return svc
+
+
+def meta_master_service(conf: Configuration, *, cluster_id: str = "",
+                        start_time_ms: int = 0,
+                        safe_mode_fn=lambda: False) -> ServiceDefinition:
+    """Config distribution + cluster info
+    (reference: ``meta_master.proto:196-211`` cluster-default config and
+    config-hash handshake, ``ConfigHashSync.java:36``)."""
+    svc = ServiceDefinition(META_SERVICE)
+    svc.unary("get_configuration", lambda r: {
+        "properties": conf.to_map(min_source=Source.SITE_PROPERTY),
+        "hash": conf.hash()})
+    svc.unary("get_config_hash", lambda r: {"hash": conf.hash()})
+    svc.unary("get_master_info", lambda r: {
+        "cluster_id": cluster_id, "start_time_ms": start_time_ms,
+        "safe_mode": bool(safe_mode_fn())})
+    svc.unary("metrics_heartbeat", lambda r: (
+        metrics() and None, {})[-1])
+    return svc

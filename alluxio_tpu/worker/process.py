@@ -212,5 +212,25 @@ class BlockWorker:
         ufs = self.ufs_manager.get(desc.mount_id)
         return self._ufs_reader.read_block(ufs, desc, cache=cache)
 
+    def persist_file(self, ufs_path: str, block_ids: List[int],
+                     mount_id: int) -> str:
+        """Write locally-cached blocks out as one UFS file; returns the UFS
+        content fingerprint (reference: the worker-side persist executor,
+        ``worker/file/`` + job-service ``PersistDefinition``)."""
+        ufs = self.ufs_manager.get(mount_id)
+        with ufs.create(ufs_path) as out:
+            for bid in block_ids:
+                with self.open_reader(bid) as r:
+                    pos = 0
+                    while pos < r.length:
+                        chunk = r.read(pos, 4 << 20)
+                        if not chunk:
+                            raise IOError(
+                                f"block {bid} truncated at {pos} "
+                                f"(expected {r.length} bytes)")
+                        out.write(chunk)
+                        pos += len(chunk)
+        return ufs.get_fingerprint(ufs_path).serialize()
+
     def cleanup_session(self, session_id: int) -> None:
         self.store.cleanup_session(session_id)

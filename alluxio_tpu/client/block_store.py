@@ -50,10 +50,13 @@ class BlockStoreClient:
         #: worker that served the most recent write (sync-persist targets it;
         #: LOCAL_FIRST keeps one file's blocks on one worker)
         self.last_write_worker: Optional[WorkerClient] = None
+        self.last_write_address: Optional[WorkerNetAddress] = None
         self._workers: Dict[str, WorkerClient] = {}
         self._lock = threading.Lock()
-        #: workers that recently failed reads (reference:
-        #: AlluxioFileInStream failed-worker memory, :94-95)
+        #: workers that recently failed reads, with the failure time —
+        #: entries expire after _FAILED_WORKER_TTL_S so a recovered worker
+        #: comes back into rotation (reference: AlluxioFileInStream
+        #: failed-worker memory, :94-95)
         self._failed_workers: Dict[str, float] = {}
 
     # -- worker client cache -------------------------------------------------
@@ -66,14 +69,28 @@ class BlockStoreClient:
                 self._workers[key] = c
             return c
 
-    def _live_workers(self) -> List[WorkerInfo]:
-        return [w for w in self._bm.get_worker_infos()
-                if w.address.key() not in self._failed_workers]
+    _FAILED_WORKER_TTL_S = 30.0
 
-    def mark_failed(self, address: WorkerNetAddress) -> None:
+    def _is_failed(self, key: str) -> bool:
         import time
 
-        self._failed_workers[address.key()] = time.monotonic()
+        t = self._failed_workers.get(key)
+        if t is None:
+            return False
+        if time.monotonic() - t > self._FAILED_WORKER_TTL_S:
+            del self._failed_workers[key]
+            return False
+        return True
+
+    def _live_workers(self) -> List[WorkerInfo]:
+        return [w for w in self._bm.get_worker_infos()
+                if not self._is_failed(w.address.key())]
+
+    def mark_failed(self, address: Optional[WorkerNetAddress]) -> None:
+        import time
+
+        if address is not None:
+            self._failed_workers[address.key()] = time.monotonic()
 
     # -- read ladder ---------------------------------------------------------
     def open_block(self, fbi: FileBlockInfo, *,
@@ -88,9 +105,11 @@ class BlockStoreClient:
             for loc in info.locations:
                 if is_local_worker(loc.address, local_hostname):
                     try:
-                        return LocalBlockInStream(
+                        stream = LocalBlockInStream(
                             self.worker_client(loc.address), self.session_id,
                             info.block_id)
+                        stream.address = loc.address
+                        return stream
                     except Exception:  # noqa: BLE001 - fall through ladder
                         pass
         # 2) remote cached copy, nearest first; the UFS descriptor rides
@@ -98,7 +117,7 @@ class BlockStoreClient:
         # heartbeat) self-heals server-side via read-through
         if info.locations:
             addrs = [l.address for l in info.locations
-                     if l.address.key() not in self._failed_workers]
+                     if not self._is_failed(l.address.key())]
             if addrs:
                 idx = self._identity.nearest(
                     [a.tiered_identity for a in addrs])
@@ -106,6 +125,7 @@ class BlockStoreClient:
                 stream = GrpcBlockInStream(
                     self.worker_client(address), info.block_id, info.length,
                     ufs=ufs_info, cache=cache_cold_reads)
+                stream.address = address
                 self._maybe_passive_cache(info, ufs_info)
                 return stream
         # 3) UFS fallback through a policy-chosen worker (caches read-through)
@@ -117,9 +137,11 @@ class BlockStoreClient:
                                              block_size=info.length)
         if address is None:
             raise UnavailableError("no live workers for UFS read")
-        return GrpcBlockInStream(self.worker_client(address), info.block_id,
-                                 info.length, ufs=ufs_info,
-                                 cache=cache_cold_reads)
+        stream = GrpcBlockInStream(self.worker_client(address),
+                                   info.block_id, info.length, ufs=ufs_info,
+                                   cache=cache_cold_reads)
+        stream.address = address
+        return stream
 
     def _maybe_passive_cache(self, info: BlockInfo,
                              ufs_info: Optional[dict]) -> None:
@@ -143,15 +165,25 @@ class BlockStoreClient:
 
     # -- write ---------------------------------------------------------------
     def open_block_writer(self, block_id: int, *, size_hint: int,
-                          tier: str = "", pinned: bool = False
+                          tier: str = "", pinned: bool = False,
+                          preferred: Optional[WorkerNetAddress] = None
                           ) -> BlockOutStream:
         workers = self._live_workers()
-        address = self._write_policy.pick(workers, block_id=block_id,
-                                          block_size=size_hint)
+        address = None
+        if preferred is not None and any(
+                w.address.key() == preferred.key() for w in workers):
+            # one file's blocks stay on one worker so worker-side persist
+            # can stream them out locally (reference: LocalFirstPolicy
+            # stickiness within a FileOutStream)
+            address = preferred
+        else:
+            address = self._write_policy.pick(workers, block_id=block_id,
+                                              block_size=size_hint)
         if address is None:
             raise UnavailableError("no live workers to write to")
         client = self.worker_client(address)
         self.last_write_worker = client
+        self.last_write_address = address
         if self._short_circuit and is_local_worker(address,
                                                    socket.gethostname()):
             try:

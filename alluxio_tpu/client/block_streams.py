@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import mmap
 import os
+import queue
 import socket
+import threading
+from concurrent import futures
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -46,6 +49,9 @@ class BlockInStream:
     def __init__(self, block_id: int, length: int) -> None:
         self.block_id = block_id
         self.length = length
+        #: serving worker (set by BlockStoreClient); failed-worker retry
+        #: marks it when a read dies mid-stream
+        self.address = None
 
     def pread(self, offset: int, n: int) -> bytes:
         raise NotImplementedError
@@ -197,8 +203,13 @@ class LocalBlockOutStream(BlockOutStream):
 
 
 class GrpcBlockOutStream(BlockOutStream):
-    """Remote write: buffered chunks shipped on close via the client-stream
-    (reference: ``GrpcDataWriter``)."""
+    """Remote write: chunks ride the client-stream as they are produced —
+    a bounded queue feeds the in-flight RPC so network transfer overlaps
+    the producer and peak memory stays ~queue-depth chunks, not a whole
+    block (reference: ``GrpcDataWriter`` chunked flow control)."""
+
+    _QUEUE_DEPTH = 4
+    _CHUNK = 1 << 20
 
     def __init__(self, worker: WorkerClient, session_id: int, block_id: int,
                  *, tier: str = "", pinned: bool = False) -> None:
@@ -207,25 +218,59 @@ class GrpcBlockOutStream(BlockOutStream):
         self._session = session_id
         self._tier = tier
         self._pinned = pinned
-        self._chunks: List[bytes] = []
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_DEPTH)
+        self._result: "futures.Future" = futures.Future()
+        self._sender = threading.Thread(target=self._send, daemon=True,
+                                        name=f"block-writer-{block_id}")
+        self._sender.start()
         self._closed = False
 
+    def _send(self) -> None:
+        def gen():
+            yield {"block_id": self.block_id, "session_id": self._session,
+                   "tier": self._tier, "pinned": self._pinned}
+            while True:
+                item = self._queue.get()
+                if item is None:
+                    return
+                yield {"data": item}
+
+        try:
+            resp = self._worker._channel.call_stream_in(
+                self._worker.service, "write_block", gen())
+            self._result.set_result(resp["length"])
+        except BaseException as e:  # noqa: BLE001 - delivered on close()
+            self._result.set_exception(e)
+            # unblock a producer stuck on a full queue
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+
     def write(self, data: bytes) -> None:
-        self._chunks.append(bytes(data))
+        view = memoryview(data)
+        for i in range(0, len(view), self._CHUNK):
+            if self._result.done():  # sender died: surface its error
+                self._result.result()
+            self._queue.put(bytes(view[i:i + self._CHUNK]))
         self.written += len(data)
 
     def close(self, cancel: bool = False) -> None:
         if self._closed:
             return
         self._closed = True
+        self._queue.put(None)
         if cancel:
-            self._chunks.clear()
+            # worker-side temp block is reaped by session cleanup; just
+            # stop feeding and drop the RPC result
+            try:
+                self._result.result(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
             return
-        data = b"".join(self._chunks)
-        self._chunks.clear()
-        n = self._worker.write_block(self.block_id, self._session, data,
-                                     tier=self._tier, pinned=self._pinned)
-        if n != len(data):
+        n = self._result.result(timeout=300)
+        if n != self.written:
             raise UnavailableError(
-                f"short write: {n} of {len(data)} bytes for block "
+                f"short write: {n} of {self.written} bytes for block "
                 f"{self.block_id}")

@@ -119,6 +119,11 @@ class HbmPageStore:
                 self._pins[page_id] = n
 
     def delete(self, page_id: PageId, force: bool = False) -> bool:
+        """Evict = drop the store's reference ONLY. Never ``arr.delete()``:
+        that invalidates the buffer for every holder, including a consumer
+        that got this array from an earlier ``get`` — JAX frees device
+        memory once the last Python reference dies, which is exactly the
+        liveness contract we want."""
         with self._lock:
             if not force and self._pins.get(page_id, 0) > 0:
                 return False  # pinned by a live lease
@@ -127,12 +132,7 @@ class HbmPageStore:
                 return False
             self._used -= self._sizes.pop(page_id, 0)
             self._pins.pop(page_id, None)
-            # dropping the reference lets XLA reclaim the buffer once no
-            # in-flight computation uses it
-            try:
-                arr.delete()
-            except Exception:  # noqa: BLE001 - buffer may be donated/in use
-                pass
+            del arr
             return True
 
     def _ensure_room(self, size: int) -> bool:

@@ -49,7 +49,13 @@ class _MetadataCache:
         self._entries[path] = (info, time.monotonic() + self._ttl)
 
     def invalidate(self, path: str) -> None:
+        """Drop the path, its parent listing, and every cached descendant
+        (recursive delete / dir rename would otherwise leave live entries
+        for dead subtrees)."""
         self._entries.pop(path, None)
+        prefix = path.rstrip("/") + "/"
+        for p in [p for p in self._entries if p.startswith(prefix)]:
+            self._entries.pop(p, None)
         parent = AlluxioURI(path).parent()
         if parent is not None:
             self._entries.pop(parent.path, None)
@@ -154,6 +160,46 @@ class FileSystem:
 
     def persist(self, path: "str | AlluxioURI") -> None:
         self.fs_master.schedule_async_persistence(AlluxioURI(path).path)
+
+    def persist_now(self, path: "str | AlluxioURI") -> str:
+        """Synchronously write a cached file back to its UFS via a worker
+        holding its blocks, then mark the inode persisted (reference: the
+        worker-side persist executor driven by ``PersistDefinition``)."""
+        from alluxio_tpu.utils.exceptions import UnavailableError
+
+        info = self.get_status(path)
+        if not info.ufs_path:
+            raise UnavailableError(f"{path} has no UFS path to persist to")
+        if info.persisted:
+            return ""
+        fbis = self.fs_master.get_file_block_info_list(info.path)
+        # the persisting worker must hold every block locally: pick one
+        # present in all blocks' location sets (LOCAL_FIRST writes keep a
+        # file's blocks on one worker, so this is the common case)
+        target = None
+        if fbis:
+            candidates = None
+            addr_by_key = {}
+            for fbi in fbis:
+                keys = set()
+                for loc in fbi.block_info.locations:
+                    keys.add(loc.address.key())
+                    addr_by_key[loc.address.key()] = loc.address
+                candidates = keys if candidates is None else \
+                    (candidates & keys)
+            if not candidates:
+                raise UnavailableError(
+                    f"no single worker holds all cached blocks of {path}")
+            target = addr_by_key[sorted(candidates)[0]]
+        fingerprint = ""
+        if target is not None:
+            worker = self.store.worker_client(target)
+            fingerprint = worker.persist_file(
+                info.ufs_path, [fbi.block_info.block_id for fbi in fbis],
+                info.mount_id)
+        self.fs_master.mark_persisted(info.path, ufs_fingerprint=fingerprint)
+        self._invalidate(path)
+        return fingerprint
 
     def _invalidate(self, path) -> None:
         if self._md_cache is not None:

@@ -85,7 +85,10 @@ class DeviceBlockLoader:
             if lease is not None:
                 self._m.counter("Client.JaxHbmHits").inc()
                 arr = lease.array
-                lease.close()  # the returned jax.Array keeps itself alive
+                # safe to unpin before returning: eviction only drops the
+                # store's reference (never arr.delete()), so the array the
+                # consumer holds stays valid regardless
+                lease.close()
                 return arr
         host = self._host_bytes(path, index)
         arr = self._jax.device_put(host, self._device)

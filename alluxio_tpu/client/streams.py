@@ -15,7 +15,9 @@ from typing import Dict, List, Optional
 from alluxio_tpu.client.block_store import BlockStoreClient
 from alluxio_tpu.client.block_streams import BlockInStream, BlockOutStream
 from alluxio_tpu.rpc.clients import FsMasterClient
-from alluxio_tpu.utils.exceptions import InvalidArgumentError
+from alluxio_tpu.utils.exceptions import (
+    ConnectionFailedError, InvalidArgumentError, UnavailableError,
+)
 from alluxio_tpu.utils.wire import FileBlockInfo, FileInfo
 
 
@@ -103,15 +105,39 @@ class FileInStream:
             n -= len(chunk)
         return bytes(out)
 
+    _MAX_READ_ATTEMPTS = 3
+
     def _read_from_block(self, pos: int, n: int) -> bytes:
         bs = self.info.block_size_bytes
         index = pos // bs
         offset_in_block = pos % bs
-        stream = self._block_stream(index)
-        readable = stream.length - offset_in_block
-        if readable <= 0:
-            return b""
-        return stream.pread(offset_in_block, min(n, readable))
+        last_err: Optional[Exception] = None
+        for _ in range(self._MAX_READ_ATTEMPTS):
+            stream = self._block_stream(index)
+            readable = stream.length - offset_in_block
+            if readable <= 0:
+                return b""
+            try:
+                return stream.pread(offset_in_block, min(n, readable))
+            except (UnavailableError, ConnectionFailedError) as e:
+                # serving worker died mid-read: remember it, refresh the
+                # block's locations, retry another replica / UFS fallback
+                # (reference: AlluxioFileInStream failed-worker retry,
+                # :94-95)
+                last_err = e
+                self._store.mark_failed(stream.address)
+                self._drop_current_stream()
+                self._block_infos = None
+        raise last_err  # type: ignore[misc]
+
+    def _drop_current_stream(self) -> None:
+        if self._current is not None:
+            try:
+                self._current.close()
+            except Exception:  # noqa: BLE001 - already broken
+                pass
+            self._current = None
+            self._current_index = -1
 
     def _block_stream(self, index: int) -> BlockInStream:
         if index == self._current_index and self._current is not None:
@@ -162,6 +188,8 @@ class FileOutStream:
         self._block_ids: List[int] = []
         self.written = 0
         self._closed = False
+        #: sticky writer target: all blocks of one stream land on one worker
+        self._worker_address = None
 
     def write(self, data: bytes) -> int:
         if self._closed:
@@ -172,7 +200,9 @@ class FileOutStream:
                 block_id = self._fs.get_new_block_id(self.info.path)
                 self._current = self._store.open_block_writer(
                     block_id, size_hint=self._block_size,
-                    tier=self._tier, pinned=self._pinned)
+                    tier=self._tier, pinned=self._pinned,
+                    preferred=self._worker_address)
+                self._worker_address = self._store.last_write_address
                 self._block_ids.append(block_id)
                 self._current_written = 0
             room = self._block_size - self._current_written

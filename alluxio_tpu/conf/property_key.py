@@ -472,6 +472,9 @@ class Keys:
     JOB_MASTER_WORKER_TIMEOUT = _k("atpu.job.master.worker.timeout",
                                    KeyType.DURATION, default="1min",
                                    scope=Scope.JOB_MASTER)
+    JOB_MASTER_LOST_WORKER_INTERVAL = _k(
+        "atpu.job.master.lost.worker.interval", KeyType.DURATION,
+        default="10s", scope=Scope.JOB_MASTER)
     JOB_WORKER_RPC_PORT = _k("atpu.job.worker.rpc.port", KeyType.INT, default=30001)
     JOB_WORKER_THREADPOOL_SIZE = _k("atpu.job.worker.threadpool.size", KeyType.INT,
                                     default=8, scope=Scope.JOB_WORKER)

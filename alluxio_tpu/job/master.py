@@ -1,0 +1,349 @@
+"""Job master: accepts jobs, plans them, tracks lifecycles, commands
+job workers.
+
+Re-design of ``job/server/src/main/java/alluxio/master/job/
+{JobMaster.java:81,222,plan/PlanCoordinator.java:49,plan/PlanTracker.java,
+workflow/WorkflowTracker.java}``: a capacity-bounded tracker holds plan
+coordinators; job workers pull ``RunTask`` commands on heartbeat and push
+task status updates back; workflows run children sequentially. Lost job
+workers are detected by heartbeat silence and their tasks failed over
+(reference: JobMaster's LostWorkerDetectionHeartbeatExecutor analogue).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+from typing import Any, Deque, Dict, List, Optional
+
+from alluxio_tpu.job.plan import (
+    PlanRegistry, RegisteredJobWorker, SelectContext, default_registry,
+)
+from alluxio_tpu.job.wire import (
+    JobCommand, JobInfo, JobWorkerHealth, Status, TaskInfo,
+)
+from alluxio_tpu.utils.clock import Clock, SystemClock
+from alluxio_tpu.utils.exceptions import (
+    JobDoesNotExistError, ResourceExhaustedError,
+)
+
+
+class _PlanCoordinator:
+    """Drives one plan job: select executors -> dispatch tasks -> aggregate
+    (reference: ``PlanCoordinator.java:49``)."""
+
+    def __init__(self, job_id: int, config: Dict[str, Any], plan,
+                 clock: Clock) -> None:
+        self.job_id = job_id
+        self.config = config
+        self.plan = plan
+        self._clock = clock
+        self.info = JobInfo(job_id=job_id, name=plan.name,
+                            status=Status.CREATED,
+                            last_updated_ms=clock.millis())
+        self.tasks: Dict[int, TaskInfo] = {}
+        self._task_ids = itertools.count()
+        #: parent workflow, notified on completion
+        self.parent: Optional["_WorkflowCoordinator"] = None
+
+    def start(self, workers: List[RegisteredJobWorker], ctx: SelectContext,
+              dispatch) -> None:
+        try:
+            executors = self.plan.select_executors(self.config, workers, ctx)
+        except Exception as e:  # noqa: BLE001 - planning error fails the job
+            self._finish(Status.FAILED, error=f"{type(e).__name__}: {e}")
+            return
+        if not executors:
+            # nothing to do (e.g. already loaded everywhere)
+            self.info.result = self.plan.join(self.config, [])
+            self._finish(Status.COMPLETED)
+            return
+        self.info.status = Status.RUNNING
+        for worker_id, task_args in executors:
+            tid = next(self._task_ids)
+            task = TaskInfo(job_id=self.job_id, task_id=tid,
+                            worker_id=worker_id, status=Status.CREATED,
+                            args=task_args)
+            self.tasks[tid] = task
+            dispatch(worker_id, JobCommand(
+                kind="run", job_id=self.job_id, task_id=tid,
+                job_config=self.config, task_args=task_args))
+
+    def on_task_update(self, task_id: int, status: str, result: Any,
+                       error_message: str) -> None:
+        task = self.tasks.get(task_id)
+        if task is None or Status.is_finished(task.status):
+            return
+        task.status = status
+        task.result = result
+        task.error_message = error_message
+        self.info.last_updated_ms = self._clock.millis()
+        self._maybe_finish()
+
+    def fail_tasks_of_worker(self, worker_id: int, reason: str) -> None:
+        for task in self.tasks.values():
+            if task.worker_id == worker_id and \
+                    not Status.is_finished(task.status):
+                task.status = Status.FAILED
+                task.error_message = reason
+        self._maybe_finish()
+
+    def cancel(self) -> List[JobCommand]:
+        cmds = []
+        for task in self.tasks.values():
+            if not Status.is_finished(task.status):
+                task.status = Status.CANCELED
+                cmds.append(JobCommand(kind="cancel", job_id=self.job_id,
+                                       task_id=task.task_id))
+        if not Status.is_finished(self.info.status):
+            self._finish(Status.CANCELED)
+        return cmds
+
+    def _maybe_finish(self) -> None:
+        statuses = [t.status for t in self.tasks.values()]
+        if not all(Status.is_finished(s) for s in statuses):
+            return
+        if any(s == Status.FAILED for s in statuses):
+            errs = "; ".join(t.error_message for t in self.tasks.values()
+                             if t.status == Status.FAILED)
+            self._finish(Status.FAILED, error=errs)
+        elif any(s == Status.CANCELED for s in statuses):
+            self._finish(Status.CANCELED)
+        else:
+            try:
+                self.info.result = self.plan.join(
+                    self.config,
+                    [t.result for t in sorted(self.tasks.values(),
+                                              key=lambda t: t.task_id)])
+                self._finish(Status.COMPLETED)
+            except Exception as e:  # noqa: BLE001
+                self._finish(Status.FAILED,
+                             error=f"join failed: {type(e).__name__}: {e}")
+
+    def _finish(self, status: str, error: str = "") -> None:
+        self.info.status = status
+        self.info.error_message = error
+        self.info.last_updated_ms = self._clock.millis()
+        self.info.tasks = list(self.tasks.values())
+        if self.parent is not None:
+            self.parent.on_child_finished(self.job_id, status)
+
+
+class _WorkflowCoordinator:
+    """Sequential composite of child jobs (reference:
+    ``job/workflow/composite/CompositeExecution.java`` +
+    ``WorkflowTracker.java``)."""
+
+    def __init__(self, job_id: int, config: Dict[str, Any], master,
+                 clock: Clock) -> None:
+        self.job_id = job_id
+        self.config = config
+        self._master = master
+        self._clock = clock
+        self._pending: Deque[Dict[str, Any]] = collections.deque(
+            config.get("jobs", []))
+        self.info = JobInfo(job_id=job_id, name="workflow",
+                            status=Status.RUNNING,
+                            last_updated_ms=clock.millis())
+
+    def start(self) -> None:
+        if not self._pending:
+            self.info.status = Status.COMPLETED
+            return
+        self._launch_next()
+
+    def _launch_next(self) -> None:
+        child_cfg = self._pending.popleft()
+        child_id = self._master._run_locked(child_cfg, parent=self)
+        self.info.children.append(child_id)
+
+    def on_child_finished(self, child_id: int, status: str) -> None:
+        if status != Status.COMPLETED:
+            self.info.status = status
+            child = self._master._coordinators.get(child_id)
+            self.info.error_message = (
+                child.info.error_message if child is not None else
+                f"child job {child_id} {status}")
+            return
+        if self._pending:
+            self._launch_next()
+        else:
+            self.info.status = Status.COMPLETED
+            self.info.last_updated_ms = self._clock.millis()
+
+    def cancel(self) -> List[JobCommand]:
+        cmds = []
+        for cid in self.info.children:
+            child = self._master._coordinators.get(cid)
+            if child is not None and \
+                    not Status.is_finished(child.info.status):
+                cmds.extend(child.cancel())
+        self._pending.clear()
+        if not Status.is_finished(self.info.status):
+            self.info.status = Status.CANCELED
+        return cmds
+
+
+class JobMaster:
+    """The job-service control plane (reference: ``JobMaster.java:81``)."""
+
+    def __init__(self, fs_master, block_master, *,
+                 registry: Optional[PlanRegistry] = None,
+                 capacity: int = 1024,
+                 clock: Optional[Clock] = None,
+                 worker_timeout_ms: int = 60_000) -> None:
+        self._fs_master = fs_master
+        self._block_master = block_master
+        self._registry = registry or default_registry()
+        self._capacity = capacity
+        self._clock = clock or SystemClock()
+        self._worker_timeout_ms = worker_timeout_ms
+        self._lock = threading.RLock()
+        self._job_ids = itertools.count(1)
+        self._worker_ids = itertools.count(1)
+        self._coordinators: Dict[int, Any] = {}  # job_id -> coordinator
+        self._finished_fifo: Deque[int] = collections.deque()
+        self._workers: Dict[int, RegisteredJobWorker] = {}
+        self._last_contact_ms: Dict[int, int] = {}
+        self._command_queues: Dict[int, Deque[JobCommand]] = {}
+
+    # -- client API ---------------------------------------------------------
+    def run(self, config: Dict[str, Any]) -> int:
+        with self._lock:
+            return self._run_locked(config)
+
+    def _run_locked(self, config: Dict[str, Any],
+                    parent=None) -> int:
+        self._evict_finished()
+        active = sum(1 for c in self._coordinators.values()
+                     if not Status.is_finished(c.info.status))
+        if active >= self._capacity:
+            raise ResourceExhaustedError(
+                f"job master at capacity ({self._capacity} active jobs)")
+        job_id = next(self._job_ids)
+        if config.get("type") == "workflow":
+            wf = _WorkflowCoordinator(job_id, config, self, self._clock)
+            self._coordinators[job_id] = wf
+            wf.start()
+            return job_id
+        plan = self._registry.get(config.get("type", ""))
+        coord = _PlanCoordinator(job_id, config, plan, self._clock)
+        coord.parent = parent
+        self._coordinators[job_id] = coord
+        ctx = SelectContext(self._fs_master, self._block_master)
+        coord.start(list(self._workers.values()), ctx, self._dispatch)
+        return job_id
+
+    def cancel(self, job_id: int) -> None:
+        with self._lock:
+            coord = self._require(job_id)
+            for cmd in coord.cancel():
+                q = self._command_queues.get(
+                    self._task_worker(cmd.job_id, cmd.task_id))
+                if q is not None:
+                    q.append(cmd)
+
+    def get_status(self, job_id: int) -> JobInfo:
+        with self._lock:
+            coord = self._require(job_id)
+            info = coord.info
+            if hasattr(coord, "tasks"):
+                info.tasks = list(coord.tasks.values())
+            return info
+
+    def list_jobs(self) -> List[JobInfo]:
+        with self._lock:
+            return [c.info for c in self._coordinators.values()]
+
+    def list_plan_types(self) -> List[str]:
+        return self._registry.names()
+
+    # -- worker protocol ----------------------------------------------------
+    def register_worker(self, hostname: str) -> int:
+        with self._lock:
+            worker_id = next(self._worker_ids)
+            self._workers[worker_id] = RegisteredJobWorker(
+                worker_id=worker_id, hostname=hostname,
+                health=JobWorkerHealth(worker_id=worker_id,
+                                       hostname=hostname))
+            self._command_queues[worker_id] = collections.deque()
+            self._last_contact_ms[worker_id] = self._clock.millis()
+            return worker_id
+
+    def heartbeat(self, worker_id: int, health: Dict[str, Any],
+                  task_updates: List[Dict[str, Any]]) -> List[dict]:
+        with self._lock:
+            if worker_id not in self._workers:
+                # master lost this worker: tell it to re-register
+                return [JobCommand(kind="register").to_wire()]
+            self._last_contact_ms[worker_id] = self._clock.millis()
+            if health:
+                self._workers[worker_id].health = JobWorkerHealth.from_wire(
+                    health)
+            for upd in task_updates:
+                coord = self._coordinators.get(upd["job_id"])
+                if coord is not None and hasattr(coord, "on_task_update"):
+                    coord.on_task_update(
+                        upd["task_id"], upd["status"], upd.get("result"),
+                        upd.get("error_message", ""))
+            q = self._command_queues[worker_id]
+            cmds = []
+            while q:
+                cmds.append(q.popleft().to_wire())
+            return cmds
+
+    def detect_lost_workers(self) -> None:
+        """Expire silent job workers and fail over their running tasks
+        (reference: job-worker liveness in ``JobMaster``)."""
+        with self._lock:
+            now = self._clock.millis()
+            dead = [wid for wid, t in self._last_contact_ms.items()
+                    if now - t > self._worker_timeout_ms]
+            for wid in dead:
+                self._workers.pop(wid, None)
+                self._last_contact_ms.pop(wid, None)
+                self._command_queues.pop(wid, None)
+                for coord in self._coordinators.values():
+                    if hasattr(coord, "fail_tasks_of_worker"):
+                        coord.fail_tasks_of_worker(
+                            wid, f"job worker {wid} lost")
+
+    def workers(self) -> List[RegisteredJobWorker]:
+        with self._lock:
+            return list(self._workers.values())
+
+    # -- internals ----------------------------------------------------------
+    def _dispatch(self, worker_id: int, cmd: JobCommand) -> None:
+        q = self._command_queues.get(worker_id)
+        if q is None:
+            coord = self._coordinators.get(cmd.job_id)
+            if coord is not None and hasattr(coord, "fail_tasks_of_worker"):
+                coord.fail_tasks_of_worker(
+                    worker_id, f"job worker {worker_id} not registered")
+            return
+        q.append(cmd)
+
+    def _task_worker(self, job_id: int, task_id: int) -> int:
+        coord = self._coordinators.get(job_id)
+        if coord is None or not hasattr(coord, "tasks"):
+            return -1
+        task = coord.tasks.get(task_id)
+        return task.worker_id if task is not None else -1
+
+    def _require(self, job_id: int):
+        coord = self._coordinators.get(job_id)
+        if coord is None:
+            raise JobDoesNotExistError(f"job {job_id} does not exist")
+        return coord
+
+    def _evict_finished(self) -> None:
+        """FIFO-evict finished jobs beyond capacity (reference:
+        ``PlanTracker``'s finished-job eviction)."""
+        for jid, coord in self._coordinators.items():
+            if Status.is_finished(coord.info.status) and \
+                    jid not in self._finished_fifo:
+                self._finished_fifo.append(jid)
+        while len(self._finished_fifo) > self._capacity:
+            jid = self._finished_fifo.popleft()
+            self._coordinators.pop(jid, None)

@@ -1,0 +1,17 @@
+"""Built-in plan definitions (reference: ``job/server/.../job/plan/{load,
+migrate,persist,replicate}``)."""
+
+from __future__ import annotations
+
+
+def register_builtin_plans(registry) -> None:
+    from alluxio_tpu.job.plans.load import LoadDefinition
+    from alluxio_tpu.job.plans.migrate import MigrateDefinition
+    from alluxio_tpu.job.plans.persist import PersistDefinition
+    from alluxio_tpu.job.plans.replicate import (
+        EvictDefinition, MoveDefinition, ReplicateDefinition,
+    )
+
+    for plan in (LoadDefinition(), MigrateDefinition(), PersistDefinition(),
+                 ReplicateDefinition(), EvictDefinition(), MoveDefinition()):
+        registry.register(plan)

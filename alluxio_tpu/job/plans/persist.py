@@ -1,0 +1,58 @@
+"""Async persist: write a cached file back to its UFS.
+
+Re-design of ``job/server/src/main/java/alluxio/job/plan/persist/
+PersistDefinition.java``: one task on a worker holding (most of) the file's
+blocks; the task drives the worker-side ``persist_file`` (worker streams
+blocks to the UFS and returns the fingerprint), then marks the inode
+persisted on the master.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Tuple
+
+from alluxio_tpu.job.plan import (
+    PlanDefinition, RegisteredJobWorker, RunTaskContext, SelectContext,
+)
+from alluxio_tpu.utils.exceptions import (
+    InvalidArgumentError, UnavailableError,
+)
+
+
+class PersistDefinition(PlanDefinition):
+    name = "persist"
+
+    def select_executors(self, config: Dict[str, Any],
+                         workers: List[RegisteredJobWorker],
+                         ctx: SelectContext) -> List[Tuple[int, Any]]:
+        path = config.get("path")
+        if not path:
+            raise InvalidArgumentError("persist job requires 'path'")
+        if not workers:
+            raise UnavailableError("no job workers registered")
+        info = ctx.fs_master.get_status(path)
+        # prefer the job worker co-located with the most cached blocks
+        votes: Dict[str, int] = collections.Counter()
+        for fbi in ctx.fs_master.get_file_block_info_list(path):
+            for loc in fbi.block_info.locations:
+                votes[loc.address.tiered_identity.value("host")] += 1
+        by_host = {w.hostname: w for w in workers}
+        best = None
+        for host, _ in votes.most_common():
+            if host in by_host:
+                best = by_host[host]
+                break
+        if best is None:
+            best = sorted(workers, key=lambda w: w.worker_id)[0]
+        return [(best.worker_id, {"path": info.path})]
+
+    def run_task(self, config: Dict[str, Any], task_args: Any,
+                 ctx: RunTaskContext) -> Any:
+        path = task_args["path"]
+        ctx.fs.persist_now(path)
+        return {"persisted": path}
+
+    def join(self, config: Dict[str, Any],
+             task_results: List[Any]) -> Any:
+        return task_results[0] if task_results else {}

@@ -237,6 +237,24 @@ class BlockMaster(Journaled):
                     pass
         return [i.id for i in newly_lost]
 
+    def forget_worker(self, worker_id: int) -> None:
+        """Expire one worker immediately (admin decommission / tests);
+        same effect as the lost-worker detector firing for it."""
+        with self._lock:
+            info = self._workers.pop(worker_id, None)
+            if info is None:
+                return
+            self._lost_workers[worker_id] = info
+            info.registered = False
+            for bid in list(info.blocks):
+                self._remove_location(bid, worker_id)
+            info.blocks.clear()
+        for listener in self.lost_worker_listeners:
+            try:
+                listener(info)
+            except Exception:  # noqa: BLE001
+                pass
+
     # --------------------------------------------------------------- blocks
     def commit_block(self, worker_id: int, used_bytes_on_tier: int,
                      tier_alias: str, block_id: int, length: int) -> None:

@@ -635,6 +635,19 @@ class FileSystemMaster:
         with self.inode_tree.lock.read_locked():
             return set(self.inode_tree.pinned_ids)
 
+    def files_with_replication_constraints(self) -> List[Inode]:
+        """Completed files whose replication is bounded — the
+        ReplicationChecker's work list (reference:
+        ``ReplicationChecker.java:57`` walks the replication-limited
+        inode registry)."""
+        with self.inode_tree.lock.read_locked():
+            out = []
+            for iid in list(self.inode_tree.replication_limited_ids):
+                inode = self.inode_tree.get_inode(iid)
+                if inode is not None and inode.completed:
+                    out.append(inode)
+            return out
+
     # ------------------------------------------------------ persist control
     def schedule_async_persistence(self, path: "str | AlluxioURI") -> None:
         """Reference: ``scheduleAsyncPersistence:3209``."""

@@ -75,6 +75,10 @@ class InodeTree(Journaled):
         self.ttl_buckets = TtlBucketList()
         self.pinned_ids: Set[int] = set()
         self.to_be_persisted_ids: Set[int] = set()
+        #: files with replication_min>0 or replication_max>=0; the
+        #: ReplicationChecker walks only these (reference: the pinned/
+        #: replication-limited inode registries in InodeTreePersistentState)
+        self.replication_limited_ids: Set[int] = set()
         self._inode_count = 0
 
     # ------------------------------------------------------------------ read
@@ -174,6 +178,7 @@ class InodeTree(Journaled):
             self.ttl_buckets.insert(inode.id, inode.creation_time_ms, inode.ttl)
         if inode.pinned:
             self.pinned_ids.add(inode.id)
+        self._track_replication(inode)
 
     def _apply_update(self, p: dict) -> None:
         inode = self._store.get(p["id"])
@@ -212,6 +217,7 @@ class InodeTree(Journaled):
         self._inode_count -= 1
         self.pinned_ids.discard(inode.id)
         self.to_be_persisted_ids.discard(inode.id)
+        self.replication_limited_ids.discard(inode.id)
         if inode.ttl >= 0:
             self.ttl_buckets.remove(inode.id)
         parent = self._store.get(inode.parent_id)
@@ -258,6 +264,7 @@ class InodeTree(Journaled):
                   "replication_max", "persistence_state"):
             if p.get(k) is not None:
                 setattr(inode, k, p[k])
+        self._track_replication(inode)
         if p.get("persistence_state") == PersistenceState.TO_BE_PERSISTED:
             self.to_be_persisted_ids.add(inode.id)
         elif p.get("persistence_state") is not None:
@@ -277,6 +284,13 @@ class InodeTree(Journaled):
         self.to_be_persisted_ids.discard(inode.id)
         self._store.put(inode)
 
+    def _track_replication(self, inode: Inode) -> None:
+        if not inode.is_directory and (inode.replication_min > 0 or
+                                       inode.replication_max >= 0):
+            self.replication_limited_ids.add(inode.id)
+        else:
+            self.replication_limited_ids.discard(inode.id)
+
     # ---------------------------------------------------------- checkpoint
     def snapshot(self) -> dict:
         inode_dicts = []
@@ -294,6 +308,7 @@ class InodeTree(Journaled):
         self.ttl_buckets.clear()
         self.pinned_ids.clear()
         self.to_be_persisted_ids.clear()
+        self.replication_limited_ids.clear()
         self._inode_count = 0
         self._root_id = snap.get("root_id")
         for d in snap.get("inodes", []):
@@ -309,6 +324,7 @@ class InodeTree(Journaled):
                 self.pinned_ids.add(inode.id)
             if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
                 self.to_be_persisted_ids.add(inode.id)
+            self._track_replication(inode)
 
     def _empty_snapshot(self) -> dict:
         return {"root_id": None, "inodes": []}

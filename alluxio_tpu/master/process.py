@@ -106,6 +106,24 @@ class MasterProcess:
         for t in self._threads:
             t.start()
 
+    def attach_replication_checker(self, job_client,
+                                   interval_s: Optional[float] = None) -> None:
+        """Start the replication-control loop once a job service exists
+        (reference: ``ReplicationChecker.java:57`` registered as an FSM
+        heartbeat; here the job master boots after the metadata master, so
+        the checker attaches late)."""
+        from alluxio_tpu.heartbeat import HeartbeatContext as HC
+        from alluxio_tpu.master.replication import ReplicationChecker
+
+        checker = ReplicationChecker(self.fs_master, self.block_master,
+                                     job_client)
+        t = HeartbeatThread(
+            HC.MASTER_REPLICATION_CHECK, _Exec(checker.heartbeat),
+            interval_s if interval_s is not None else
+            self._conf.get_duration_s(Keys.MASTER_REPLICATION_CHECK_INTERVAL))
+        t.start()
+        self._threads.append(t)
+
     def stop(self) -> None:
         for t in self._threads:
             t.stop()

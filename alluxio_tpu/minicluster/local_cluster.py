@@ -43,7 +43,8 @@ class LocalCluster:
                  conf_overrides: Optional[Dict] = None,
                  worker_mem_bytes: int = 64 << 20,
                  block_size: int = 1 << 20,
-                 start_worker_heartbeats: bool = False) -> None:
+                 start_worker_heartbeats: bool = False,
+                 start_job_service: bool = False) -> None:
         self._base = base_dir
         self._num_workers = num_workers
         self._worker_mem = worker_mem_bytes
@@ -59,6 +60,9 @@ class LocalCluster:
             self.conf.set(k, v)
         self.master: Optional[MasterProcess] = None
         self.workers: List[_WorkerHandle] = []
+        self._start_job_service = start_job_service
+        self.job_master = None
+        self.job_workers: List = []
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "LocalCluster":
@@ -68,6 +72,8 @@ class LocalCluster:
         self.master.start()
         for i in range(self._num_workers):
             self._start_worker(i)
+        if self._start_job_service:
+            self.start_job_service()
         return self
 
     def _start_worker(self, index: int) -> _WorkerHandle:
@@ -87,6 +93,9 @@ class LocalCluster:
                 f"host=localhost-w{index},slice=slice0"))
         worker = BlockWorker(wconf, bm_client, fs_client,
                              ufs_manager=None, address=address)
+        # UFS resolution must be in place before the RPC server serves a
+        # single read (a UFS-descriptor read in the gap would crash on None)
+        worker.ufs_manager = _MountFollowingUfsManager(fs_client)
         server = RpcServer(bind_host="127.0.0.1", port=0)
         server.add_service(worker_service(worker))
         port = server.start()
@@ -96,8 +105,6 @@ class LocalCluster:
             worker.start()
         else:
             worker._master_sync.register_with_master()
-        # workers resolve UFS instances lazily from the master's mount table
-        worker.ufs_manager = _MountFollowingUfsManager(fs_client)
         handle = _WorkerHandle(worker, server, port)
         self.workers.append(handle)
         return handle
@@ -105,7 +112,30 @@ class LocalCluster:
     def add_worker(self) -> _WorkerHandle:
         return self._start_worker(len(self.workers))
 
+    def start_job_service(self) -> None:
+        """Start a job master + one job worker per block worker
+        (reference: job master/worker co-deployment, §3.5 of SURVEY.md)."""
+        from alluxio_tpu.job.process import JobMasterProcess, make_job_worker
+
+        jconf = self.conf.copy()
+        jconf.set(Keys.JOB_MASTER_RPC_PORT, 0)
+        # tight heartbeat so in-process tests converge fast
+        jconf.set(Keys.JOB_WORKER_HEARTBEAT_INTERVAL, "50ms")
+        self.job_master = JobMasterProcess(jconf, self.master.address)
+        self.job_master.start()
+        for i in range(len(self.workers)):
+            jw = make_job_worker(jconf, self.job_master.address,
+                                 self.master.address, f"localhost-w{i}")
+            jw.start()
+            self.job_workers.append(jw)
+        self.master.attach_replication_checker(self.job_client(),
+                                               interval_s=0.1)
+
     def stop(self) -> None:
+        for jw in self.job_workers:
+            jw.stop()
+        if self.job_master is not None:
+            self.job_master.stop()
         for w in self.workers:
             w.stop()
         if self.master is not None:
@@ -130,6 +160,11 @@ class LocalCluster:
 
     def worker_client(self, index: int = 0) -> WorkerClient:
         return WorkerClient(self.workers[index].address)
+
+    def job_client(self):
+        from alluxio_tpu.rpc.job_service import JobMasterClient
+
+        return JobMasterClient(self.job_master.address)
 
     def file_system(self):
         """A full FileSystem client bound to this cluster."""

@@ -215,8 +215,7 @@ class RpcChannel:
         with RpcChannel._pool_lock:
             ch = RpcChannel._pool.get(address)
             if ch is None:
-                target = address if address.startswith("unix:") else address
-                ch = grpc.insecure_channel(target, options=[
+                ch = grpc.insecure_channel(address, options=[
                     ("grpc.max_send_message_length", 64 << 20),
                     ("grpc.max_receive_message_length", 64 << 20),
                 ])

@@ -50,6 +50,21 @@ class TestHbmStore:
         lease.close()
         assert store.put(PageId("f", 1), b"y" * 1024)
 
+    def test_eviction_keeps_consumer_array_alive(self):
+        """Regression: eviction drops only the store's reference — an
+        array a consumer obtained earlier must stay readable after its
+        page is evicted (no arr.delete() under the consumer)."""
+        store = HbmPageStore(capacity_bytes=1024)
+        p0 = PageId("f", 0)
+        store.put(p0, b"k" * 1024)
+        with store.get(p0) as lease:
+            held = lease.array
+        # unpinned now; force p0 out by inserting a full-size page
+        assert store.put(PageId("f", 1), b"m" * 1024)
+        assert not store.has(p0)
+        # the consumer's array is still valid device memory
+        assert bytes(np.asarray(held)[:2]) == b"kk"
+
 
 class TestDecode:
     def test_image_record_round_trip(self):

@@ -1,0 +1,262 @@
+"""Job service integration tests (reference: ``tests/.../job/plan/*``
++ ``job/server`` unit tests)."""
+
+import pytest
+
+from alluxio_tpu.job.wire import Status
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from alluxio_tpu.conf import Keys
+
+    with LocalCluster(str(tmp_path), num_workers=2,
+                      start_job_service=True,
+                      start_worker_heartbeats=True,
+                      conf_overrides={
+                          Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL: "50ms",
+                      }) as c:
+        yield c
+
+
+def _host_set(block_client, block_id):
+    info = block_client.get_block_info(block_id)
+    return {loc.address.tiered_identity.value("host")
+            for loc in info.locations}
+
+
+def _wait_locations(block_client, block_id, predicate, timeout_s=5.0):
+    """Wait out the worker-heartbeat lag that propagates removals."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate(_host_set(block_client, block_id)):
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"block {block_id} locations never satisfied predicate; "
+        f"now: {_host_set(block_client, block_id)}")
+
+
+def _wait_file_uncached(cluster, path, timeout_s=5.0):
+    for fbi in cluster.fs_client().get_file_block_info_list(path):
+        _wait_locations(cluster.block_client(), fbi.block_info.block_id,
+                        lambda hosts: not hosts, timeout_s)
+
+
+class TestDistributedLoad:
+    def test_load_persisted_file(self, cluster):
+        """§3.5 north-star: cold file in UFS -> distributedLoad caches it."""
+        fs = cluster.file_system()
+        data = b"x" * (3 * (1 << 20) + 17)  # 3+ blocks
+        fs.write_all("/cold", data, write_type="CACHE_THROUGH")
+        # free the cache so only the UFS copy remains
+        fs.free("/cold", forced=True)
+        _wait_file_uncached(cluster, "/cold")
+        st = fs.get_status("/cold")
+        assert st.persisted
+
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "load", "path": "/cold", "replication": 1})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        assert info.result["num_blocks"] == 4
+
+        bc = cluster.block_client()
+        for fbi in cluster.fs_client().get_file_block_info_list("/cold"):
+            assert fbi.block_info.locations, "block not cached after load"
+
+    def test_load_replication_2(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/r2", b"y" * (1 << 20), write_type="CACHE_THROUGH")
+        fs.free("/r2", forced=True)
+        _wait_file_uncached(cluster, "/r2")
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "load", "path": "/r2", "replication": 2})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        fbi = cluster.fs_client().get_file_block_info_list("/r2")[0]
+        hosts = _host_set(cluster.block_client(), fbi.block_info.block_id)
+        assert hosts == {"localhost-w0", "localhost-w1"}
+
+    def test_load_already_loaded_is_noop(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/warm", b"z" * 1024, write_type="CACHE_THROUGH")
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "load", "path": "/warm", "replication": 1})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED
+
+
+class TestMigrate:
+    def test_distributed_cp(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/src")
+        for i in range(4):
+            fs.write_all(f"/src/f{i}", f"file-{i}".encode() * 100)
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "migrate", "source": "/src",
+                         "destination": "/dst"})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        assert info.result["num_files"] == 4
+        for i in range(4):
+            assert fs.read_all(f"/dst/f{i}") == f"file-{i}".encode() * 100
+            assert fs.exists(f"/src/f{i}")  # cp keeps source
+
+    def test_distributed_mv(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/mv-src", b"move me")
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "migrate", "source": "/mv-src",
+                         "destination": "/mv-dst", "delete_source": True})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        assert fs.read_all("/mv-dst") == b"move me"
+        assert not fs.exists("/mv-src")
+
+    def test_overwrite_false_fails(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/a", b"1")
+        fs.write_all("/b", b"2")
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "migrate", "source": "/a",
+                         "destination": "/b"})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.FAILED
+
+
+class TestPersist:
+    def test_async_persist_job(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/p", b"persist me" * 1000)  # MUST_CACHE default
+        assert not fs.get_status("/p").persisted
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "persist", "path": "/p"})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        assert fs.get_status("/p").persisted
+
+
+class TestReplicate:
+    def test_replicate_block(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/rep", b"r" * 4096)
+        fbi = cluster.fs_client().get_file_block_info_list("/rep")[0]
+        bid = fbi.block_info.block_id
+        assert len(_host_set(cluster.block_client(), bid)) == 1
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "replicate", "block_id": bid,
+                         "replicas": 1})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        assert len(_host_set(cluster.block_client(), bid)) == 2
+
+    def test_evict_block(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/ev", b"e" * 4096, write_type="CACHE_THROUGH")
+        fbi = cluster.fs_client().get_file_block_info_list("/ev")[0]
+        bid = fbi.block_info.block_id
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "evict", "block_id": bid, "replicas": 1})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        _wait_locations(cluster.block_client(), bid, lambda hosts: not hosts)
+
+
+class TestWorkflow:
+    def test_sequential_composite(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/wf-src", b"w" * 2048)
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "workflow", "jobs": [
+            {"type": "migrate", "source": "/wf-src",
+             "destination": "/wf-mid"},
+            {"type": "migrate", "source": "/wf-mid",
+             "destination": "/wf-dst"},
+        ]})
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.COMPLETED, info.error_message
+        assert fs.read_all("/wf-dst") == b"w" * 2048
+        assert len(info.children) == 2
+
+
+class TestReplicationControl:
+    def test_under_replicated_file_heals(self, cluster):
+        """set replication_min=2 -> checker fans a second copy out."""
+        fs = cluster.file_system()
+        fs.write_all("/heal", b"h" * 8192)
+        fs.set_attribute("/heal", replication_min=2)
+        fbi = cluster.fs_client().get_file_block_info_list("/heal")[0]
+        _wait_locations(cluster.block_client(), fbi.block_info.block_id,
+                        lambda hosts: len(hosts) == 2, timeout_s=10.0)
+
+    def test_over_replicated_file_trims(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/trim", b"t" * 8192, write_type="CACHE_THROUGH")
+        fbi = cluster.fs_client().get_file_block_info_list("/trim")[0]
+        bid = fbi.block_info.block_id
+        # replicate to both workers, then cap at 1
+        jc = cluster.job_client()
+        jc.wait_for_job(jc.run({"type": "replicate", "block_id": bid,
+                                "replicas": 1}))
+        _wait_locations(cluster.block_client(), bid,
+                        lambda hosts: len(hosts) == 2)
+        fs.set_attribute("/trim", replication_max=1)
+        _wait_locations(cluster.block_client(), bid,
+                        lambda hosts: len(hosts) == 1, timeout_s=10.0)
+
+    def test_lost_worker_triggers_re_replication(self, cluster):
+        """Elastic recovery (SURVEY §5.3): kill a worker holding the only
+        extra copy; the checker restores replication_min."""
+        fs = cluster.file_system()
+        fs.write_all("/elastic", b"e" * 8192)
+        fs.set_attribute("/elastic", replication_min=2)
+        fbi = cluster.fs_client().get_file_block_info_list("/elastic")[0]
+        bid = fbi.block_info.block_id
+        _wait_locations(cluster.block_client(), bid,
+                        lambda hosts: len(hosts) == 2, timeout_s=10.0)
+        # a third worker gives the checker somewhere to heal to
+        cluster.add_worker()
+        jw = None  # co-located job worker for the new block worker
+        from alluxio_tpu.job.process import make_job_worker
+
+        jw = make_job_worker(cluster.conf, cluster.job_master.address,
+                             cluster.master.address, "localhost-w2")
+        jw.start()
+        cluster.job_workers.append(jw)
+        # kill worker 1 and expire it on the master immediately
+        victim = cluster.workers[1]
+        victim_id = victim.worker.worker_id
+        victim.stop()
+        cluster.master.block_master.forget_worker(victim_id)
+        _wait_locations(
+            cluster.block_client(), bid,
+            lambda hosts: len(hosts) == 2 and "localhost-w1" not in hosts,
+            timeout_s=15.0)
+
+
+class TestJobMasterBehaviors:
+    def test_cancel_unknown_job(self, cluster):
+        from alluxio_tpu.utils.exceptions import JobDoesNotExistError
+
+        with pytest.raises(JobDoesNotExistError):
+            cluster.job_client().get_status(99999)
+
+    def test_list_jobs_and_types(self, cluster):
+        jc = cluster.job_client()
+        assert "load" in jc.list_plan_types()
+        fs = cluster.file_system()
+        fs.write_all("/lj", b"x")
+        job_id = jc.run({"type": "persist", "path": "/lj"})
+        jc.wait_for_job(job_id)
+        assert any(j.job_id == job_id for j in jc.list_jobs())
+
+    def test_bad_job_config_fails_cleanly(self, cluster):
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "load"})  # missing path
+        info = jc.wait_for_job(job_id)
+        assert info.status == Status.FAILED
+        assert "path" in info.error_message

@@ -165,3 +165,34 @@ class TestClientPageCache:
             assert metrics().counter("Client.PageCacheHits").count >= 1
         finally:
             fs2.close()
+
+
+class TestFailedWorkerRetry:
+    def test_read_fails_over_to_replica(self, tmp_path):
+        """Regression: a worker dying mid-service must not fail reads of
+        blocks that have a healthy replica elsewhere (failed-worker
+        memory + retry, reference AlluxioFileInStream :94-95)."""
+        with LocalCluster(str(tmp_path), num_workers=2,
+                          block_size=BLOCK) as c:
+            fs = c.file_system()
+            payload = b"failover" * 4096
+            fs.write_all("/fo", payload, write_type=WriteType.MUST_CACHE)
+            # copy the block to the second worker so a replica exists
+            fbis = c.fs_client().get_file_block_info_list("/fo")
+            holder_keys = {loc.address.key()
+                           for fbi in fbis
+                           for loc in fbi.block_info.locations}
+            target = next(i for i, w in enumerate(c.workers)
+                          if f"localhost:{w.port}" not in holder_keys)
+            src = next(i for i in range(len(c.workers)) if i != target)
+            for fbi in fbis:
+                bid = fbi.block_info.block_id
+                data = c.worker_client(src).read_block_bytes(bid)
+                c.worker_client(target).write_block(
+                    bid, session_id=1, data=data)
+            # kill the original holder
+            c.workers[src].stop()
+            fs2 = c.file_system()
+            assert fs2.read_all("/fo") == payload
+            fs2.close()
+            fs.close()

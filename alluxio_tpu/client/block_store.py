@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from alluxio_tpu.client.block_streams import (
     BlockInStream, BlockOutStream, GrpcBlockInStream, GrpcBlockOutStream,
@@ -95,14 +95,21 @@ class BlockStoreClient:
     # -- read ladder ---------------------------------------------------------
     def open_block(self, fbi: FileBlockInfo, *,
                    ufs_info: Optional[dict] = None,
-                   cache_cold_reads: bool = True) -> BlockInStream:
+                   cache_cold_reads: bool = True,
+                   exclude: Optional[Set[str]] = None) -> BlockInStream:
         """Build the best stream for one block
-        (reference: ``BlockInStream.create``, ``BlockInStream.java:97``)."""
+        (reference: ``BlockInStream.create``, ``BlockInStream.java:97``).
+
+        ``exclude``: worker address keys to skip for this call only (the
+        caller saw a stale location there mid-retry)."""
         info = fbi.block_info
+        exclude = exclude or set()
         local_hostname = socket.gethostname()
         # 1) short-circuit a same-host cached copy
         if self._short_circuit:
             for loc in info.locations:
+                if loc.address.key() in exclude:
+                    continue
                 if is_local_worker(loc.address, local_hostname):
                     try:
                         stream = LocalBlockInStream(
@@ -117,7 +124,8 @@ class BlockStoreClient:
         # heartbeat) self-heals server-side via read-through
         if info.locations:
             addrs = [l.address for l in info.locations
-                     if not self._is_failed(l.address.key())]
+                     if not self._is_failed(l.address.key())
+                     and l.address.key() not in exclude]
             if addrs:
                 idx = self._identity.nearest(
                     [a.tiered_identity for a in addrs])
@@ -132,7 +140,8 @@ class BlockStoreClient:
         if ufs_info is None:
             raise UnavailableError(
                 f"block {info.block_id} has no cached copy and no UFS source")
-        workers = self._live_workers()
+        workers = [w for w in self._live_workers()
+                   if w.address.key() not in exclude]
         address = self._ufs_read_policy.pick(workers, block_id=info.block_id,
                                              block_size=info.length)
         if address is None:

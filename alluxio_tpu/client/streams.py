@@ -10,13 +10,14 @@ the file on close. Write types mirror the reference
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Set
 
 from alluxio_tpu.client.block_store import BlockStoreClient
 from alluxio_tpu.client.block_streams import BlockInStream, BlockOutStream
 from alluxio_tpu.rpc.clients import FsMasterClient
 from alluxio_tpu.utils.exceptions import (
-    ConnectionFailedError, InvalidArgumentError, UnavailableError,
+    BlockDoesNotExistError, InvalidArgumentError, UnavailableError,
 )
 from alluxio_tpu.utils.wire import FileBlockInfo, FileInfo
 
@@ -112,20 +113,39 @@ class FileInStream:
         index = pos // bs
         offset_in_block = pos % bs
         last_err: Optional[Exception] = None
-        for _ in range(self._MAX_READ_ATTEMPTS):
-            stream = self._block_stream(index)
+        excluded: Set[str] = set()
+        for attempt in range(self._MAX_READ_ATTEMPTS):
+            if attempt:
+                time.sleep(0.05 * attempt)
+            try:
+                stream = self._block_stream(index, exclude=excluded)
+            except UnavailableError as e:
+                # no source yet (commit may still be propagating to the
+                # master): refresh locations and retry briefly
+                last_err = e
+                self._block_infos = None
+                continue
             readable = stream.length - offset_in_block
             if readable <= 0:
                 return b""
             try:
                 return stream.pread(offset_in_block, min(n, readable))
-            except (UnavailableError, ConnectionFailedError) as e:
+            except UnavailableError as e:
                 # serving worker died mid-read: remember it, refresh the
                 # block's locations, retry another replica / UFS fallback
                 # (reference: AlluxioFileInStream failed-worker retry,
                 # :94-95)
                 last_err = e
                 self._store.mark_failed(stream.address)
+                self._drop_current_stream()
+                self._block_infos = None
+            except BlockDoesNotExistError as e:
+                # stale location (evicted since the master's last heartbeat):
+                # the worker is healthy, so don't mark it failed — exclude it
+                # for this read only and retry another replica
+                last_err = e
+                if stream.address is not None:
+                    excluded.add(stream.address.key())
                 self._drop_current_stream()
                 self._block_infos = None
         raise last_err  # type: ignore[misc]
@@ -139,7 +159,8 @@ class FileInStream:
             self._current = None
             self._current_index = -1
 
-    def _block_stream(self, index: int) -> BlockInStream:
+    def _block_stream(self, index: int,
+                      exclude: Optional[Set[str]] = None) -> BlockInStream:
         if index == self._current_index and self._current is not None:
             return self._current
         if self._current is not None:
@@ -148,7 +169,7 @@ class FileInStream:
         fbi = self._blocks()[index]
         self._current = self._store.open_block(
             fbi, ufs_info=self._ufs_info_for(index),
-            cache_cold_reads=self._cache)
+            cache_cold_reads=self._cache, exclude=exclude)
         self._current_index = index
         return self._current
 

@@ -106,11 +106,14 @@ class BlockMasterSync(HeartbeatExecutor):
 
     def register_with_master(self) -> int:
         self.worker_id = self._client.get_worker_id(self._address)
+        # Discard the pending delta BEFORE snapshotting: an event that lands
+        # after the clear is preserved and re-sent on the next heartbeat
+        # (idempotent at the master), whereas clearing after the snapshot
+        # would silently drop any commit/evict that raced the registration.
+        self._reporter.generate_report()
         cap, used = self._store.store_meta()
         self._client.register(self.worker_id, cap, used,
                               self._store.block_report(), self._address)
-        # a fresh registration supersedes any pending delta
-        self._reporter.generate_report()
         return self.worker_id
 
     def heartbeat(self) -> None:

@@ -347,13 +347,21 @@ class BlockMaster(Journaled):
         with self._lock:
             return set(self._lost_blocks)
 
-    def capacity_bytes(self) -> int:
+    def capacity_bytes_on_tiers(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
         with self._lock:
-            return sum(w.capacity_bytes for w in self._workers.values())
+            for w in self._workers.values():
+                for tier, n in w.capacity_bytes_on_tiers.items():
+                    out[tier] = out.get(tier, 0) + n
+        return out
 
-    def used_bytes(self) -> int:
+    def used_bytes_on_tiers(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
         with self._lock:
-            return sum(w.used_bytes for w in self._workers.values())
+            for w in self._workers.values():
+                for tier, n in w.used_bytes_on_tiers.items():
+                    out[tier] = out.get(tier, 0) + n
+        return out
 
     # ---------------------------------------------------- journal contract
     def process_entry(self, entry: JournalEntry) -> bool:

@@ -577,6 +577,7 @@ class FileSystemMaster:
                 ufs_type = ufs.get_underfs_type()
                 total, used = ufs.get_space_total(), ufs.get_space_used()
             out.append(MountPointInfo(
+                alluxio_path=info.alluxio_path,
                 ufs_uri=info.ufs_uri, ufs_type=ufs_type,
                 ufs_capacity_bytes=total, ufs_used_bytes=used,
                 read_only=info.read_only, shared=info.shared,

@@ -87,7 +87,7 @@ class MasterProcess:
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
             start_time_ms=self.start_time_ms,
-            safe_mode_fn=self.in_safe_mode))
+            safe_mode_fn=self.in_safe_mode, journal=self.journal))
         self.rpc_port = self.rpc_server.start()
         return self.rpc_port
 

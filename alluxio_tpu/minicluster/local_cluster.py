@@ -21,6 +21,7 @@ from alluxio_tpu.rpc.core import RpcServer
 from alluxio_tpu.rpc.worker_service import worker_service
 from alluxio_tpu.utils.wire import TieredIdentity, WorkerNetAddress
 from alluxio_tpu.worker.process import BlockWorker
+from alluxio_tpu.worker.ufs_manager import WorkerUfsManager
 
 
 class _WorkerHandle:
@@ -95,7 +96,7 @@ class LocalCluster:
                              ufs_manager=None, address=address)
         # UFS resolution must be in place before the RPC server serves a
         # single read (a UFS-descriptor read in the gap would crash on None)
-        worker.ufs_manager = _MountFollowingUfsManager(fs_client)
+        worker.ufs_manager = WorkerUfsManager(fs_client)
         server = RpcServer(bind_host="127.0.0.1", port=0)
         server.add_service(worker_service(worker))
         port = server.start()
@@ -171,34 +172,3 @@ class LocalCluster:
         from alluxio_tpu.client.file_system import FileSystem
 
         return FileSystem(self.master.address, conf=self.conf)
-
-
-class _MountFollowingUfsManager:
-    """Worker-side UFS manager that learns mounts from the master
-    (reference: ``WorkerUfsManager`` pulls UFS info by mount id)."""
-
-    def __init__(self, fs_client: FsMasterClient) -> None:
-        from alluxio_tpu.underfs.registry import UfsManager
-
-        self._inner = UfsManager()
-        self._fs = fs_client
-
-    def get(self, mount_id: int):
-        if not self._inner.has(mount_id):
-            for mp in self._fs.get_mount_points():
-                if not self._inner.has(mp.mount_id):
-                    self._inner.add_mount(mp.mount_id, mp.ufs_uri,
-                                          mp.properties)
-        return self._inner.get(mount_id)
-
-    def has(self, mount_id: int) -> bool:
-        return self._inner.has(mount_id)
-
-    def add_mount(self, *a, **k):
-        return self._inner.add_mount(*a, **k)
-
-    def remove_mount(self, mount_id: int) -> None:
-        self._inner.remove_mount(mount_id)
-
-    def close(self) -> None:
-        self._inner.close()

@@ -171,7 +171,8 @@ class BlockMasterClient(_BaseClient):
         resp = self._call("get_worker_infos", {"include_lost": include_lost})
         return [WorkerInfo.from_wire(d) for d in resp["infos"]]
 
-    def get_capacity(self) -> Dict[str, int]:
+    def get_capacity(self) -> Dict[str, Dict[str, int]]:
+        """Returns ``{"capacity": {tier: bytes}, "used": {tier: bytes}}``."""
         return self._call("get_capacity", {})
 
 
@@ -186,6 +187,12 @@ class MetaMasterClient(_BaseClient):
 
     def get_master_info(self) -> dict:
         return self._call("get_master_info", {})
+
+    def get_metrics(self) -> Dict[str, float]:
+        return self._call("get_metrics", {})["metrics"]
+
+    def checkpoint(self) -> None:
+        self._call("checkpoint", {}, timeout=300.0)
 
 
 class WorkerClient(_BaseClient):

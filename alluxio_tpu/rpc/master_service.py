@@ -130,17 +130,19 @@ def block_master_service(bm: BlockMaster) -> ServiceDefinition:
     u("get_worker_infos", lambda r: {"infos": [
         w.to_wire() for w in bm.get_worker_infos(
             include_lost=r.get("include_lost", False))]})
-    u("get_capacity", lambda r: {"capacity": bm.capacity_bytes(),
-                                 "used": bm.used_bytes()})
+    u("get_capacity", lambda r: {"capacity": bm.capacity_bytes_on_tiers(),
+                                 "used": bm.used_bytes_on_tiers()})
     return svc
 
 
 def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         start_time_ms: int = 0,
-                        safe_mode_fn=lambda: False) -> ServiceDefinition:
-    """Config distribution + cluster info
-    (reference: ``meta_master.proto:196-211`` cluster-default config and
-    config-hash handshake, ``ConfigHashSync.java:36``)."""
+                        safe_mode_fn=lambda: False,
+                        journal=None) -> ServiceDefinition:
+    """Config distribution + cluster info + admin ops
+    (reference: ``meta_master.proto:143-211`` — cluster-default config,
+    config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
+    trigger used by ``fsadmin journal checkpoint``)."""
     svc = ServiceDefinition(META_SERVICE)
     svc.unary("get_configuration", lambda r: {
         "properties": conf.to_map(min_source=Source.SITE_PROPERTY),
@@ -149,6 +151,18 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
     svc.unary("get_master_info", lambda r: {
         "cluster_id": cluster_id, "start_time_ms": start_time_ms,
         "safe_mode": bool(safe_mode_fn())})
+    svc.unary("get_metrics", lambda r: {"metrics": metrics().snapshot()})
     svc.unary("metrics_heartbeat", lambda r: (
         metrics() and None, {})[-1])
+
+    def _checkpoint(r):
+        if journal is None:
+            from alluxio_tpu.utils.exceptions import FailedPreconditionError
+
+            raise FailedPreconditionError(
+                "this master has no journal to checkpoint")
+        journal.checkpoint()
+        return {}
+
+    svc.unary("checkpoint", _checkpoint)
     return svc

@@ -1,0 +1,11 @@
+"""Command-line shells.
+
+Re-design of the reference's CLI layer (``shell/src/main/java/alluxio/cli``):
+``fs`` (FileSystemShell, ~45 commands), ``fsadmin`` (FileSystemAdminShell),
+``job`` (JobShell), plus ``format``. Dispatch lives in
+``alluxio_tpu.shell.main`` (the ``bin/alluxio`` equivalent).
+"""
+
+from alluxio_tpu.shell.command import Command, CommandError, ShellContext
+
+__all__ = ["Command", "CommandError", "ShellContext"]

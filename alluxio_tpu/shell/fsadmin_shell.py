@@ -1,0 +1,192 @@
+"""FileSystemAdminShell: ``alluxio-tpu fsadmin <command>``.
+
+Re-design of ``shell/src/main/java/alluxio/cli/fsadmin/
+{FileSystemAdminShell.java,command/*,report/*,doctor/*}``: cluster report,
+doctor checks, journal checkpoint, and UFS listing for operators.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from alluxio_tpu.conf import Source
+from alluxio_tpu.shell.command import Command, Shell, human_size
+
+ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
+
+
+@ADMIN_SHELL.register
+class ReportCommand(Command):
+    name = "report"
+    description = "Report cluster summary|capacity|ufs|metrics."
+
+    def configure(self, p):
+        p.add_argument("category", nargs="?", default="summary",
+                       choices=["summary", "capacity", "ufs", "metrics"])
+
+    def run(self, args, ctx):
+        return getattr(self, f"_{args.category}")(ctx)
+
+    def _summary(self, ctx):
+        info = ctx.meta_client().get_master_info()
+        cap = ctx.block_client().get_capacity()
+        workers = ctx.block_client().get_worker_infos()
+        started = time.strftime(
+            "%m-%d-%Y %H:%M:%S",
+            time.localtime(info.get("start_time_ms", 0) / 1000))
+        uptime_s = max(0, time.time() - info.get("start_time_ms", 0) / 1000)
+        ctx.print("Alluxio-TPU cluster summary:")
+        ctx.print(f"    Master Address: {ctx.master_address}")
+        ctx.print(f"    Cluster Id: {info.get('cluster_id', '')}")
+        ctx.print(f"    Started: {started}")
+        ctx.print(f"    Uptime: {int(uptime_s)}s")
+        ctx.print(f"    Safe Mode: {info.get('safe_mode', False)}")
+        ctx.print(f"    Live Workers: {len(workers)}")
+        total = sum(cap["capacity"].values())
+        used = sum(cap["used"].values())
+        ctx.print(f"    Total Capacity: {human_size(total)}")
+        for tier, n in sorted(cap["capacity"].items()):
+            ctx.print(f"        Tier: {tier}  Size: {human_size(n)}")
+        ctx.print(f"    Used Capacity: {human_size(used)}")
+        for tier, n in sorted(cap["used"].items()):
+            ctx.print(f"        Tier: {tier}  Size: {human_size(n)}")
+        pct = (100.0 * used / total) if total else 0.0
+        ctx.print(f"    Free Capacity: {human_size(total - used)} "
+                  f"({100 - pct:.1f}% free)")
+        return 0
+
+    def _capacity(self, ctx):
+        workers = ctx.block_client().get_worker_infos(include_lost=True)
+        ctx.print(f"{'Worker Name':<28s} {'Last Heartbeat':>14s} "
+                  f"{'Storage':>9s} {'Total':>12s} {'Used':>12s} "
+                  f"{'State':>8s}")
+        for w in workers:
+            first = True
+            tiers = sorted(set(list(w.capacity_bytes_on_tiers)
+                               + list(w.used_bytes_on_tiers)))
+            for tier in tiers or ["-"]:
+                total = w.capacity_bytes_on_tiers.get(tier, 0)
+                used = w.used_bytes_on_tiers.get(tier, 0)
+                namecol = (f"{w.address.host}:{w.address.rpc_port}"
+                           if first else "")
+                ctx.print(f"{namecol:<28s} "
+                          f"{w.last_contact_ms if first else '':>14} "
+                          f"{tier:>9s} {human_size(total):>12s} "
+                          f"{human_size(used):>12s} "
+                          f"{(w.state if first else ''):>8}")
+                first = False
+        return 0
+
+    def _ufs(self, ctx):
+        for m in ctx.fs_client().get_mount_points():
+            props = " ".join(f"{k}={v}" for k, v in m.properties.items())
+            flags = []
+            if m.read_only:
+                flags.append("readonly")
+            if m.shared:
+                flags.append("shared")
+            ctx.print(f"{m.ufs_uri} on {m.alluxio_path} "
+                      f"(type={m.ufs_type or 'unknown'}"
+                      + (", " + ", ".join(flags) if flags else "")
+                      + (f", {props}" if props else "") + ")")
+        return 0
+
+    def _metrics(self, ctx):
+        snap = ctx.meta_client().get_metrics()
+        for k in sorted(snap):
+            ctx.print(f"{k}  {snap[k]}")
+        return 0
+
+
+@ADMIN_SHELL.register
+class DoctorCommand(Command):
+    name = "doctor"
+    description = "Show configuration and cluster health warnings."
+
+    def configure(self, p):
+        p.add_argument("category", nargs="?", default="configuration",
+                       choices=["configuration"])
+
+    def run(self, args, ctx):
+        server_conf = ctx.meta_client().get_configuration()
+        server = server_conf.get("properties", {})
+        local = ctx.conf.to_map(min_source=Source.SITE_PROPERTY)
+        issues = 0
+        for key, val in sorted(server.items()):
+            mine = local.get(key)
+            if mine is not None and str(mine) != str(val):
+                ctx.print(f"WARN: {key} differs: server='{val}' "
+                          f"client='{mine}'")
+                issues += 1
+        if server_conf.get("hash") != ctx.conf.hash():
+            ctx.print("INFO: client configuration hash differs from the "
+                      "cluster default (expected when overrides are set)")
+        if issues == 0:
+            ctx.print("No server-/client-side configuration conflicts found.")
+        return 0
+
+
+@ADMIN_SHELL.register
+class JournalCommand(Command):
+    name = "journal"
+    description = "Journal operations: checkpoint."
+
+    def configure(self, p):
+        p.add_argument("op", choices=["checkpoint"])
+
+    def run(self, args, ctx):
+        if args.op == "checkpoint":
+            ctx.meta_client().checkpoint()
+            ctx.print("Successfully took a checkpoint on the primary master")
+        return 0
+
+
+@ADMIN_SHELL.register
+class GetConfCommand(Command):
+    name = "getConf"
+    description = "Print cluster configuration (optionally one key)."
+
+    def configure(self, p):
+        p.add_argument("--source", action="store_true",
+                       help="also print each property's source")
+        p.add_argument("key", nargs="?")
+
+    def run(self, args, ctx):
+        props = ctx.meta_client().get_configuration()["properties"]
+        if args.key:
+            if args.key in props:
+                ctx.print(props[args.key])
+                return 0
+            try:
+                v = ctx.conf.get(args.key)
+            except KeyError:
+                v = None
+            if v is None:
+                ctx.eprint(f"{args.key} is not set")
+                return 1
+            ctx.print(v)
+            return 0
+        for k in sorted(props):
+            ctx.print(f"{k}={props[k]}")
+        return 0
+
+
+@ADMIN_SHELL.register
+class MetricsCommand(Command):
+    name = "metrics"
+    description = "Print master metrics matching an optional filter."
+
+    def configure(self, p):
+        p.add_argument("filter", nargs="?", default="")
+
+    def run(self, args, ctx):
+        snap = ctx.meta_client().get_metrics()
+        for k in sorted(snap):
+            if args.filter in k:
+                ctx.print(f"{k}  {snap[k]}")
+        return 0
+
+
+def main(argv=None) -> int:
+    return ADMIN_SHELL.run(sys.argv[1:] if argv is None else argv)

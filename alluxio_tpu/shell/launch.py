@@ -1,0 +1,123 @@
+"""Foreground process launchers.
+
+Re-design of the reference's role mains (``master/AlluxioMaster.java:35``,
+``worker/AlluxioWorker.java:44``, ``master/AlluxioJobMasterProcess.java``,
+``proxy/AlluxioProxy.java:37``) plus ``bin/alluxio-start.sh``'s
+launch-process: build the process from global config, serve until
+SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import socket
+import threading
+
+from alluxio_tpu.conf import Configuration, Keys
+
+LOG = logging.getLogger(__name__)
+
+
+def _serve_until_signal(stop_fn, banner: str) -> int:
+    done = threading.Event()
+
+    def _handler(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGINT, _handler)
+    signal.signal(signal.SIGTERM, _handler)
+    LOG.info("%s", banner)
+    print(banner, flush=True)
+    done.wait()
+    stop_fn()
+    return 0
+
+
+def launch_master(conf: Configuration) -> int:
+    from alluxio_tpu.master.process import MasterProcess
+
+    proc = MasterProcess(conf)
+    port = proc.start()
+    return _serve_until_signal(
+        proc.stop, f"alluxio-tpu master serving on port {port}")
+
+
+def launch_worker(conf: Configuration) -> int:
+    from alluxio_tpu.rpc.clients import BlockMasterClient, FsMasterClient
+    from alluxio_tpu.rpc.core import RpcServer
+    from alluxio_tpu.rpc.worker_service import worker_service
+    from alluxio_tpu.worker.process import BlockWorker
+    from alluxio_tpu.worker.ufs_manager import WorkerUfsManager
+
+    master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
+                   f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+    fs_client = FsMasterClient(master_addr)
+    worker = BlockWorker(conf, BlockMasterClient(master_addr), fs_client)
+    worker.ufs_manager = WorkerUfsManager(fs_client)
+    server = RpcServer(bind_host="0.0.0.0",
+                       port=conf.get_int(Keys.WORKER_RPC_PORT))
+    server.add_service(worker_service(worker))
+    port = server.start()
+    worker.address.rpc_port = port
+    worker.address.data_port = port
+    worker.start()
+
+    def stop():
+        worker.stop()
+        server.stop()
+
+    return _serve_until_signal(
+        stop, f"alluxio-tpu worker serving on port {port}")
+
+
+def launch_job_master(conf: Configuration) -> int:
+    from alluxio_tpu.job.process import JobMasterProcess
+
+    master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
+                   f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+    proc = JobMasterProcess(conf, master_addr)
+    port = proc.start()
+    return _serve_until_signal(
+        proc.stop, f"alluxio-tpu job master serving on port {port}")
+
+
+def launch_job_worker(conf: Configuration) -> int:
+    from alluxio_tpu.job.process import make_job_worker
+
+    master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
+                   f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+    job_master_addr = (f"{conf.get(Keys.JOB_MASTER_HOSTNAME)}:"
+                       f"{conf.get_int(Keys.JOB_MASTER_RPC_PORT)}")
+    jw = make_job_worker(conf, job_master_addr, master_addr,
+                         socket.gethostname())
+    jw.start()
+    return _serve_until_signal(jw.stop, "alluxio-tpu job worker running")
+
+
+def launch_proxy(conf: Configuration) -> int:
+    try:
+        from alluxio_tpu.proxy.process import ProxyProcess
+    except ImportError:
+        print("proxy process is not available in this build")
+        return 1
+    proc = ProxyProcess(conf)
+    port = proc.start()
+    return _serve_until_signal(
+        proc.stop, f"alluxio-tpu proxy serving on port {port}")
+
+
+_LAUNCHERS = {
+    "master": launch_master,
+    "worker": launch_worker,
+    "job-master": launch_job_master,
+    "job-worker": launch_job_worker,
+    "proxy": launch_proxy,
+}
+
+
+def launch_process(role: str, conf: Configuration) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    return _LAUNCHERS[role](conf)

@@ -1,0 +1,104 @@
+"""Top-level CLI dispatch: ``python -m alluxio_tpu.shell.main <shell> ...``.
+
+Re-design of ``bin/alluxio`` (the bash dispatcher): routes to the fs,
+fsadmin, job shells, ``format``, and the process launchers. Generic
+options: ``--master host:port``, ``--job-master host:port``,
+``-D key=value`` config overrides.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.shell.command import ShellContext
+
+USAGE = """\
+Usage: alluxio-tpu [generic options] <command> [command args]
+
+Commands:
+  fs         file system user shell (ls/cat/cp/pin/...)
+  fsadmin    administration shell (report/doctor/journal/...)
+  job        job service shell (ls/stat/cancel)
+  format     format master journal / worker storage
+  master     run a master process
+  worker     run a worker process
+  job-master run a job master process
+  job-worker run a job worker process
+  proxy      run the REST/S3 proxy process
+  version    print the version
+
+Generic options:
+  --master host:port      metadata master address
+  --job-master host:port  job master address
+  -D key=value            set a configuration property
+"""
+
+
+def _parse_generic(argv: List[str], conf: Configuration) -> List[str]:
+    rest: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--master" and i + 1 < len(argv):
+            host, _, port = argv[i + 1].rpartition(":")
+            conf.set(Keys.MASTER_HOSTNAME, host or "localhost")
+            conf.set(Keys.MASTER_RPC_PORT, int(port))
+            i += 2
+        elif a == "--job-master" and i + 1 < len(argv):
+            host, _, port = argv[i + 1].rpartition(":")
+            conf.set(Keys.JOB_MASTER_HOSTNAME, host or "localhost")
+            conf.set(Keys.JOB_MASTER_RPC_PORT, int(port))
+            i += 2
+        elif a == "-D" and i + 1 < len(argv):
+            k, _, v = argv[i + 1].partition("=")
+            conf.set(k, v)
+            i += 2
+        else:
+            rest.append(a)
+            i += 1
+    return rest
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    conf = Configuration()
+    argv = _parse_generic(argv, conf)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(USAGE)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    ctx = ShellContext(conf)
+    if cmd == "fs":
+        from alluxio_tpu.shell.fs_shell import FS_SHELL
+
+        return FS_SHELL.run(rest, ctx)
+    if cmd == "fsadmin":
+        from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+
+        return ADMIN_SHELL.run(rest, ctx)
+    if cmd == "job":
+        from alluxio_tpu.shell.job_shell import JOB_SHELL
+
+        return JOB_SHELL.run(rest, ctx)
+    if cmd == "format":
+        from alluxio_tpu.shell.format import main as format_main
+
+        return format_main(rest)
+    if cmd == "version":
+        import alluxio_tpu
+
+        print(getattr(alluxio_tpu, "__version__", "0.1.0"))
+        return 0
+    if cmd in ("master", "worker", "job-master", "job-worker", "proxy"):
+        from alluxio_tpu.shell.launch import launch_process
+
+        return launch_process(cmd, conf)
+    print(f"Unknown command: {cmd}", file=sys.stderr)
+    print(USAGE, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

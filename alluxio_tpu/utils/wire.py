@@ -239,6 +239,7 @@ _NESTED[("WorkerInfo", "address")] = WorkerNetAddress
 @_wire_dataclass
 @dataclass
 class MountPointInfo:
+    alluxio_path: str = ""
     ufs_uri: str = ""
     ufs_type: str = ""
     ufs_capacity_bytes: int = -1

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Start cluster roles in the background (reference: bin/alluxio-start.sh).
+# Usage: bin/alluxio-tpu-start.sh <master|worker|job_master|job_worker|proxy|local>
+# `local` starts master + worker + job master + job worker on this host.
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+LOG_DIR="${ALLUXIO_TPU_LOGS_DIR:-/tmp/alluxio-tpu-logs}"
+PID_DIR="${ALLUXIO_TPU_PID_DIR:-/tmp/alluxio-tpu-pids}"
+mkdir -p "${LOG_DIR}" "${PID_DIR}"
+
+start_role() {
+  local role="$1"
+  local cli_role="${role//_/-}"
+  nohup "${SCRIPT_DIR}/alluxio-tpu" "${cli_role}" \
+    >"${LOG_DIR}/${role}.out" 2>&1 &
+  echo $! > "${PID_DIR}/${role}.pid"
+  echo "Started ${role} (pid $(cat "${PID_DIR}/${role}.pid")), logs in ${LOG_DIR}/${role}.out"
+}
+
+case "${1:-}" in
+  master|worker|job_master|job_worker|proxy) start_role "$1" ;;
+  local)
+    start_role master; sleep 2
+    start_role worker
+    start_role job_master; sleep 1
+    start_role job_worker
+    ;;
+  *) echo "Usage: $0 <master|worker|job_master|job_worker|proxy|local>"; exit 1 ;;
+esac

@@ -1,0 +1,245 @@
+"""CLI shell tests (reference: ``tests/src/test/java/alluxio/client/cli/**``
+golden tests): drive fs/fsadmin/job commands against a LocalCluster and
+assert on output + exit codes."""
+
+from __future__ import annotations
+
+import io
+import os
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+from alluxio_tpu.shell.command import ShellContext
+from alluxio_tpu.shell.fs_shell import FS_SHELL
+from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+from alluxio_tpu.shell.job_shell import JOB_SHELL
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1,
+                      start_job_service=True,
+                      start_worker_heartbeats=True) as c:
+        yield c
+
+
+def run_shell(shell, cluster, argv):
+    conf = cluster.conf.copy()
+    conf.set(Keys.MASTER_HOSTNAME, "localhost")
+    conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+    if cluster.job_master is not None:
+        conf.set(Keys.JOB_MASTER_HOSTNAME, "localhost")
+        conf.set(Keys.JOB_MASTER_RPC_PORT, cluster.job_master.rpc_port)
+    out, err = io.StringIO(), io.StringIO()
+    ctx = ShellContext(conf, out=out, err=err)
+    code = shell.run(argv, ctx)
+    return code, out.getvalue(), err.getvalue()
+
+
+class TestFsShell:
+    def test_mkdir_ls_rm(self, cluster):
+        code, out, _ = run_shell(FS_SHELL, cluster, ["mkdir", "/a/b"])
+        assert code == 0 and "/a/b" in out
+        code, out, _ = run_shell(FS_SHELL, cluster, ["ls", "/a"])
+        assert code == 0 and "/a/b" in out
+        code, out, _ = run_shell(FS_SHELL, cluster, ["rm", "-R", "/a"])
+        assert code == 0
+        code, _, err = run_shell(FS_SHELL, cluster, ["ls", "/a"])
+        assert code == 1 and "DoesNotExist" in err
+
+    def test_touch_cat_head_tail(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/f", b"hello world")
+        code, out, _ = run_shell(FS_SHELL, cluster, ["cat", "/f"])
+        assert code == 0 and out == "hello world"
+        code, out, _ = run_shell(FS_SHELL, cluster, ["head", "-c", "5", "/f"])
+        assert out == "hello"
+        code, out, _ = run_shell(FS_SHELL, cluster, ["tail", "-c", "5", "/f"])
+        assert out == "world"
+        code, out, _ = run_shell(FS_SHELL, cluster, ["touch", "/empty"])
+        assert code == 0 and fs.get_status("/empty").length == 0
+
+    def test_glob_expansion(self, cluster):
+        fs = cluster.file_system()
+        for name in ("x1", "x2", "y1"):
+            fs.write_all(f"/g/{name}", b"d")
+        code, out, _ = run_shell(FS_SHELL, cluster, ["ls", "/g/x*"])
+        assert code == 0
+        assert "/g/x1" in out and "/g/x2" in out and "/g/y1" not in out
+
+    def test_cp_and_mv(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/src/f", b"data" * 100)
+        code, _, _ = run_shell(FS_SHELL, cluster, ["cp", "-R", "/src", "/cp"])
+        assert code == 0 and fs.read_all("/cp/f") == b"data" * 100
+        code, _, _ = run_shell(FS_SHELL, cluster, ["mv", "/cp", "/moved"])
+        assert code == 0 and fs.exists("/moved/f") and not fs.exists("/cp")
+
+    def test_local_copies(self, cluster, tmp_path):
+        local = tmp_path / "local.bin"
+        local.write_bytes(b"local-data")
+        code, _, _ = run_shell(
+            FS_SHELL, cluster, ["copyFromLocal", str(local), "/in"])
+        assert code == 0
+        assert cluster.file_system().read_all("/in") == b"local-data"
+        dest = tmp_path / "out.bin"
+        code, _, _ = run_shell(
+            FS_SHELL, cluster, ["copyToLocal", "/in", str(dest)])
+        assert code == 0 and dest.read_bytes() == b"local-data"
+
+    def test_stat_test_checksum_count_du(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/d/f1", b"abc")
+        fs.write_all("/d/f2", b"defgh")
+        code, out, _ = run_shell(FS_SHELL, cluster,
+                                 ["stat", "-f", "%z", "/d/f1"])
+        assert code == 0 and out.strip() == "3"
+        assert run_shell(FS_SHELL, cluster, ["test", "-f", "/d/f1"])[0] == 0
+        assert run_shell(FS_SHELL, cluster, ["test", "-d", "/d/f1"])[0] == 1
+        assert run_shell(FS_SHELL, cluster, ["test", "-e", "/nope"])[0] == 1
+        code, out, _ = run_shell(FS_SHELL, cluster, ["checksum", "/d/f1"])
+        assert "900150983cd24fb0d6963f7d28e17f72" in out  # md5("abc")
+        code, out, _ = run_shell(FS_SHELL, cluster, ["count", "/d"])
+        assert code == 0 and "2" in out and "8" in out
+        code, out, _ = run_shell(FS_SHELL, cluster, ["du", "/d"])
+        assert code == 0 and "/d/f1" in out and "/d/f2" in out
+
+    def test_attribute_commands(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/attr", b"x")
+        assert run_shell(FS_SHELL, cluster, ["pin", "/attr"])[0] == 0
+        assert fs.get_status("/attr").pinned
+        assert run_shell(FS_SHELL, cluster, ["unpin", "/attr"])[0] == 0
+        assert not fs.get_status("/attr").pinned
+        assert run_shell(FS_SHELL, cluster,
+                         ["setTtl", "/attr", "60000"])[0] == 0
+        assert fs.get_status("/attr").ttl == 60000
+        assert run_shell(FS_SHELL, cluster, ["unsetTtl", "/attr"])[0] == 0
+        assert fs.get_status("/attr").ttl == -1
+        assert run_shell(FS_SHELL, cluster,
+                         ["chmod", "600", "/attr"])[0] == 0
+        assert fs.get_status("/attr").mode == 0o600
+        assert run_shell(FS_SHELL, cluster,
+                         ["chown", "alice:team", "/attr"])[0] == 0
+        info = fs.get_status("/attr")
+        assert info.owner == "alice" and info.group == "team"
+        assert run_shell(FS_SHELL, cluster,
+                         ["setReplication", "--min", "1", "/attr"])[0] == 0
+        assert fs.get_status("/attr").replication_min == 1
+
+    def test_capacity_and_location(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/loc", b"z" * 1000)
+        code, out, _ = run_shell(FS_SHELL, cluster, ["getCapacityBytes"])
+        assert code == 0 and int(out.strip()) > 0
+        code, out, _ = run_shell(FS_SHELL, cluster, ["getUsedBytes"])
+        assert code == 0 and int(out.strip()) >= 1000
+        code, out, _ = run_shell(FS_SHELL, cluster, ["location", "/loc"])
+        assert code == 0 and "block" in out
+
+    def test_free_and_load(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/warm", b"w" * 4096, write_type="CACHE_THROUGH")
+        assert run_shell(FS_SHELL, cluster, ["free", "/warm"])[0] == 0
+        assert run_shell(FS_SHELL, cluster, ["load", "/warm"])[0] == 0
+        assert fs.read_all("/warm") == b"w" * 4096
+
+    def test_distributed_commands(self, cluster):
+        fs = cluster.file_system()
+        for i in range(3):
+            fs.write_all(f"/dist/f{i}", b"d" * 256)
+        code, out, _ = run_shell(
+            FS_SHELL, cluster, ["distributedCp", "/dist", "/dist2"])
+        assert code == 0, out
+        assert fs.read_all("/dist2/f1") == b"d" * 256
+        code, out, _ = run_shell(
+            FS_SHELL, cluster, ["distributedMv", "/dist2", "/dist3"])
+        assert code == 0, out
+        assert fs.exists("/dist3/f1") and not fs.exists("/dist2/f1")
+
+    def test_mount_table_and_master_info(self, cluster):
+        code, out, _ = run_shell(FS_SHELL, cluster, ["mount"])
+        assert code == 0 and " on /" in out
+        code, out, _ = run_shell(FS_SHELL, cluster, ["masterInfo"])
+        assert code == 0 and "cluster_id" in out
+        code, out, _ = run_shell(FS_SHELL, cluster, ["leader"])
+        assert code == 0 and str(cluster.master.rpc_port) in out
+
+    def test_help_and_unknown(self, cluster):
+        code, out, _ = run_shell(FS_SHELL, cluster, [])
+        assert code == 0 and "ls" in out and "cat" in out
+        code, _, err = run_shell(FS_SHELL, cluster, ["frobnicate"])
+        assert code == 1 and "not a valid command" in err
+
+
+class TestAdminShell:
+    def test_report_summary(self, cluster):
+        code, out, _ = run_shell(ADMIN_SHELL, cluster, ["report"])
+        assert code == 0
+        assert "Live Workers: 1" in out and "Total Capacity" in out
+
+    def test_report_capacity_ufs_metrics(self, cluster):
+        cluster.file_system().write_all("/m", b"x")
+        code, out, _ = run_shell(ADMIN_SHELL, cluster,
+                                 ["report", "capacity"])
+        assert code == 0 and "Worker Name" in out
+        code, out, _ = run_shell(ADMIN_SHELL, cluster, ["report", "ufs"])
+        assert code == 0 and " on /" in out
+        code, out, _ = run_shell(ADMIN_SHELL, cluster,
+                                 ["report", "metrics"])
+        assert code == 0 and "Master.rpc" in out
+
+    def test_doctor_and_getconf(self, cluster):
+        code, out, _ = run_shell(ADMIN_SHELL, cluster, ["doctor"])
+        assert code == 0
+        code, out, _ = run_shell(ADMIN_SHELL, cluster, ["getConf"])
+        assert code == 0
+        code, out, _ = run_shell(
+            ADMIN_SHELL, cluster, ["getConf", "atpu.master.hostname"])
+        assert code == 0 and out.strip() != ""
+
+    def test_journal_checkpoint(self, cluster):
+        fs = cluster.file_system()
+        for i in range(5):
+            fs.write_all(f"/ckpt/f{i}", b"x")
+        code, out, _ = run_shell(ADMIN_SHELL, cluster,
+                                 ["journal", "checkpoint"])
+        assert code == 0 and "checkpoint" in out.lower()
+
+
+class TestJobShell:
+    def test_ls_stat_cancel(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/j/f", b"j" * 128)
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "migrate", "source": "/j/f",
+                         "destination": "/j/g"})
+        jc.wait_for_job(job_id)
+        code, out, _ = run_shell(JOB_SHELL, cluster, ["ls"])
+        assert code == 0 and str(job_id) in out
+        code, out, _ = run_shell(JOB_SHELL, cluster,
+                                 ["stat", "-v", str(job_id)])
+        assert code == 0 and "COMPLETED" in out
+        code, out, _ = run_shell(JOB_SHELL, cluster, ["leader"])
+        assert code == 0
+
+
+class TestFormat:
+    def test_format_wipes_dirs(self, tmp_path):
+        from alluxio_tpu.conf import Configuration
+        from alluxio_tpu.shell.format import format_master, format_worker
+
+        conf = Configuration(load_env=False)
+        journal = tmp_path / "journal"
+        journal.mkdir()
+        (journal / "seg1").write_text("x")
+        conf.set(Keys.MASTER_JOURNAL_FOLDER, str(journal))
+        conf.set(Keys.WORKER_DATA_FOLDER, str(tmp_path / "wdata"))
+        conf.set(Keys.WORKER_SHM_DIR, str(tmp_path / "shm"))
+        buf = io.StringIO()
+        format_master(conf, out=buf)
+        assert os.listdir(journal) == []
+        format_worker(conf, out=buf)
+        assert (tmp_path / "wdata").is_dir()

@@ -28,6 +28,18 @@ def _each(fs, args_paths):
             yield p
 
 
+_PUMP_CHUNK = 4 << 20
+
+
+def _pump(fin, fout) -> None:
+    """Stream fin -> fout in chunks (both alluxio and local file objects)."""
+    while True:
+        chunk = fin.read(_PUMP_CHUNK)
+        if not chunk:
+            break
+        fout.write(chunk)
+
+
 def _walk_files(fs, path):
     """Yield FileInfo of every file under path (path itself if a file)."""
     info = fs.get_status(path)
@@ -185,6 +197,14 @@ class MvCommand(Command):
         return 0
 
 
+def _resolve_into_dir(fs, src: str, dst: str) -> str:
+    """cp semantics: copying INTO an existing directory targets
+    dst/<basename(src)>."""
+    if fs.exists(dst) and fs.get_status(dst).folder:
+        return AlluxioURI(dst).join(AlluxioURI(src).name).path
+    return dst
+
+
 def _copy_tree(fs, src: str, dst: str, ctx) -> None:
     info = fs.get_status(src)
     if info.folder:
@@ -194,11 +214,7 @@ def _copy_tree(fs, src: str, dst: str, ctx) -> None:
                        AlluxioURI(dst).join(child.name).path, ctx)
         return
     with fs.open_file(src) as fin, fs.create_file(dst) as fout:
-        while True:
-            chunk = fin.read(4 << 20)
-            if not chunk:
-                break
-            fout.write(chunk)
+        _pump(fin, fout)
     ctx.print(f"Copied {src} to {dst}")
 
 
@@ -223,11 +239,17 @@ class CpCommand(Command):
         elif dst_local:
             _to_local(fs, args.src, args.dst[len("file://"):], ctx)
         else:
-            for p in expand_globs(fs, args.src):
+            matches = expand_globs(fs, args.src)
+            if len(matches) > 1 and not (
+                    fs.exists(args.dst) and fs.get_status(args.dst).folder):
+                raise CommandError(
+                    f"target {args.dst} must be an existing directory when "
+                    f"copying multiple sources")
+            for p in matches:
                 info = fs.get_status(p)
                 if info.folder and not args.recursive:
                     raise CommandError(f"{p} is a directory (use -R)")
-                _copy_tree(fs, p, args.dst, ctx)
+                _copy_tree(fs, p, _resolve_into_dir(fs, p, args.dst), ctx)
         return 0
 
 
@@ -241,11 +263,7 @@ def _from_local(fs, local: str, remote: str, ctx) -> None:
     if fs.exists(remote) and fs.get_status(remote).folder:
         remote = AlluxioURI(remote).join(os.path.basename(local)).path
     with open(local, "rb") as fin, fs.create_file(remote) as fout:
-        while True:
-            chunk = fin.read(4 << 20)
-            if not chunk:
-                break
-            fout.write(chunk)
+        _pump(fin, fout)
     ctx.print(f"Copied file://{local} to {remote}")
 
 
@@ -259,11 +277,7 @@ def _to_local(fs, remote: str, local: str, ctx) -> None:
     if os.path.isdir(local):
         local = os.path.join(local, AlluxioURI(remote).name)
     with fs.open_file(remote) as fin, open(local, "wb") as fout:
-        while True:
-            chunk = fin.read(4 << 20)
-            if not chunk:
-                break
-            fout.write(chunk)
+        _pump(fin, fout)
     ctx.print(f"Copied {remote} to file://{local}")
 
 
@@ -639,6 +653,9 @@ class MountCommand(Command):
             return 0
         if args.ufs_uri is None:
             raise CommandError("usage: mount [options] <path> <ufs-uri>")
+        for o in args.option:
+            if "=" not in o:
+                raise CommandError(f"--option must be key=value, got {o!r}")
         props = dict(o.split("=", 1) for o in args.option)
         fs.mount(args.path, args.ufs_uri, read_only=args.readonly,
                  shared=args.shared, properties=props or None)

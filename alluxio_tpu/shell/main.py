@@ -36,20 +36,38 @@ Generic options:
 """
 
 
+class GenericOptionError(Exception):
+    """Bad generic option; message is the usage error."""
+
+
+def _split_host_port(value: str, flag: str,
+                     default_port: int) -> "tuple[str, int]":
+    host, sep, port = value.rpartition(":")
+    if not sep:
+        return value, default_port
+    if not port.isdigit():
+        raise GenericOptionError(
+            f"{flag} expects host:port, got {value!r}")
+    return host or "localhost", int(port)
+
+
 def _parse_generic(argv: List[str], conf: Configuration) -> List[str]:
     rest: List[str] = []
     i = 0
     while i < len(argv):
         a = argv[i]
         if a == "--master" and i + 1 < len(argv):
-            host, _, port = argv[i + 1].rpartition(":")
-            conf.set(Keys.MASTER_HOSTNAME, host or "localhost")
-            conf.set(Keys.MASTER_RPC_PORT, int(port))
+            host, port = _split_host_port(
+                argv[i + 1], "--master", conf.get_int(Keys.MASTER_RPC_PORT))
+            conf.set(Keys.MASTER_HOSTNAME, host)
+            conf.set(Keys.MASTER_RPC_PORT, port)
             i += 2
         elif a == "--job-master" and i + 1 < len(argv):
-            host, _, port = argv[i + 1].rpartition(":")
-            conf.set(Keys.JOB_MASTER_HOSTNAME, host or "localhost")
-            conf.set(Keys.JOB_MASTER_RPC_PORT, int(port))
+            host, port = _split_host_port(
+                argv[i + 1], "--job-master",
+                conf.get_int(Keys.JOB_MASTER_RPC_PORT))
+            conf.set(Keys.JOB_MASTER_HOSTNAME, host)
+            conf.set(Keys.JOB_MASTER_RPC_PORT, port)
             i += 2
         elif a == "-D" and i + 1 < len(argv):
             k, _, v = argv[i + 1].partition("=")
@@ -64,7 +82,11 @@ def _parse_generic(argv: List[str], conf: Configuration) -> List[str]:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     conf = Configuration()
-    argv = _parse_generic(argv, conf)
+    try:
+        argv = _parse_generic(argv, conf)
+    except GenericOptionError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(USAGE)
         return 0

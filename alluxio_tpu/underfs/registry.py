@@ -73,6 +73,26 @@ def _register_optional() -> None:
         from alluxio_tpu.underfs.gcs import GcsUnderFileSystem
 
         register_factory("gs", GcsUnderFileSystem)
+        register_factory("gcs", GcsUnderFileSystem)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from alluxio_tpu.underfs import s3_compat
+
+        for cls in (s3_compat.OssUnderFileSystem,
+                    s3_compat.CosUnderFileSystem,
+                    s3_compat.KodoUnderFileSystem,
+                    s3_compat.SwiftUnderFileSystem,
+                    s3_compat.ObsUnderFileSystem):
+            for scheme in cls.schemes:
+                register_factory(scheme, cls)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        # needs a working libhdfs (HADOOP_HOME); probe at registration time
+        from alluxio_tpu.underfs.hdfs import HdfsUnderFileSystem
+
+        register_factory("hdfs", HdfsUnderFileSystem)
     except Exception:  # noqa: BLE001
         pass
 

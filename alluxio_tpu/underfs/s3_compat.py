@@ -1,0 +1,82 @@
+"""S3-compatible connectors for the other cloud object stores.
+
+The reference ships per-vendor SDK connectors (``underfs/{oss,cos,kodo,
+swift}`` — Alibaba OSS, Tencent COS, Qiniu Kodo, OpenStack Swift). All four
+services expose S3-compatible REST gateways, so the TPU build serves them
+through the SigV4 client with vendor-specific endpoint defaults instead of
+four SDK dependencies. Properties mirror the s3 connector with a vendor
+prefix (e.g. ``oss.endpoint``, ``cos.access.key``) and fall back to the
+``s3.*`` names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from alluxio_tpu.underfs.s3 import S3Client, S3UnderFileSystem
+
+
+def _remap(prefix: str, properties: Optional[Dict[str, str]],
+           default_endpoint: str = "") -> Dict[str, str]:
+    props = dict(properties or {})
+    for suffix in ("endpoint", "access.key", "secret.key", "region",
+                   "path.style", "multipart.size"):
+        v = props.get(f"{prefix}.{suffix}", props.get(f"s3.{suffix}"))
+        if v is not None:
+            props[f"s3.{suffix}"] = v
+    if "s3.endpoint" not in props and default_endpoint:
+        props["s3.endpoint"] = default_endpoint
+    return props
+
+
+class _CompatUfs(S3UnderFileSystem):
+    vendor_prefix = "s3"
+    default_endpoint = ""
+
+    def _make_client(self, bucket: str,
+                     properties: Optional[Dict[str, str]]) -> S3Client:
+        return S3Client(bucket, _remap(self.vendor_prefix, properties,
+                                       self.default_endpoint))
+
+
+class OssUnderFileSystem(_CompatUfs):
+    """``oss://bucket/...`` via Alibaba OSS's S3-compatible API
+    (reference: ``underfs/oss``)."""
+
+    schemes = ("oss",)
+    vendor_prefix = "oss"
+    default_endpoint = "https://oss-cn-hangzhou.aliyuncs.com"
+
+
+class CosUnderFileSystem(_CompatUfs):
+    """``cos://bucket/...`` via Tencent COS's S3-compatible API
+    (reference: ``underfs/cos``)."""
+
+    schemes = ("cos", "cosn")
+    vendor_prefix = "cos"
+    default_endpoint = "https://cos.ap-guangzhou.myqcloud.com"
+
+
+class KodoUnderFileSystem(_CompatUfs):
+    """``kodo://bucket/...`` via Qiniu Kodo's S3-compatible API
+    (reference: ``underfs/kodo``)."""
+
+    schemes = ("kodo",)
+    vendor_prefix = "kodo"
+    default_endpoint = "https://s3-cn-east-1.qiniucs.com"
+
+
+class SwiftUnderFileSystem(_CompatUfs):
+    """``swift://container/...`` via an OpenStack Swift S3-middleware
+    endpoint (reference: ``underfs/swift``)."""
+
+    schemes = ("swift",)
+    vendor_prefix = "swift"
+
+
+class ObsUnderFileSystem(_CompatUfs):
+    """``obs://bucket/...`` via Huawei OBS's S3-compatible API."""
+
+    schemes = ("obs",)
+    vendor_prefix = "obs"
+    default_endpoint = "https://obs.cn-north-1.myhuaweicloud.com"

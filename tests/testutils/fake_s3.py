@@ -1,0 +1,204 @@
+"""In-process fake S3 server for connector tests.
+
+Speaks just enough of the S3 REST dialect for the SigV4 client: object
+GET(Range)/PUT/HEAD/DELETE, server-side copy, ListObjectsV2 with
+continuation tokens, and multipart upload (initiate/part/complete/abort).
+Auth headers are accepted but not validated (the signer is exercised for
+shape, not cryptographic verification).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Tuple
+
+
+class _State:
+    def __init__(self) -> None:
+        self.objects: Dict[str, bytes] = {}  # "bucket/key" -> data
+        self.uploads: Dict[str, Dict[int, bytes]] = {}
+        self.lock = threading.Lock()
+
+
+def _xml_escape(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: _State = None  # set by serve()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _path_key(self) -> Tuple[str, str, Dict[str, List[str]]]:
+        parsed = urllib.parse.urlsplit(self.path)
+        parts = parsed.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        return bucket, key, urllib.parse.parse_qs(parsed.query,
+                                                  keep_blank_values=True)
+
+    def _send(self, code: int, body: bytes = b"",
+              headers: Dict[str, str] = None) -> None:
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(n) if n else b""
+
+    # -- verbs ---------------------------------------------------------------
+    def do_PUT(self):
+        bucket, key, q = self._path_key()
+        body = self._read_body()
+        st = self.state
+        if "partNumber" in q and "uploadId" in q:
+            upload_id = q["uploadId"][0]
+            part = int(q["partNumber"][0])
+            with st.lock:
+                if upload_id not in st.uploads:
+                    return self._send(404)
+                st.uploads[upload_id][part] = body
+            return self._send(200, headers={"ETag": f'"part-{part}"'})
+        src = self.headers.get("x-amz-copy-source")
+        if src:
+            src = urllib.parse.unquote(src.lstrip("/"))
+            with st.lock:
+                data = st.objects.get(src)
+                if data is None:
+                    return self._send(404)
+                st.objects[f"{bucket}/{key}"] = data
+            return self._send(
+                200, b"<CopyObjectResult><ETag>\"copy\"</ETag>"
+                     b"</CopyObjectResult>")
+        with st.lock:
+            st.objects[f"{bucket}/{key}"] = body
+        self._send(200, headers={"ETag": f'"{hash(body) & 0xffffffff:x}"'})
+
+    def do_GET(self):
+        bucket, key, q = self._path_key()
+        st = self.state
+        if not key and "list-type" in q:
+            return self._list(bucket, q)
+        with st.lock:
+            data = st.objects.get(f"{bucket}/{key}")
+        if data is None:
+            return self._send(404)
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            spec = rng[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s) if start_s else 0
+            end = int(end_s) if end_s else len(data) - 1
+            if start >= len(data):
+                return self._send(416)
+            chunk = data[start:end + 1]
+            return self._send(206, chunk, headers={
+                "Content-Range": f"bytes {start}-{start+len(chunk)-1}"
+                                 f"/{len(data)}"})
+        self._send(200, data)
+
+    def _list(self, bucket: str, q: Dict[str, List[str]]) -> None:
+        prefix = q.get("prefix", [""])[0]
+        max_keys = int(q.get("max-keys", ["1000"])[0])
+        token = q.get("continuation-token", [""])[0]
+        with self.state.lock:
+            keys = sorted(k.split("/", 1)[1]
+                          for k in self.state.objects
+                          if k.startswith(f"{bucket}/")
+                          and k.split("/", 1)[1].startswith(prefix))
+        if token:
+            keys = [k for k in keys if k > token]
+        page, rest = keys[:max_keys], keys[max_keys:]
+        items = "".join(
+            f"<Contents><Key>{_xml_escape(k)}</Key></Contents>"
+            for k in page)
+        truncated = "true" if rest else "false"
+        next_token = (f"<NextContinuationToken>{_xml_escape(page[-1])}"
+                      f"</NextContinuationToken>") if rest else ""
+        body = (f"<?xml version='1.0'?><ListBucketResult>"
+                f"<IsTruncated>{truncated}</IsTruncated>{next_token}"
+                f"{items}</ListBucketResult>").encode()
+        self._send(200, body, headers={"Content-Type": "application/xml"})
+
+    def do_HEAD(self):
+        bucket, key, _ = self._path_key()
+        with self.state.lock:
+            data = self.state.objects.get(f"{bucket}/{key}")
+        if data is None:
+            return self._send(404)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("ETag", f'"{hash(data) & 0xffffffff:x}"')
+        self.send_header("Last-Modified", "Mon, 01 Jan 2024 00:00:00 GMT")
+        self.end_headers()
+
+    def do_DELETE(self):
+        bucket, key, q = self._path_key()
+        st = self.state
+        if "uploadId" in q:
+            with st.lock:
+                st.uploads.pop(q["uploadId"][0], None)
+            return self._send(204)
+        with st.lock:
+            st.objects.pop(f"{bucket}/{key}", None)
+        self._send(204)
+
+    def do_POST(self):
+        bucket, key, q = self._path_key()
+        st = self.state
+        body = self._read_body()
+        if "uploads" in q:
+            upload_id = uuid.uuid4().hex
+            with st.lock:
+                st.uploads[upload_id] = {}
+            return self._send(200, (
+                f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                f"<Bucket>{bucket}</Bucket><Key>{_xml_escape(key)}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                f"</InitiateMultipartUploadResult>").encode())
+        if "uploadId" in q:
+            upload_id = q["uploadId"][0]
+            with st.lock:
+                parts = st.uploads.pop(upload_id, None)
+                if parts is None:
+                    return self._send(404)
+                st.objects[f"{bucket}/{key}"] = b"".join(
+                    parts[i] for i in sorted(parts))
+            return self._send(200, (
+                "<?xml version='1.0'?><CompleteMultipartUploadResult>"
+                "<ETag>\"mp\"</ETag></CompleteMultipartUploadResult>"
+            ).encode())
+        self._send(400)
+
+
+class FakeS3Server:
+    """Context manager: ``with FakeS3Server() as srv: srv.endpoint``."""
+
+    def __init__(self) -> None:
+        self.state = _State()
+        handler = type("BoundHandler", (_Handler,), {"state": self.state})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.port = self._httpd.server_port
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def __enter__(self) -> "FakeS3Server":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        return False

@@ -319,6 +319,11 @@ class Keys:
         scope=Scope.MASTER)
     MASTER_TTL_CHECK_INTERVAL = _k("atpu.master.ttl.check.interval",
                                    KeyType.DURATION, default="1h", scope=Scope.MASTER)
+    MASTER_ACTIVE_SYNC_INTERVAL = _k(
+        "atpu.master.activesync.interval", KeyType.DURATION, default="30s",
+        scope=Scope.MASTER,
+        description="Poll interval for active sync points (reference: "
+                    "ActiveSyncManager.java:81; polling replaces iNotify).")
     MASTER_REPLICATION_CHECK_INTERVAL = _k(
         "atpu.master.replication.check.interval", KeyType.DURATION, default="1min",
         scope=Scope.MASTER)

@@ -50,6 +50,9 @@ class EntryType:
     CLUSTER_INFO = "cluster_info"
     PATH_PROPERTIES = "path_properties"
     REMOVE_PATH_PROPERTIES = "remove_path_properties"
+    # file.proto active-sync equivalents
+    ADD_SYNC_POINT = "add_sync_point"
+    REMOVE_SYNC_POINT = "remove_sync_point"
     # table.proto equivalents
     ATTACH_DB = "attach_db"
     DETACH_DB = "detach_db"

@@ -65,8 +65,12 @@ class FileSystemMaster:
         journal.register(_MountTableJournal(self.mount_table))
         #: paths with in-flight async persist (file id -> alluxio path)
         self._persist_requests: Dict[int, str] = {}
-        #: access-time of last UFS sync per path (soft state)
-        self._sync_times: Dict[str, int] = {}
+        from alluxio_tpu.master.sync import AbsentPathCache, UfsSyncPathCache
+
+        #: last-sync bookkeeping (reference: UfsSyncPathCache)
+        self._sync_cache = UfsSyncPathCache()
+        #: UFS paths known absent (reference: AsyncUfsAbsentPathCache)
+        self._absent_cache = AbsentPathCache()
 
     # -------------------------------------------------------------- startup
     def start(self, root_ufs_uri: Optional[str] = None,
@@ -247,6 +251,7 @@ class FileSystemMaster:
                     parent_id = p.id
                 inode.parent_id = parent_id
                 ctx.append(EntryType.INODE_FILE, inode.to_wire_dict())
+            self._absent_cache.remove(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def create_directory(self, path: "str | AlluxioURI", *,
@@ -278,6 +283,7 @@ class FileSystemMaster:
                     parent_id = p.id
                 inode.parent_id = parent_id
                 ctx.append(EntryType.INODE_DIRECTORY, inode.to_wire_dict())
+            self._absent_cache.remove(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def _prepare_parents(self, lookup: PathLookup,
@@ -452,6 +458,7 @@ class FileSystemMaster:
                     "new_name": dst_uri.name, "op_time_ms": now})
             if persisted:
                 self._rename_in_ufs(src_uri, dst_uri, inode.is_directory)
+            self._absent_cache.remove(dst_uri.path)
 
     def _rename_in_ufs(self, src_uri: AlluxioURI, dst_uri: AlluxioURI,
                        is_dir: bool) -> None:
@@ -544,6 +551,8 @@ class FileSystemMaster:
             except Exception:
                 self._ufs.remove_mount(mount_id)
                 raise
+            # a new mount can reveal paths previously recorded absent
+            self._absent_cache.clear()
 
     def unmount(self, path: "str | AlluxioURI") -> None:
         uri = AlluxioURI(path)
@@ -699,56 +708,118 @@ class FileSystemMaster:
     # ------------------------------------------------------- UFS metadata sync
     def _maybe_sync(self, uri: AlluxioURI, sync_interval_ms: int) -> None:
         """On-access sync gate (reference: ``InodeSyncStream.java:115`` +
-        ``UfsSyncPathCache``): -1 never, 0 always, >0 min interval."""
-        if sync_interval_ms < 0:
+        ``UfsSyncPathCache``): -1 never, 0 always, >0 min interval. A
+        recursive sync of an ancestor freshens this path too."""
+        if not self._sync_cache.should_sync(uri.path, self._now(),
+                                            sync_interval_ms):
             return
-        now = self._now()
-        last = self._sync_times.get(uri.path, 0)
-        if sync_interval_ms > 0 and now - last < sync_interval_ms:
-            return
-        self._sync_times[uri.path] = now
         self.sync_metadata(uri)
 
-    def sync_metadata(self, path: "str | AlluxioURI") -> bool:
+    def sync_metadata(self, path: "str | AlluxioURI", *,
+                      recursive: bool = False) -> bool:
         """Diff UFS vs inode state via fingerprints; reload on change.
-        Returns True if anything changed."""
+        ``recursive`` extends the diff to the whole subtree (the
+        ``DescendantType.ALL`` mode of ``InodeSyncStream``). Returns True
+        if anything changed."""
         uri = AlluxioURI(path)
+        changed = self._sync_one(uri)
+        if recursive:
+            changed = self._sync_children(uri) or changed
+        self._sync_cache.notify_synced(uri.path, self._now(),
+                                       recursive=recursive)
+        return changed
+
+    def _sync_one(self, uri: AlluxioURI, *,
+                  status: "UfsStatus | None" = None,
+                  status_known: bool = False) -> bool:
+        """``status_known=True`` means the caller already holds the UFS
+        status (e.g. from a directory listing) — skip the per-path probe."""
         try:
             resolution = self.mount_table.resolve(uri)
         except Exception:  # noqa: BLE001
             return False
         ufs = self._ufs.get(resolution.mount_id)
-        status = ufs.get_status(resolution.ufs_path)
+        if not status_known:
+            status = ufs.get_status(resolution.ufs_path)
         with self.inode_tree.lock.read_locked():
             lookup = self.inode_tree.lookup(uri)
             exists = lookup.exists
             inode = lookup.inode if exists else None
         if status is None:
+            self._absent_cache.add(uri.path)
             if exists and inode.persistence_state == PersistenceState.PERSISTED:
                 # UFS deleted it out-of-band
                 self.delete(uri, recursive=True, alluxio_only=True)
                 return True
             return False
+        self._absent_cache.remove(uri.path)
         new_fp = Fingerprint.from_status(status)
         if not exists:
-            self._load_metadata_if_exists(uri)
+            self._load_metadata_if_exists(uri, status=status)
             return True
         if inode.is_directory != status.is_directory:
             self.delete(uri, recursive=True, alluxio_only=True)
-            self._load_metadata_if_exists(uri)
+            self._load_metadata_if_exists(uri, status=status)
             return True
         old_fp = Fingerprint.parse(inode.ufs_fingerprint)
         if not inode.is_directory and not new_fp.matches_content(old_fp) and \
                 inode.persistence_state == PersistenceState.PERSISTED:
             # content changed under us: drop cached blocks + metadata, reload
             self.delete(uri, recursive=False, alluxio_only=True)
-            self._load_metadata_if_exists(uri)
+            self._load_metadata_if_exists(uri, status=status)
             return True
         return False
 
-    def _load_metadata_if_exists(self, uri: AlluxioURI) -> Optional[FileInfo]:
+    def _sync_children(self, uri: AlluxioURI) -> bool:
+        """Recursive UFS-vs-tree diff below ``uri``: load new UFS entries,
+        re-check known ones, drop persisted inodes the UFS lost."""
+        try:
+            resolution = self.mount_table.resolve(uri)
+        except Exception:  # noqa: BLE001
+            return False
+        if not self._ufs.has(resolution.mount_id):
+            return False
+        ufs = self._ufs.get(resolution.mount_id)
+        listing = ufs.list_status(resolution.ufs_path)
+        if listing is None:
+            return False
+        ufs_names = {st.name: st for st in listing}
+        changed = False
+        with self.inode_tree.lock.read_locked():
+            lookup = self.inode_tree.lookup(uri)
+            if not lookup.exists or not lookup.inode.is_directory:
+                return False
+            known = {c.name: c for c in
+                     self.inode_tree.children(lookup.inode)}
+        # UFS entries unknown to the tree -> load; the listing already
+        # carries each child's status, so no per-child UFS probe is needed
+        for name, st in ufs_names.items():
+            child = uri.join(name)
+            if name not in known:
+                self._load_metadata_if_exists(child, status=st)
+                changed = True
+            else:
+                changed = self._sync_one(child, status=st,
+                                         status_known=True) or changed
+            if st.is_directory:
+                changed = self._sync_children(child) or changed
+        # persisted inodes gone from the UFS -> drop (cache-only stays)
+        for name, inode in known.items():
+            if name not in ufs_names and \
+                    inode.persistence_state == PersistenceState.PERSISTED:
+                self.delete(uri.join(name), recursive=True,
+                            alluxio_only=True)
+                changed = True
+        return changed
+
+    def _load_metadata_if_exists(self, uri: AlluxioURI, *,
+                                 status: "UfsStatus | None" = None
+                                 ) -> Optional[FileInfo]:
         """Create inodes mirroring an existing UFS path (metadata load on
-        access — reference: ``InodeSyncStream`` loadMetadata)."""
+        access — reference: ``InodeSyncStream`` loadMetadata). A caller
+        that already holds the UFS status passes it to skip the probe."""
+        if status is None and self._absent_cache.is_absent(uri.path):
+            return None
         try:
             resolution = self.mount_table.resolve(uri)
         except Exception:  # noqa: BLE001
@@ -756,8 +827,10 @@ class FileSystemMaster:
         if not self._ufs.has(resolution.mount_id):
             return None
         ufs = self._ufs.get(resolution.mount_id)
-        status = ufs.get_status(resolution.ufs_path)
         if status is None:
+            status = ufs.get_status(resolution.ufs_path)
+        if status is None:
+            self._absent_cache.add(uri.path)
             return None
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)

@@ -55,6 +55,9 @@ class MasterProcess:
             self.block_master, self.journal, clock=self._clock,
             default_block_size=conf.get_bytes(
                 Keys.USER_BLOCK_SIZE_BYTES_DEFAULT))
+        from alluxio_tpu.master.sync import ActiveSyncManager
+
+        self.active_sync = ActiveSyncManager(self.fs_master, self.journal)
         self._root_ufs_uri = root_ufs_uri or conf.get(Keys.HOME) + \
             "/underFSStorage"
         self.rpc_server: Optional[RpcServer] = None
@@ -82,7 +85,8 @@ class MasterProcess:
         self.rpc_server = RpcServer(
             bind_host="0.0.0.0",
             port=self._conf.get_int(Keys.MASTER_RPC_PORT))
-        self.rpc_server.add_service(fs_master_service(self.fs_master))
+        self.rpc_server.add_service(fs_master_service(
+            self.fs_master, active_sync=self.active_sync))
         self.rpc_server.add_service(block_master_service(self.block_master))
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
@@ -102,6 +106,10 @@ class MasterProcess:
                 HeartbeatContext.MASTER_TTL_CHECK,
                 _Exec(self.fs_master.check_ttl_expired),
                 conf.get_duration_s(Keys.MASTER_TTL_CHECK_INTERVAL)),
+            HeartbeatThread(
+                HeartbeatContext.MASTER_ACTIVE_SYNC,
+                _Exec(self.active_sync.heartbeat),
+                conf.get_duration_s(Keys.MASTER_ACTIVE_SYNC_INTERVAL)),
         ]
         for t in self._threads:
             t.start()

@@ -119,6 +119,15 @@ class FsMasterClient(_BaseClient):
     def sync_metadata(self, path: str) -> bool:
         return self._call("sync_metadata", {"path": str(path)})["changed"]
 
+    def start_sync(self, path: str) -> None:
+        self._call("start_sync", {"path": str(path)})
+
+    def stop_sync(self, path: str) -> None:
+        self._call("stop_sync", {"path": str(path)})
+
+    def get_sync_path_list(self) -> List[str]:
+        return self._call("get_sync_path_list", {})["paths"]
+
     def mark_persisted(self, path: str, ufs_fingerprint: str = "") -> None:
         self._call("mark_persisted", {"path": str(path),
                                       "ufs_fingerprint": ufs_fingerprint})

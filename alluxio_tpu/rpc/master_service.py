@@ -34,11 +34,20 @@ def _timed(name: str, fn):
     return wrapper
 
 
-def fs_master_service(fsm: FileSystemMaster) -> ServiceDefinition:
+def fs_master_service(fsm: FileSystemMaster,
+                      active_sync=None) -> ServiceDefinition:
     svc = ServiceDefinition(FS_SERVICE)
 
     def u(name, fn):
         svc.unary(name, _timed(name, fn))
+
+    if active_sync is not None:
+        u("start_sync", lambda r: (
+            active_sync.add_sync_point(r["path"]), {})[-1])
+        u("stop_sync", lambda r: (
+            active_sync.remove_sync_point(r["path"]), {})[-1])
+        u("get_sync_path_list", lambda r: {
+            "paths": active_sync.sync_points()})
 
     u("get_status", lambda r: fsm.get_status(
         r["path"], sync_interval_ms=r.get("sync_interval_ms", -1)).to_wire())

@@ -736,6 +736,45 @@ class MasterInfoCommand(Command):
         return 0
 
 
+@FS_SHELL.register
+class StartSyncCommand(Command):
+    name = "startSync"
+    description = "Register a path as an active sync point."
+
+    def configure(self, p):
+        p.add_argument("path")
+
+    def run(self, args, ctx):
+        ctx.fs_client().start_sync(args.path)
+        ctx.print(f"Started automatic syncing of '{args.path}'")
+        return 0
+
+
+@FS_SHELL.register
+class StopSyncCommand(Command):
+    name = "stopSync"
+    description = "Unregister an active sync point."
+
+    def configure(self, p):
+        p.add_argument("path")
+
+    def run(self, args, ctx):
+        ctx.fs_client().stop_sync(args.path)
+        ctx.print(f"Stopped automatic syncing of '{args.path}'")
+        return 0
+
+
+@FS_SHELL.register
+class GetSyncPathListCommand(Command):
+    name = "getSyncPathList"
+    description = "List the active sync points."
+
+    def run(self, args, ctx):
+        for p in ctx.fs_client().get_sync_path_list():
+            ctx.print(p)
+        return 0
+
+
 def _run_distributed(ctx, config: dict, wait: bool) -> int:
     jc = ctx.job_client()
     job_id = jc.run(config)

@@ -39,18 +39,29 @@ class GcsJsonClient(ObjectStoreClient):
         ).rstrip("/")
         self._static_token = props.get("gcs.token", "")
         self._session = requests.Session()
+        self._cached_token = ""
+        self._token_expiry = 0.0
 
     def _headers(self) -> Dict[str, str]:
+        import time
+
         tok = self._static_token
         if not tok and "googleapis.com" in self._base:
-            try:  # TPU-VM / GCE metadata token
-                r = self._session.get(
-                    _METADATA_TOKEN_URL,
-                    headers={"Metadata-Flavor": "Google"}, timeout=2)
-                if r.ok:
-                    tok = r.json().get("access_token", "")
-            except requests.RequestException:
-                pass
+            if self._cached_token and time.monotonic() < self._token_expiry:
+                tok = self._cached_token
+            else:
+                try:  # TPU-VM / GCE metadata token, cached until expiry
+                    r = self._session.get(
+                        _METADATA_TOKEN_URL,
+                        headers={"Metadata-Flavor": "Google"}, timeout=2)
+                    if r.ok:
+                        body = r.json()
+                        tok = body.get("access_token", "")
+                        self._cached_token = tok
+                        self._token_expiry = time.monotonic() + max(
+                            30.0, float(body.get("expires_in", 300)) - 60.0)
+                except requests.RequestException:
+                    pass
         return {"Authorization": f"Bearer {tok}"} if tok else {}
 
     def _obj_url(self, key: str, alt_media: bool = False) -> str:
@@ -105,12 +116,26 @@ class GcsJsonClient(ObjectStoreClient):
         return r.status_code in (200, 204)
 
     def copy(self, src_key: str, dst_key: str) -> bool:
-        r = self._session.post(
-            f"{self._base}/storage/v1/b/{self._bucket}/o/"
-            f"{urllib.parse.quote(src_key, safe='')}/rewriteTo/b/"
-            f"{self._bucket}/o/{urllib.parse.quote(dst_key, safe='')}",
-            headers=self._headers(), timeout=60)
-        return r.ok
+        # rewriteTo may return done=false + rewriteToken for large objects;
+        # loop until the rewrite completes or deletion of the source after a
+        # half-finished copy would lose data
+        url = (f"{self._base}/storage/v1/b/{self._bucket}/o/"
+               f"{urllib.parse.quote(src_key, safe='')}/rewriteTo/b/"
+               f"{self._bucket}/o/{urllib.parse.quote(dst_key, safe='')}")
+        token = None
+        for _ in range(64):
+            params = {"rewriteToken": token} if token else {}
+            r = self._session.post(url, params=params,
+                                   headers=self._headers(), timeout=60)
+            if not r.ok:
+                return False
+            body = r.json()
+            if body.get("done", True):
+                return True
+            token = body.get("rewriteToken")
+            if not token:
+                return False
+        return False
 
     def list_prefix(self, prefix: str) -> List[str]:
         keys: List[str] = []

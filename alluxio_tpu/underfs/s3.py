@@ -162,16 +162,10 @@ class S3Client(ObjectStoreClient):
         if r.status_code == 404:
             return None
         r.raise_for_status()
+        from alluxio_tpu.underfs.web import _parse_http_date
+
         length = int(r.headers.get("Content-Length", 0))
-        mtime = 0
-        lm = r.headers.get("Last-Modified")
-        if lm:
-            try:
-                mtime = int(datetime.datetime.strptime(
-                    lm, "%a, %d %b %Y %H:%M:%S %Z").replace(
-                    tzinfo=datetime.timezone.utc).timestamp() * 1000)
-            except ValueError:
-                pass
+        mtime = _parse_http_date(r.headers.get("Last-Modified")) or 0
         return (length, mtime, r.headers.get("ETag", "").strip('"'))
 
     def delete(self, key: str) -> bool:

@@ -9,7 +9,6 @@ scraper, with a stdlib HTMLParser.
 
 from __future__ import annotations
 
-import datetime
 import html.parser
 import io
 import urllib.parse
@@ -35,13 +34,15 @@ class _HrefParser(html.parser.HTMLParser):
 
 
 def _parse_http_date(value: Optional[str]) -> Optional[int]:
+    """RFC 7231 date -> epoch ms; locale-independent (unlike strptime %a/%b)."""
     if not value:
         return None
     try:
-        return int(datetime.datetime.strptime(
-            value, "%a, %d %b %Y %H:%M:%S %Z").replace(
-            tzinfo=datetime.timezone.utc).timestamp() * 1000)
-    except ValueError:
+        import email.utils
+
+        dt = email.utils.parsedate_to_datetime(value)
+        return int(dt.timestamp() * 1000) if dt else None
+    except (TypeError, ValueError):
         return None
 
 
@@ -95,6 +96,9 @@ class WebUnderFileSystem(UnderFileSystem):
                                   timeout=self._timeout)
             if r.status_code == 404:
                 return None
+            # transient server errors must NOT read as "exists, empty" —
+            # a fabricated zero-length status would poison sync fingerprints
+            r.raise_for_status()
         return r
 
     def _looks_dir(self, path: str, resp: requests.Response) -> bool:

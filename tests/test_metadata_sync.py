@@ -1,0 +1,147 @@
+"""Metadata sync tests (reference: ``InodeSyncStream`` behaviors +
+``ActiveSyncManager`` + absent-path cache)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from alluxio_tpu.master.sync import (
+    AbsentPathCache, ActiveSyncManager, UfsSyncPathCache,
+)
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1,
+                      start_worker_heartbeats=True) as c:
+        yield c
+
+
+def _root_ufs_dir(cluster):
+    """The local-disk directory backing the root mount."""
+    mp = cluster.fs_client().get_mount_points()[0]
+    return mp.ufs_uri
+
+
+class TestSyncPathCache:
+    def test_recursive_ancestor_covers_descendants(self):
+        c = UfsSyncPathCache()
+        c.notify_synced("/a", 1000, recursive=True)
+        assert c.last_sync_ms("/a/b/c") == 1000
+        assert not c.should_sync("/a/b", 1500, interval_ms=1000)
+        assert c.should_sync("/a/b", 2500, interval_ms=1000)
+
+    def test_non_recursive_does_not_cover(self):
+        c = UfsSyncPathCache()
+        c.notify_synced("/a", 1000, recursive=False)
+        assert c.last_sync_ms("/a/b") == 0
+        assert c.should_sync("/a/b", 1001, interval_ms=10)
+
+    def test_interval_semantics(self):
+        c = UfsSyncPathCache()
+        assert not c.should_sync("/x", 100, interval_ms=-1)  # never
+        assert c.should_sync("/x", 100, interval_ms=0)       # always
+
+
+class TestAbsentCache:
+    def test_add_expire_remove(self):
+        c = AbsentPathCache(ttl_s=0.05)
+        c.add("/a/b")
+        assert c.is_absent("/a/b")
+        time.sleep(0.08)
+        assert not c.is_absent("/a/b")  # ttl expired
+        c.add("/a/b")
+        c.add("/a/b/c")
+        c.remove("/a/b")
+        assert not c.is_absent("/a/b")
+        assert not c.is_absent("/a/b/c")  # descendants dropped too
+
+
+class TestOnAccessSync:
+    def test_out_of_band_ufs_create_visible_after_sync(self, cluster):
+        fs = cluster.file_system()
+        root = _root_ufs_dir(cluster)
+        with open(os.path.join(root, "oob.txt"), "wb") as f:
+            f.write(b"out-of-band")
+        # a direct read picks it up via on-access metadata load
+        assert fs.read_all("/oob.txt") == b"out-of-band"
+
+    def test_out_of_band_delete_detected(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/gone.txt", b"x", write_type="CACHE_THROUGH")
+        root = _root_ufs_dir(cluster)
+        os.unlink(os.path.join(root, "gone.txt"))
+        changed = cluster.fs_client().sync_metadata("/gone.txt")
+        assert changed
+        assert not fs.exists("/gone.txt")
+
+    def test_content_change_detected(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/mut.txt", b"version-1", write_type="CACHE_THROUGH")
+        root = _root_ufs_dir(cluster)
+        time.sleep(0.05)  # ensure mtime moves
+        with open(os.path.join(root, "mut.txt"), "wb") as f:
+            f.write(b"version-2-different")
+        cluster.fs_client().sync_metadata("/mut.txt")
+        assert fs.read_all("/mut.txt") == b"version-2-different"
+
+    def test_recursive_sync_loads_subtree(self, cluster):
+        fs = cluster.file_system()
+        root = _root_ufs_dir(cluster)
+        os.makedirs(os.path.join(root, "deep/nest"), exist_ok=True)
+        with open(os.path.join(root, "deep/nest/f.txt"), "wb") as f:
+            f.write(b"nested")
+        changed = cluster.master.fs_master.sync_metadata(
+            "/", recursive=True)
+        assert changed
+        assert fs.read_all("/deep/nest/f.txt") == b"nested"
+
+    def test_absent_cache_prevents_repeated_ufs_probes(self, cluster):
+        from alluxio_tpu.underfs.delegating import SleepingUnderFileSystem
+
+        fsm = cluster.master.fs_master
+        mount_id = cluster.fs_client().get_mount_points()[0].mount_id
+        inner = fsm.ufs_manager.get(mount_id)
+        spy = SleepingUnderFileSystem(inner, sleeps={})
+        fsm.ufs_manager._by_mount[mount_id] = spy
+        fs = cluster.file_system()
+        for _ in range(5):
+            assert not fs.exists("/never-there")
+        # first miss probes the UFS; the rest hit the absent cache
+        assert spy.op_counts.get("get_status", 0) == 1
+
+
+class TestActiveSync:
+    def test_sync_point_lifecycle_and_tick(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/watch")
+        fsc = cluster.fs_client()
+        fsc.start_sync("/watch")
+        assert fsc.get_sync_path_list() == ["/watch"]
+        root = _root_ufs_dir(cluster)
+        os.makedirs(os.path.join(root, "watch"), exist_ok=True)
+        with open(os.path.join(root, "watch/new.txt"), "wb") as f:
+            f.write(b"appeared")
+        # manual tick (the heartbeat thread does this on its interval)
+        cluster.master.active_sync.heartbeat()
+        assert fs.read_all("/watch/new.txt") == b"appeared"
+        fsc.stop_sync("/watch")
+        assert fsc.get_sync_path_list() == []
+
+    def test_sync_points_survive_restart(self, tmp_path):
+        with LocalCluster(str(tmp_path), num_workers=0) as c:
+            c.file_system().create_directory("/sp")
+            c.master.active_sync.add_sync_point("/sp")
+        # same base dir -> same journal folder; replay restores the points
+        with LocalCluster(str(tmp_path), num_workers=0) as c:
+            assert c.master.active_sync.sync_points() == ["/sp"]
+
+    def test_remove_unknown_point_errors(self, cluster):
+        from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+        with pytest.raises(InvalidArgumentError):
+            cluster.fs_client().stop_sync("/not-registered")

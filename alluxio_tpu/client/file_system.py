@@ -70,9 +70,12 @@ class FileSystem:
     def __init__(self, master_address: str,
                  conf: Optional[Configuration] = None) -> None:
         self._conf = conf or Configuration()
-        self.fs_master = FsMasterClient(master_address)
-        self.block_master = BlockMasterClient(master_address)
-        self.meta_master = MetaMasterClient(master_address)
+        from alluxio_tpu.security.authentication import client_metadata
+
+        md = tuple(client_metadata(self._conf))
+        self.fs_master = FsMasterClient(master_address, metadata=md)
+        self.block_master = BlockMasterClient(master_address, metadata=md)
+        self.meta_master = MetaMasterClient(master_address, metadata=md)
         identity = TieredIdentity.from_spec(
             self._conf.get(Keys.TIERED_IDENTITY),
             hostname=socket.gethostname())

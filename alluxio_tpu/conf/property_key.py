@@ -277,6 +277,21 @@ class Keys:
         "atpu.security.authorization.permission.enabled", KeyType.BOOL, default=True)
     SECURITY_AUTHORIZATION_PERMISSION_UMASK = _k(
         "atpu.security.authorization.permission.umask", KeyType.INT, default=0o022)
+    SECURITY_AUTHORIZATION_PERMISSION_SUPERGROUP = _k(
+        "atpu.security.authorization.permission.supergroup", default="supergroup",
+        description="Members act as superusers (reference: "
+                    "alluxio.security.authorization.permission.supergroup).")
+    SECURITY_LOGIN_IMPERSONATION_USERNAME = _k(
+        "atpu.security.login.impersonation.username",
+        description="User to act as; the connecting user must be allowed by "
+                    "the master's impersonation rules.")
+    SECURITY_AUTH_CUSTOM_PROVIDER = _k(
+        "atpu.security.authentication.custom.provider",
+        description="dotted.module:attr of an AuthenticationProvider for "
+                    "CUSTOM auth (reference: AuthenticationProvider SPI).")
+    SECURITY_LOGIN_TOKEN = _k(
+        "atpu.security.login.token",
+        description="Opaque credential forwarded to a CUSTOM provider.")
 
     # --- master ---
     MASTER_HOSTNAME = _k("atpu.master.hostname", default="localhost", scope=Scope.ALL)
@@ -528,3 +543,11 @@ class Templates:
         "atpu.master.mount.table.{}.option.{}",
         r"atpu\.master\.mount\.table\.(\w+)\.option\.(.+)",
         KeyType.STRING, lambda *_: None, Scope.MASTER)
+    MASTER_IMPERSONATION_USERS = _template(
+        "atpu.master.security.impersonation.{}.users",
+        r"atpu\.master\.security\.impersonation\.([^.]+)\.users",
+        KeyType.LIST, lambda *_: None, Scope.MASTER)
+    MASTER_IMPERSONATION_GROUPS = _template(
+        "atpu.master.security.impersonation.{}.groups",
+        r"atpu\.master\.security\.impersonation\.([^.]+)\.groups",
+        KeyType.LIST, lambda *_: None, Scope.MASTER)

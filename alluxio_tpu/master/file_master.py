@@ -53,12 +53,23 @@ class FileSystemMaster:
                  ufs_manager: Optional[UfsManager] = None,
                  inode_store: Optional[InodeStore] = None,
                  clock: Optional[Clock] = None,
-                 default_block_size: int = 64 << 20) -> None:
+                 default_block_size: int = 64 << 20,
+                 permission_checker=None,
+                 umask: int = 0o022) -> None:
         self._block_master = block_master
         self._journal = journal
         self._ufs = ufs_manager or UfsManager()
         self._clock = clock or SystemClock()
         self._default_block_size = default_block_size
+        if permission_checker is None:
+            from alluxio_tpu.security.authorization import PermissionChecker
+            from alluxio_tpu.security.user import get_os_user
+
+            # the process user is the superuser (reference: the master's
+            # login user bypasses permission checks)
+            permission_checker = PermissionChecker(superuser=get_os_user())
+        self._perm = permission_checker
+        self._umask = umask
         self.inode_tree = InodeTree(inode_store)
         self.mount_table = MountTable()
         journal.register(self.inode_tree)
@@ -80,9 +91,13 @@ class FileSystemMaster:
             if self.inode_tree.root is None:
                 now = self._clock.millis()
                 cid = self._block_master.new_container_id()
+                from alluxio_tpu.security.user import get_os_user
+
+                # root is owned by the master's login user (reference:
+                # InodeTree.initializeRoot uses the server login user)
                 root = Inode.new_directory(
                     ids.file_id_from_container(cid), -1, "", mode=0o755,
-                    now_ms=now)
+                    owner=get_os_user(), now_ms=now)
                 root.persistence_state = PersistenceState.PERSISTED
                 with self._journal.create_context() as ctx:
                     ctx.append(EntryType.INODE_DIRECTORY, root.to_wire_dict())
@@ -106,6 +121,58 @@ class FileSystemMaster:
 
     def _now(self) -> int:
         return self._clock.millis()
+
+    # ---------------------------------------------------------- permissions
+    def _auth_user(self):
+        from alluxio_tpu.security.user import authenticated_user
+
+        return authenticated_user()
+
+    def _check_access(self, lookup: PathLookup, bits: int) -> None:
+        """traverse + ``bits`` on the target inode."""
+        user = self._auth_user()
+        self._perm.check_traverse(user, lookup.inodes[:-1])
+        self._perm.check(user, lookup.inode, bits, path=lookup.uri.path)
+
+    def _check_parent_write(self, lookup: PathLookup) -> None:
+        """traverse + WRITE on the deepest existing ancestor (create) or
+        the parent (delete/rename)."""
+        from alluxio_tpu.security.authorization import WRITE
+
+        user = self._auth_user()
+        self._perm.check_traverse(user, lookup.inodes[:-1])
+        self._perm.check(user, lookup.deepest, WRITE, path=lookup.uri.path)
+
+    def _check_delete(self, lookup: PathLookup) -> None:
+        """traverse + WRITE on the parent of an existing target."""
+        from alluxio_tpu.security.authorization import WRITE
+
+        user = self._auth_user()
+        self._perm.check_traverse(user, lookup.inodes[:-2])
+        if len(lookup.inodes) >= 2:
+            self._perm.check(user, lookup.inodes[-2], WRITE,
+                             path=lookup.uri.path)
+
+    def _fill_owner(self, owner: str, group: str) -> "tuple[str, str]":
+        """Create-time defaults from the authenticated user
+        (reference: inodes inherit the RPC caller's identity)."""
+        user = self._auth_user()
+        if user is not None:
+            owner = owner or user.name
+            group = group or (user.groups[0] if user.groups else user.name)
+        return owner, group
+
+    def _inherit_default_acl(self, parent: Inode, inode: Inode) -> None:
+        """A directory's default ACL becomes new children's access ACL
+        (and stays the default on child directories) — reference:
+        DefaultAccessControlList inheritance."""
+        default = parent.xattr.get(self.DEFAULT_ACL_XATTR, "")
+        if not default:
+            return
+        inode.xattr = dict(inode.xattr)
+        inode.xattr[self.ACL_XATTR] = default
+        if inode.is_directory:
+            inode.xattr[self.DEFAULT_ACL_XATTR] = default
 
     # ---------------------------------------------------------------- reads
     def get_status(self, path: "str | AlluxioURI",
@@ -146,6 +213,9 @@ class FileSystemMaster:
             lookup = self.inode_tree.lookup(uri)
             if not lookup.exists:
                 raise FileDoesNotExistError(f"path {uri} does not exist")
+            from alluxio_tpu.security.authorization import READ
+
+            self._check_access(lookup, READ)
 
             def emit(dir_inode: Inode, dir_uri: AlluxioURI) -> None:
                 for child in self.inode_tree.children(dir_inode):
@@ -162,6 +232,9 @@ class FileSystemMaster:
         with self.inode_tree.lock.read_locked():
             lookup = self.inode_tree.lookup(uri)
             inode = lookup.inode
+            from alluxio_tpu.security.authorization import READ
+
+            self._check_access(lookup, READ)
             if inode.is_directory:
                 raise InvalidArgumentError(f"{uri} is a directory")
             return self._file_block_infos(inode)
@@ -218,7 +291,8 @@ class FileSystemMaster:
     def create_file(self, path: "str | AlluxioURI", *,
                     block_size_bytes: Optional[int] = None,
                     recursive: bool = True, ttl: int = -1,
-                    ttl_action: str = TtlAction.DELETE, mode: int = 0o644,
+                    ttl_action: str = TtlAction.DELETE,
+                    mode: Optional[int] = None,
                     owner: str = "", group: str = "",
                     replication_min: int = 0, replication_max: int = -1,
                     cacheable: bool = True,
@@ -232,6 +306,11 @@ class FileSystemMaster:
             lookup = self.inode_tree.lookup(uri)
             if lookup.exists:
                 raise FileAlreadyExistsError(f"{uri} already exists")
+            self._check_parent_write(lookup)
+            owner, group = self._fill_owner(owner, group)
+            # umask shapes the DEFAULT mode only; explicit modes are kept
+            # (reference: ModeUtils.applyFileUMask on option defaults)
+            mode = (0o666 & ~self._umask) if mode is None else mode
             parents = self._prepare_parents(lookup, recursive)
             now = self._now()
             cid = self._block_master.new_container_id()
@@ -244,19 +323,26 @@ class FileSystemMaster:
             if persist_on_complete:
                 inode.persistence_state = PersistenceState.TO_BE_PERSISTED
             with self._journal.create_context() as ctx:
-                parent_id = lookup.deepest.id
+                prev = lookup.deepest
                 for p in parents:
-                    p.parent_id = parent_id
+                    p.parent_id = prev.id
+                    # intermediate dirs inherit identity + default ACL so
+                    # children created under them later inherit correctly
+                    p.owner, p.group = owner, group
+                    p.mode = 0o777 & ~self._umask
+                    self._inherit_default_acl(prev, p)
                     ctx.append(EntryType.INODE_DIRECTORY, p.to_wire_dict())
-                    parent_id = p.id
-                inode.parent_id = parent_id
+                    prev = p
+                inode.parent_id = prev.id
+                self._inherit_default_acl(prev, inode)
                 ctx.append(EntryType.INODE_FILE, inode.to_wire_dict())
             self._absent_cache.remove(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def create_directory(self, path: "str | AlluxioURI", *,
                          recursive: bool = True, allow_exists: bool = False,
-                         mode: int = 0o755, owner: str = "", group: str = "",
+                         mode: Optional[int] = None,
+                         owner: str = "", group: str = "",
                          persisted: bool = False) -> FileInfo:
         uri = AlluxioURI(path)
         if uri.is_root():
@@ -267,6 +353,9 @@ class FileSystemMaster:
                 if allow_exists and lookup.inode.is_directory:
                     return self._file_info(lookup.inode, uri)
                 raise FileAlreadyExistsError(f"{uri} already exists")
+            self._check_parent_write(lookup)
+            owner, group = self._fill_owner(owner, group)
+            mode = (0o777 & ~self._umask) if mode is None else mode
             parents = self._prepare_parents(lookup, recursive)
             now = self._now()
             cid = self._block_master.new_container_id()
@@ -276,12 +365,18 @@ class FileSystemMaster:
             if persisted:
                 inode.persistence_state = PersistenceState.PERSISTED
             with self._journal.create_context() as ctx:
-                parent_id = lookup.deepest.id
+                prev = lookup.deepest
                 for p in parents:
-                    p.parent_id = parent_id
+                    p.parent_id = prev.id
+                    # intermediate dirs inherit identity + default ACL so
+                    # children created under them later inherit correctly
+                    p.owner, p.group = owner, group
+                    p.mode = 0o777 & ~self._umask
+                    self._inherit_default_acl(prev, p)
                     ctx.append(EntryType.INODE_DIRECTORY, p.to_wire_dict())
-                    parent_id = p.id
-                inode.parent_id = parent_id
+                    prev = p
+                inode.parent_id = prev.id
+                self._inherit_default_acl(prev, inode)
                 ctx.append(EntryType.INODE_DIRECTORY, inode.to_wire_dict())
             self._absent_cache.remove(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
@@ -312,6 +407,9 @@ class FileSystemMaster:
         """Reference: ``getNewBlockIdForFile:1538``."""
         uri = AlluxioURI(path)
         with self.inode_tree.lock.write_locked():
+            from alluxio_tpu.security.authorization import WRITE
+
+            self._check_access(self.inode_tree.lookup(uri), WRITE)
             inode = self._existing_file(uri)
             if inode.completed:
                 raise FileAlreadyCompletedError(f"{uri} is completed")
@@ -327,6 +425,9 @@ class FileSystemMaster:
         """Reference: ``completeFile:1295``."""
         uri = AlluxioURI(path)
         with self.inode_tree.lock.write_locked():
+            from alluxio_tpu.security.authorization import WRITE
+
+            self._check_access(self.inode_tree.lookup(uri), WRITE)
             inode = self._existing_file(uri)
             if inode.completed:
                 raise FileAlreadyCompletedError(f"{uri} already completed")
@@ -364,6 +465,7 @@ class FileSystemMaster:
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)
             inode = lookup.inode
+            self._check_delete(lookup)
             if self.mount_table.is_mount_point(uri):
                 raise InvalidPathError(
                     f"{uri} is a mount point; unmount it instead")
@@ -432,6 +534,7 @@ class FileSystemMaster:
         with self.inode_tree.lock.write_locked():
             src_lookup = self.inode_tree.lookup(src_uri)
             inode = src_lookup.inode
+            self._check_delete(src_lookup)
             if self.mount_table.is_mount_point(src_uri):
                 raise InvalidPathError(f"{src_uri} is a mount point")
             # cross-mount renames are unsupported (reference behavior)
@@ -442,6 +545,7 @@ class FileSystemMaster:
             dst_lookup = self.inode_tree.lookup(dst_uri)
             if dst_lookup.exists:
                 raise FileAlreadyExistsError(f"{dst_uri} already exists")
+            self._check_parent_write(dst_lookup)
             if len(dst_lookup.missing_components) > 1:
                 raise FileDoesNotExistError(
                     f"parent of {dst_uri} does not exist")
@@ -482,6 +586,9 @@ class FileSystemMaster:
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)
             inode = lookup.inode
+            from alluxio_tpu.security.authorization import WRITE
+
+            self._check_access(lookup, WRITE)
             targets: List[Inode] = []
             if inode.is_directory:
                 if not recursive and self.inode_tree.child_names(inode):
@@ -525,6 +632,7 @@ class FileSystemMaster:
                 raise FileAlreadyExistsError(f"{uri} already exists")
             if len(lookup.missing_components) > 1:
                 raise FileDoesNotExistError(f"parent of {uri} must exist")
+            self._check_parent_write(lookup)
             mount_id = ids.create_mount_id()
             # validate the UFS before journaling (link check, reference does
             # the same via UnderFileSystem creation + status probe)
@@ -559,6 +667,7 @@ class FileSystemMaster:
         with self.inode_tree.lock.write_locked():
             if not self.mount_table.is_mount_point(uri):
                 raise InvalidPathError(f"{uri} is not a mount point")
+            self._check_delete(self.inode_tree.lookup(uri))
             info = next(i for i in self.mount_table.mount_points()
                         if i.alluxio_path == uri.path)
             lookup = self.inode_tree.lookup(uri)
@@ -614,6 +723,23 @@ class FileSystemMaster:
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)
             inode = lookup.inode
+            user = self._auth_user()
+            self._perm.check_traverse(user, lookup.inodes[:-1])
+            if owner is not None:
+                # chown is superuser-only (reference parity)
+                self._perm.check_superuser(user)
+            elif mode is not None or group is not None:
+                self._perm.check_owner(user, inode, path=uri.path)
+            else:
+                from alluxio_tpu.security.authorization import WRITE
+
+                self._perm.check(user, inode, WRITE, path=uri.path)
+            if xattr is not None and any(k.startswith("system.")
+                                         for k in xattr):
+                # ACLs are managed via set_acl (owner-checked); letting a
+                # WRITE-only caller plant system.* xattrs would forge ACLs
+                raise InvalidArgumentError(
+                    "system.* xattr keys cannot be set via set_attribute")
             targets = [inode]
             if recursive and inode.is_directory:
                 targets.extend(self.inode_tree.descendants(inode))
@@ -641,6 +767,74 @@ class FileSystemMaster:
                         payload["xattr"] = xattr
                     ctx.append(EntryType.SET_ATTRIBUTE, payload)
 
+    # -------------------------------------------------------------- ACLs
+    ACL_XATTR = "system.acl"
+    DEFAULT_ACL_XATTR = "system.default.acl"
+
+    def set_acl(self, path: "str | AlluxioURI", entries: List[str], *,
+                default: bool = False, recursive: bool = False) -> None:
+        """Replace the extended ACL (reference: ``setAcl`` +
+        ``SET_ACL`` journal entry). ``entries``: ``user:name:rwx`` strings;
+        empty list removes the ACL. ``default=True`` sets the default ACL
+        inherited by new children (directories only)."""
+        from alluxio_tpu.security.authorization import AccessControlList
+
+        AccessControlList.from_entries(entries)  # validate
+        uri = AlluxioURI(path)
+        with self.inode_tree.lock.write_locked():
+            lookup = self.inode_tree.lookup(uri)
+            inode = lookup.inode
+            user = self._auth_user()
+            self._perm.check_traverse(user, lookup.inodes[:-1])
+            self._perm.check_owner(user, inode, path=uri.path)
+            if default and not inode.is_directory:
+                raise InvalidArgumentError(
+                    "default ACLs apply to directories only")
+            key = self.DEFAULT_ACL_XATTR if default else self.ACL_XATTR
+            targets = [inode]
+            if recursive and inode.is_directory:
+                targets.extend(
+                    d for d in self.inode_tree.descendants(inode)
+                    # default ACLs exist only on directories
+                    if d.is_directory or not default)
+            now = self._now()
+            with self._journal.create_context() as ctx:
+                for t in targets:
+                    xattr = dict(t.xattr)
+                    if entries:
+                        xattr[key] = ",".join(entries)
+                    else:
+                        xattr.pop(key, None)
+                    ctx.append(EntryType.SET_ACL, {
+                        "id": t.id, "xattr": xattr, "op_time_ms": now})
+
+    def get_acl(self, path: "str | AlluxioURI") -> Dict[str, List[str]]:
+        """Owner/group/mode base entries + extended + default entries
+        (reference: ``getAcl`` wire shape)."""
+        from alluxio_tpu.security.authorization import bits_to_string
+
+        uri = AlluxioURI(path)
+        with self.inode_tree.lock.read_locked():
+            lookup = self.inode_tree.lookup(uri)
+            inode = lookup.inode
+            from alluxio_tpu.security.authorization import READ
+
+            self._check_access(lookup, READ)
+            base = [
+                f"user:{inode.owner}:{bits_to_string((inode.mode >> 6) & 7)}",
+                f"group:{inode.group}:{bits_to_string((inode.mode >> 3) & 7)}",
+                f"other::{bits_to_string(inode.mode & 7)}",
+            ]
+            extended = inode.xattr.get(self.ACL_XATTR, "")
+            default = inode.xattr.get(self.DEFAULT_ACL_XATTR, "")
+            return {
+                "owner": inode.owner, "group": inode.group,
+                "mode": inode.mode,
+                "entries": base + ([e for e in extended.split(",") if e]),
+                "default_entries":
+                    [e for e in default.split(",") if e],
+            }
+
     def get_pinned_file_ids(self) -> Set[int]:
         with self.inode_tree.lock.read_locked():
             return set(self.inode_tree.pinned_ids)
@@ -663,6 +857,9 @@ class FileSystemMaster:
         """Reference: ``scheduleAsyncPersistence:3209``."""
         uri = AlluxioURI(path)
         with self.inode_tree.lock.write_locked():
+            from alluxio_tpu.security.authorization import WRITE
+
+            self._check_access(self.inode_tree.lookup(uri), WRITE)
             inode = self._existing_file(uri)
             if not inode.completed:
                 raise FileIncompleteError(f"{uri} is not completed")

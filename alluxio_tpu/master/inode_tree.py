@@ -156,6 +156,8 @@ class InodeTree(Journaled):
             self._apply_rename(p)
         elif t == EntryType.SET_ATTRIBUTE:
             self._apply_set_attribute(p)
+        elif t == EntryType.SET_ACL:
+            self._apply_set_acl(p)
         elif t == EntryType.PERSIST_FILE:
             self._apply_persist(p)
         else:
@@ -187,6 +189,15 @@ class InodeTree(Journaled):
         for k, v in p.items():
             if k != "id" and hasattr(inode, k):
                 setattr(inode, k, v)
+        self._store.put(inode)
+
+    def _apply_set_acl(self, p: dict) -> None:
+        inode = self._store.get(p["id"])
+        if inode is None:
+            return
+        inode.xattr = dict(p.get("xattr", {}))
+        inode.last_modification_time_ms = p.get(
+            "op_time_ms", inode.last_modification_time_ms)
         self._store.put(inode)
 
     def _apply_new_block(self, p: dict) -> None:

@@ -51,10 +51,21 @@ class MasterProcess:
         self.block_master = BlockMaster(
             self.journal, clock=self._clock,
             worker_timeout_ms=conf.get_ms(Keys.MASTER_WORKER_TIMEOUT))
+        from alluxio_tpu.security.authorization import PermissionChecker
+        from alluxio_tpu.security.user import get_os_user
+
+        checker = PermissionChecker(
+            enabled=conf.get_bool(
+                Keys.SECURITY_AUTHORIZATION_PERMISSION_ENABLED),
+            supergroup=str(conf.get(
+                Keys.SECURITY_AUTHORIZATION_PERMISSION_SUPERGROUP)),
+            superuser=get_os_user())
         self.fs_master = FileSystemMaster(
             self.block_master, self.journal, clock=self._clock,
             default_block_size=conf.get_bytes(
-                Keys.USER_BLOCK_SIZE_BYTES_DEFAULT))
+                Keys.USER_BLOCK_SIZE_BYTES_DEFAULT),
+            permission_checker=checker,
+            umask=int(conf.get(Keys.SECURITY_AUTHORIZATION_PERMISSION_UMASK)))
         from alluxio_tpu.master.sync import ActiveSyncManager
 
         self.active_sync = ActiveSyncManager(self.fs_master, self.journal)
@@ -82,11 +93,19 @@ class MasterProcess:
             Keys.MASTER_SAFEMODE_WAIT)
         metrics("Master")
         self._start_heartbeats()
+        from alluxio_tpu.security.audit import AsyncAuditLogWriter
+        from alluxio_tpu.security.authentication import Authenticator
+
+        self.audit_writer = AsyncAuditLogWriter()
+        self.audit_writer.start()
+        authenticator = Authenticator(self._conf)
         self.rpc_server = RpcServer(
             bind_host="0.0.0.0",
-            port=self._conf.get_int(Keys.MASTER_RPC_PORT))
+            port=self._conf.get_int(Keys.MASTER_RPC_PORT),
+            authenticator=authenticator)
         self.rpc_server.add_service(fs_master_service(
-            self.fs_master, active_sync=self.active_sync))
+            self.fs_master, active_sync=self.active_sync,
+            audit_writer=self.audit_writer))
         self.rpc_server.add_service(block_master_service(self.block_master))
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
@@ -137,6 +156,8 @@ class MasterProcess:
             t.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if getattr(self, "audit_writer", None) is not None:
+            self.audit_writer.stop()
         self.fs_master.stop()
         self.journal.stop()
 

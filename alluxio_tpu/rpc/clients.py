@@ -27,8 +27,9 @@ class _BaseClient:
     service = ""
 
     def __init__(self, address: str, *, retry_duration_s: float = 30.0,
-                 base_sleep_s: float = 0.05, max_sleep_s: float = 3.0) -> None:
-        self._channel = RpcChannel(address)
+                 base_sleep_s: float = 0.05, max_sleep_s: float = 3.0,
+                 metadata=None) -> None:
+        self._channel = RpcChannel(address, metadata=metadata)
         self._retry_duration_s = retry_duration_s
         self._base_sleep_s = base_sleep_s
         self._max_sleep_s = max_sleep_s
@@ -118,6 +119,14 @@ class FsMasterClient(_BaseClient):
 
     def sync_metadata(self, path: str) -> bool:
         return self._call("sync_metadata", {"path": str(path)})["changed"]
+
+    def set_acl(self, path: str, entries: List[str], *,
+                default: bool = False, recursive: bool = False) -> None:
+        self._call("set_acl", {"path": str(path), "entries": entries,
+                               "default": default, "recursive": recursive})
+
+    def get_acl(self, path: str) -> dict:
+        return self._call("get_acl", {"path": str(path)})
 
     def start_sync(self, path: str) -> None:
         self._call("start_sync", {"path": str(path)})

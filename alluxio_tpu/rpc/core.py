@@ -57,9 +57,30 @@ def unpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False, strict_map_key=False)
 
 
-def _wrap_unary(fn: Callable[[dict], Any]) -> Callable:
+def _bind_user(context: grpc.ServicerContext, authenticator):
+    """Authenticate request metadata and bind the user contextvar; returns
+    a reset token (or None). Raises AlluxioTpuError on rejection."""
+    if authenticator is None:
+        return None
+    from alluxio_tpu.security.user import set_authenticated_user
+
+    md = {k: v for k, v in (context.invocation_metadata() or ())}
+    user = authenticator.authenticate(md)
+    return set_authenticated_user(user)
+
+
+def _unbind_user(token) -> None:
+    if token is not None:
+        from alluxio_tpu.security.user import reset_authenticated_user
+
+        reset_authenticated_user(token)
+
+
+def _wrap_unary(fn: Callable[[dict], Any], authenticator=None) -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
+        token = None
         try:
+            token = _bind_user(context, authenticator)
             return fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -68,13 +89,18 @@ def _wrap_unary(fn: Callable[[dict], Any]) -> Callable:
         except Exception as e:  # noqa: BLE001
             LOG.exception("unhandled error in RPC handler")
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        finally:
+            _unbind_user(token)
 
     return handler
 
 
-def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]]) -> Callable:
+def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
+                     authenticator=None) -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
+        token = None
         try:
+            token = _bind_user(context, authenticator)
             yield from fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -83,13 +109,18 @@ def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]]) -> Callable:
         except Exception as e:  # noqa: BLE001
             LOG.exception("unhandled error in streaming RPC handler")
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        finally:
+            _unbind_user(token)
 
     return handler
 
 
-def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any]) -> Callable:
+def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any],
+                    authenticator=None) -> Callable:
     def handler(request_iterator, context: grpc.ServicerContext):
+        token = None
         try:
+            token = _bind_user(context, authenticator)
             return fn(request_iterator)
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -98,6 +129,8 @@ def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any]) -> Callable:
         except Exception as e:  # noqa: BLE001
             LOG.exception("unhandled error in client-streaming RPC handler")
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        finally:
+            _unbind_user(token)
 
     return handler
 
@@ -120,8 +153,10 @@ class ServiceDefinition:
 
 
 class _GenericHandler(grpc.GenericRpcHandler):
-    def __init__(self, services: Dict[str, ServiceDefinition]) -> None:
+    def __init__(self, services: Dict[str, ServiceDefinition],
+                 authenticator=None) -> None:
         self._services = services
+        self._auth = authenticator
 
     def service(self, handler_call_details):
         # method path: /<service>/<method>
@@ -136,16 +171,16 @@ class _GenericHandler(grpc.GenericRpcHandler):
         fn, kind = entry
         if kind == "unary":
             return grpc.unary_unary_rpc_method_handler(
-                _wrap_unary(fn), request_deserializer=unpack,
+                _wrap_unary(fn, self._auth), request_deserializer=unpack,
                 response_serializer=pack)
         if kind == "stream_out":
             return grpc.unary_stream_rpc_method_handler(
-                _wrap_stream_out(fn), request_deserializer=unpack,
-                response_serializer=pack)
+                _wrap_stream_out(fn, self._auth),
+                request_deserializer=unpack, response_serializer=pack)
         if kind == "stream_in":
             return grpc.stream_unary_rpc_method_handler(
-                _wrap_stream_in(fn), request_deserializer=unpack,
-                response_serializer=pack)
+                _wrap_stream_in(fn, self._auth),
+                request_deserializer=unpack, response_serializer=pack)
         return None
 
 
@@ -155,8 +190,13 @@ class RpcServer:
 
     def __init__(self, bind_host: str = "0.0.0.0", port: int = 0,
                  max_workers: int = 16,
-                 domain_socket_path: Optional[str] = None) -> None:
+                 domain_socket_path: Optional[str] = None,
+                 authenticator=None) -> None:
+        """``authenticator``: a ``security.authentication.Authenticator``;
+        when set, every RPC is authenticated and the resolved user is bound
+        for handlers to read via ``security.authenticated_user()``."""
         self._services: Dict[str, ServiceDefinition] = {}
+        self._authenticator = authenticator
         options = [
             ("grpc.max_send_message_length", 64 << 20),
             ("grpc.max_receive_message_length", 64 << 20),
@@ -175,7 +215,7 @@ class RpcServer:
 
     def start(self) -> int:
         self._server.add_generic_rpc_handlers(
-            (_GenericHandler(self._services),))
+            (_GenericHandler(self._services, self._authenticator),))
         self.port = self._server.add_insecure_port(self._bind)
         if self._domain_socket_path:
             # UDS endpoint for same-host traffic without TCP
@@ -202,16 +242,30 @@ def _raise_typed(err: grpc.RpcError) -> None:
         f"{err.code().name}: {err.details()}") from None
 
 
+def default_client_metadata() -> Tuple[Tuple[str, str], ...]:
+    """Identity attached to calls when the caller supplies none: the OS
+    user under SIMPLE auth (reference: LoginUser)."""
+    from alluxio_tpu.security.user import get_os_user
+
+    return (("atpu-user", get_os_user()),)
+
+
 class RpcChannel:
     """A pooled channel + method invokers (reference: GrpcConnectionPool
     multiplexes channels per NetworkGroup; grpc-python already multiplexes
-    streams on one HTTP/2 connection, so one channel per address suffices)."""
+    streams on one HTTP/2 connection, so one channel per address suffices).
+    ``metadata``: identity/credential tuples attached to every call
+    (reference: the SASL-authenticated channel carrying the user)."""
 
     _pool: Dict[str, grpc.Channel] = {}
     _pool_lock = threading.Lock()
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str,
+                 metadata: Optional[Tuple[Tuple[str, str], ...]] = None
+                 ) -> None:
         self.address = address
+        self.metadata = tuple(metadata) if metadata is not None \
+            else default_client_metadata()
         with RpcChannel._pool_lock:
             ch = RpcChannel._pool.get(address)
             if ch is None:
@@ -228,7 +282,7 @@ class RpcChannel:
             f"/{service}/{method}", request_serializer=pack,
             response_deserializer=unpack)
         try:
-            return fn(request, timeout=timeout)
+            return fn(request, timeout=timeout, metadata=self.metadata)
         except grpc.RpcError as e:
             _raise_typed(e)
 
@@ -238,7 +292,7 @@ class RpcChannel:
             f"/{service}/{method}", request_serializer=pack,
             response_deserializer=unpack)
         try:
-            yield from fn(request, timeout=timeout)
+            yield from fn(request, timeout=timeout, metadata=self.metadata)
         except grpc.RpcError as e:
             _raise_typed(e)
 
@@ -249,7 +303,7 @@ class RpcChannel:
             f"/{service}/{method}", request_serializer=pack,
             response_deserializer=unpack)
         try:
-            return fn(requests, timeout=timeout)
+            return fn(requests, timeout=timeout, metadata=self.metadata)
         except grpc.RpcError as e:
             _raise_typed(e)
 

@@ -42,8 +42,9 @@ class JobMasterClient:
 
     service = JOB_SERVICE
 
-    def __init__(self, address: str, *, retry_duration_s: float = 30.0):
-        self._channel = RpcChannel(address)
+    def __init__(self, address: str, *, retry_duration_s: float = 30.0,
+                 metadata=None):
+        self._channel = RpcChannel(address, metadata=metadata)
         self._retry_duration_s = retry_duration_s
 
     def _call(self, method: str, request: dict, timeout: float = 30.0):

@@ -35,11 +35,45 @@ def _timed(name: str, fn):
 
 
 def fs_master_service(fsm: FileSystemMaster,
-                      active_sync=None) -> ServiceDefinition:
+                      active_sync=None,
+                      audit_writer=None) -> ServiceDefinition:
     svc = ServiceDefinition(FS_SERVICE)
 
     def u(name, fn):
-        svc.unary(name, _timed(name, fn))
+        timed = _timed(name, fn)
+        if audit_writer is None:
+            svc.unary(name, timed)
+            return
+
+        def audited(req):
+            from alluxio_tpu.security.audit import AuditContext
+            from alluxio_tpu.security.user import authenticated_user
+            from alluxio_tpu.utils.exceptions import PermissionDeniedError
+
+            user = authenticated_user()
+            ctx = AuditContext(
+                command=name, src_path=str(req.get("path")
+                                           or req.get("src") or ""),
+                dst_path=str(req.get("dst") or ""),
+                user=user.name if user else "")
+            try:
+                return timed(req)
+            except PermissionDeniedError:
+                ctx.allowed = ctx.succeeded = False
+                raise
+            except Exception:
+                ctx.succeeded = False
+                raise
+            finally:
+                audit_writer.append(ctx)
+
+        svc.unary(name, audited)
+
+    u("set_acl", lambda r: (fsm.set_acl(
+        r["path"], r.get("entries", []),
+        default=r.get("default", False),
+        recursive=r.get("recursive", False)), {})[-1])
+    u("get_acl", lambda r: fsm.get_acl(r["path"]))
 
     if active_sync is not None:
         u("start_sync", lambda r: (
@@ -59,7 +93,7 @@ def fs_master_service(fsm: FileSystemMaster,
     u("create_file", lambda r: fsm.create_file(
         r["path"], block_size_bytes=r.get("block_size_bytes"),
         recursive=r.get("recursive", True), ttl=r.get("ttl", -1),
-        ttl_action=r.get("ttl_action", "DELETE"), mode=r.get("mode", 0o644),
+        ttl_action=r.get("ttl_action", "DELETE"), mode=r.get("mode"),
         owner=r.get("owner", ""), group=r.get("group", ""),
         replication_min=r.get("replication_min", 0),
         replication_max=r.get("replication_max", -1),
@@ -68,7 +102,7 @@ def fs_master_service(fsm: FileSystemMaster,
     u("create_directory", lambda r: fsm.create_directory(
         r["path"], recursive=r.get("recursive", True),
         allow_exists=r.get("allow_exists", False),
-        mode=r.get("mode", 0o755)).to_wire())
+        mode=r.get("mode")).to_wire())
     u("get_new_block_id", lambda r: {
         "block_id": fsm.get_new_block_id_for_file(r["path"])})
     u("complete_file", lambda r: (

@@ -1,0 +1,16 @@
+"""Security: authentication, authorization (POSIX + ACL), audit.
+
+Re-design of ``core/common/src/main/java/alluxio/security`` (41 files):
+the reference runs a SASL handshake over a dedicated gRPC stream
+(``ChannelAuthenticator``/``DefaultAuthenticationServer``); the TPU build
+carries the identity in per-RPC gRPC metadata validated server-side by a
+pluggable provider — same trust model for SIMPLE/CUSTOM (the wire asserts
+a username; CUSTOM validates an opaque credential), much less machinery.
+"""
+
+from alluxio_tpu.security.user import (
+    User, authenticated_user, get_client_user, set_authenticated_user,
+)
+
+__all__ = ["User", "authenticated_user", "get_client_user",
+           "set_authenticated_user"]

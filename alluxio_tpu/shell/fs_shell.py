@@ -737,6 +737,54 @@ class MasterInfoCommand(Command):
 
 
 @FS_SHELL.register
+class SetfaclCommand(Command):
+    name = "setfacl"
+    description = "Set the ACL of a path (-m entries | -b to remove)."
+
+    def configure(self, p):
+        p.add_argument("-R", action="store_true", dest="recursive")
+        p.add_argument("-d", action="store_true", dest="default",
+                       help="operate on the default ACL (directories)")
+        p.add_argument("-b", action="store_true", dest="remove_all",
+                       help="remove the extended ACL")
+        p.add_argument("-m", dest="entries", default=None,
+                       help="comma-separated entries, e.g. user:alice:rwx")
+        p.add_argument("path")
+
+    def run(self, args, ctx):
+        if args.remove_all:
+            entries = []
+        elif args.entries:
+            entries = [e for e in args.entries.split(",") if e]
+        else:
+            raise CommandError("one of -m <entries> or -b is required")
+        ctx.fs_client().set_acl(args.path, entries, default=args.default,
+                                recursive=args.recursive)
+        ctx.print(f"Modified ACL of {args.path}")
+        return 0
+
+
+@FS_SHELL.register
+class GetfaclCommand(Command):
+    name = "getfacl"
+    description = "Show the ACL of a path."
+
+    def configure(self, p):
+        p.add_argument("path")
+
+    def run(self, args, ctx):
+        acl = ctx.fs_client().get_acl(args.path)
+        ctx.print(f"# file: {args.path}")
+        ctx.print(f"# owner: {acl['owner']}")
+        ctx.print(f"# group: {acl['group']}")
+        for e in acl["entries"]:
+            ctx.print(e)
+        for e in acl["default_entries"]:
+            ctx.print(f"default:{e}" if not e.startswith("default:") else e)
+        return 0
+
+
+@FS_SHELL.register
 class StartSyncCommand(Command):
     name = "startSync"
     description = "Register a path as an active sync point."

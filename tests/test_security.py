@@ -1,0 +1,291 @@
+"""Security tests: authentication metadata, impersonation, POSIX + ACL
+authorization, audit log (reference: ``core/common/src/test/java/alluxio/
+security`` + master permission-check tests)."""
+
+from __future__ import annotations
+
+import logging
+
+import pytest
+
+from alluxio_tpu.conf import Configuration, Keys, Templates
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+from alluxio_tpu.rpc.clients import FsMasterClient
+from alluxio_tpu.security.authentication import (
+    USER_KEY, Authenticator, client_metadata,
+)
+from alluxio_tpu.security.authorization import (
+    EXECUTE, READ, WRITE, AccessControlList, AclEntry, check_bits,
+)
+from alluxio_tpu.security.user import User, get_os_user
+from alluxio_tpu.utils.exceptions import (
+    PermissionDeniedError, UnauthenticatedError,
+)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1,
+                      start_worker_heartbeats=True) as c:
+        yield c
+
+
+def client_as(cluster, user: str, impersonate: str = "") -> FsMasterClient:
+    md = [(USER_KEY, user)]
+    if impersonate:
+        md.append(("atpu-impersonate", impersonate))
+    return FsMasterClient(cluster.master.address, metadata=tuple(md))
+
+
+class TestAuthentication:
+    def test_os_user_flows_to_inode_owner(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/owned", b"x")
+        assert fs.get_status("/owned").owner == get_os_user()
+
+    def test_explicit_login_username(self, cluster, tmp_path):
+        from alluxio_tpu.client.file_system import FileSystem
+
+        # superuser opens a world-writable sandbox (root itself is 0o755
+        # owned by the master user, like the reference)
+        cluster.file_system().create_directory("/sandbox", mode=0o777)
+        conf = Configuration(load_env=False)
+        conf.set(Keys.SECURITY_LOGIN_USERNAME, "alice")
+        fs = FileSystem(cluster.master.address, conf=conf)
+        fs.create_directory("/sandbox/alice-dir")
+        assert fs.get_status("/sandbox/alice-dir").owner == "alice"
+
+    def test_missing_user_rejected(self, cluster):
+        c = FsMasterClient(cluster.master.address, metadata=(),
+                           retry_duration_s=0.1)
+        with pytest.raises(UnauthenticatedError):
+            c.get_status("/")
+
+    def test_custom_provider(self):
+        conf = Configuration(load_env=False)
+        conf.set(Keys.SECURITY_AUTH_TYPE, "CUSTOM")
+        conf.set(Keys.SECURITY_AUTH_CUSTOM_PROVIDER,
+                 "tests.test_security:reject_bob_provider")
+        auth = Authenticator(conf)
+        assert auth.authenticate({USER_KEY: "alice",
+                                  "atpu-token": "ok"}).name == "alice"
+        with pytest.raises(UnauthenticatedError):
+            auth.authenticate({USER_KEY: "bob", "atpu-token": "ok"})
+
+    def test_impersonation_allowlist(self):
+        conf = Configuration(load_env=False)
+        conf.set(Templates.MASTER_IMPERSONATION_USERS.format("proxyd"),
+                 "alice,carol")
+        auth = Authenticator(conf)
+        u = auth.authenticate({USER_KEY: "proxyd",
+                               "atpu-impersonate": "alice"})
+        assert u.name == "alice" and u.connection_user == "proxyd"
+        with pytest.raises(PermissionDeniedError):
+            auth.authenticate({USER_KEY: "proxyd",
+                               "atpu-impersonate": "mallory"})
+        with pytest.raises(PermissionDeniedError):
+            auth.authenticate({USER_KEY: "otherd",
+                               "atpu-impersonate": "alice"})
+
+    def test_wildcard_impersonation(self):
+        conf = Configuration(load_env=False)
+        conf.set(Templates.MASTER_IMPERSONATION_USERS.format("superproxy"),
+                 "*")
+        auth = Authenticator(conf)
+        assert auth.authenticate(
+            {USER_KEY: "superproxy",
+             "atpu-impersonate": "anyone"}).name == "anyone"
+
+
+def reject_bob_provider(user: str, token: str) -> None:
+    if user == "bob":
+        raise ValueError("bob is not welcome")
+
+
+class TestModeBits:
+    def test_owner_group_other_ladder(self):
+        kw = dict(owner="alice", group="team", mode=0o640)
+        assert check_bits(bits_wanted=READ | WRITE, user="alice",
+                          groups=(), **kw)
+        assert check_bits(bits_wanted=READ, user="bob", groups=("team",),
+                          **kw)
+        assert not check_bits(bits_wanted=WRITE, user="bob",
+                              groups=("team",), **kw)
+        assert not check_bits(bits_wanted=READ, user="eve", groups=(), **kw)
+
+    def test_acl_named_user_and_mask(self):
+        kw = dict(owner="alice", group="team", mode=0o600)
+        entries = ["user:bob:rw-"]
+        assert check_bits(bits_wanted=READ | WRITE, user="bob", groups=(),
+                          acl_entries=entries, **kw)
+        # mask caps named-user perms
+        entries = ["user:bob:rw-", "mask::r--"]
+        assert not check_bits(bits_wanted=WRITE, user="bob", groups=(),
+                              acl_entries=entries, **kw)
+        assert check_bits(bits_wanted=READ, user="bob", groups=(),
+                          acl_entries=entries, **kw)
+
+    def test_acl_entry_roundtrip(self):
+        e = AclEntry.parse("default:user:carol:r-x")
+        assert e.is_default and e.subject == "carol" and \
+            e.bits == (READ | EXECUTE)
+        assert e.to_cli_string() == "default:user:carol:r-x"
+        acl = AccessControlList.from_entries(
+            ["user:a:rwx", "group:g:r--", "mask::rw-"])
+        assert acl.named_users["a"] == 7 and acl.mask == READ | WRITE
+
+
+class TestEnforcement:
+    def test_other_user_cannot_write_0700_dir(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/private")
+        fs.set_attribute("/private", owner="alice", mode=0o700)
+        bob = client_as(cluster, "bob")
+        with pytest.raises(PermissionDeniedError):
+            bob.create_file("/private/f")
+        alice = client_as(cluster, "alice")
+        alice.create_file("/private/ok")
+
+    def test_delete_requires_parent_write(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/shared", mode=0o755)
+        fs.set_attribute("/shared", owner="alice", mode=0o755)
+        alice = client_as(cluster, "alice")
+        alice.create_file("/shared/hers")
+        bob = client_as(cluster, "bob")
+        with pytest.raises(PermissionDeniedError):
+            bob.delete("/shared/hers")
+
+    def test_chown_superuser_only(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/f-owned", b"x")
+        alice = client_as(cluster, "alice")
+        with pytest.raises(PermissionDeniedError):
+            alice.set_attribute("/f-owned", owner="alice")
+        # the cluster process user is the superuser
+        fs.set_attribute("/f-owned", owner="alice")
+        assert fs.get_status("/f-owned").owner == "alice"
+
+    def test_chmod_owner_only(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/m", b"x")
+        fs.set_attribute("/m", owner="alice")
+        bob = client_as(cluster, "bob")
+        with pytest.raises(PermissionDeniedError):
+            bob.set_attribute("/m", mode=0o777)
+        client_as(cluster, "alice").set_attribute("/m", mode=0o604)
+        assert fs.get_status("/m").mode == 0o604
+
+    def test_acl_grants_access(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/acld")
+        fs.set_attribute("/acld", owner="alice", mode=0o700)
+        bob = client_as(cluster, "bob")
+        with pytest.raises(PermissionDeniedError):
+            bob.list_status("/acld")
+        client_as(cluster, "alice").set_acl(
+            "/acld", ["user:bob:r-x"])
+        assert bob.list_status("/acld") == []
+        acl = fs.fs_master.get_acl("/acld")
+        assert "user:bob:r-x" in acl["entries"]
+
+    def test_default_acl_inheritance(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/proj")
+        fs.set_acl = fs.fs_master.set_acl
+        fs.fs_master.set_acl("/proj", ["user:bob:rwx"], default=True)
+        fs.write_all("/proj/child", b"x")
+        acl = fs.fs_master.get_acl("/proj/child")
+        assert "user:bob:rwx" in acl["entries"]
+
+    def test_umask_applied_to_default_mode(self, cluster):
+        cluster.file_system().create_directory("/open", mode=0o777)
+        bob = client_as(cluster, "bob")
+        # default mode is shaped by the 0o022 umask...
+        info = bob.create_file("/open/umasked")
+        assert info.mode == 0o666 & ~0o022
+        # ...but an explicit mode is kept verbatim (reference:
+        # ModeUtils.applyFileUMask applies to option defaults only)
+        info = bob.create_file("/open/explicit", mode=0o666)
+        assert info.mode == 0o666
+
+
+class TestEscalationRegressions:
+    """Holes closed after review: ACL forging via xattr, unchecked
+    mutation RPCs, nested default-ACL inheritance."""
+
+    def test_xattr_cannot_forge_acl(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/open2", mode=0o777)
+        bob = client_as(cluster, "bob")
+        bob.create_file("/open2/f")
+        from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+        with pytest.raises(InvalidArgumentError):
+            bob.set_attribute("/open2/f",
+                              xattr={"system.acl": "user:bob:rwx"})
+
+    def test_get_acl_needs_read(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/hidden", b"x")
+        fs.set_attribute("/hidden", owner="alice", mode=0o600)
+        bob = client_as(cluster, "bob")
+        with pytest.raises(PermissionDeniedError):
+            bob.get_acl("/hidden")
+
+    def test_complete_file_needs_write(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/open3", mode=0o777)
+        alice = client_as(cluster, "alice")
+        alice.create_file("/open3/partial")
+        bob = client_as(cluster, "bob")
+        with pytest.raises(PermissionDeniedError):
+            bob.complete_file("/open3/partial", length=0)
+        with pytest.raises(PermissionDeniedError):
+            bob.get_new_block_id("/open3/partial")
+
+    def test_nested_default_acl_inheritance(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/proj2")
+        fs.fs_master.set_acl("/proj2", ["user:bob:rwx"], default=True)
+        # recursive create: intermediate dirs must carry the default on
+        fs.write_all("/proj2/a/b/deep", b"x")
+        acl = fs.fs_master.get_acl("/proj2/a/b/deep")
+        assert "user:bob:rwx" in acl["entries"]
+        mid = fs.fs_master.get_acl("/proj2/a")
+        assert "default:user:bob:rwx" in mid["default_entries"] or \
+            "user:bob:rwx" in mid["default_entries"]
+
+    def test_recursive_default_acl_skips_files(self, cluster):
+        fs = cluster.file_system()
+        fs.create_directory("/mix")
+        fs.write_all("/mix/f", b"x")
+        fs.create_directory("/mix/sub")
+        fs.fs_master.set_acl("/mix", ["user:bob:r-x"], default=True,
+                             recursive=True)
+        assert fs.fs_master.get_acl("/mix/f")["default_entries"] == []
+        assert fs.fs_master.get_acl("/mix/sub")["default_entries"] != []
+
+
+class TestAudit:
+    def test_audit_entries_logged(self, cluster, caplog):
+        with caplog.at_level(logging.INFO, logger="alluxio_tpu.audit"):
+            fs = cluster.file_system()
+            fs.create_directory("/audited")
+            fs.set_attribute("/audited", owner="alice", mode=0o700)
+            bob = client_as(cluster, "bob")
+            with pytest.raises(PermissionDeniedError):
+                bob.create_file("/audited/nope")
+            import time
+
+            deadline = time.monotonic() + 3
+            while time.monotonic() < deadline:
+                if any("allowed=false" in r.message
+                       for r in caplog.records):
+                    break
+                time.sleep(0.05)
+        msgs = [r.message for r in caplog.records]
+        assert any("cmd=create_directory" in m and "src=/audited" in m
+                   for m in msgs)
+        denied = [m for m in msgs if "allowed=false" in m]
+        assert denied and "ugi=bob" in denied[0]

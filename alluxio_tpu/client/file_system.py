@@ -90,6 +90,25 @@ class FileSystem:
             short_circuit=self._conf.get_bool(Keys.USER_SHORT_CIRCUIT_ENABLED),
             passive_cache=self._conf.get_bool(
                 Keys.USER_FILE_PASSIVE_CACHE_ENABLED))
+        # pull cluster defaults once at start (reference: clients load
+        # cluster-default config via the meta master on first connect)
+        self._path_conf: Dict[str, Dict[str, str]] = {}
+        self._path_conf_hash: Optional[str] = None
+        self._config_hash: Optional[str] = None
+        if self._conf.get_bool(Keys.USER_CONF_CLUSTER_DEFAULT_ENABLED):
+            try:
+                from alluxio_tpu.conf import Source
+
+                # short retry: an offline master must not stall client
+                # construction for the full 30s default retry window
+                quick = MetaMasterClient(master_address, metadata=md,
+                                         retry_duration_s=1.0)
+                resp = quick.get_configuration()
+                self._conf.merge(resp["properties"], Source.CLUSTER_DEFAULT)
+                self._config_hash = resp["hash"]
+                self._refresh_path_conf()
+            except Exception:  # noqa: BLE001 - offline client still works
+                pass
         md_cache_size = self._conf.get_int(Keys.USER_METADATA_CACHE_MAX_SIZE)
         self._md_cache = _MetadataCache(
             md_cache_size,
@@ -97,7 +116,6 @@ class FileSystem:
         ) if md_cache_size > 0 else None
         self._sync_interval_ms = int(1000 * self._conf.get_duration_s(
             Keys.USER_FILE_METADATA_SYNC_INTERVAL))
-        self._config_hash: Optional[str] = None
         self._page_cache = None
         if self._conf.get_bool(Keys.USER_CLIENT_CACHE_ENABLED):
             from alluxio_tpu.client.cache.manager import LocalCacheManager
@@ -226,13 +244,36 @@ class FileSystem:
             return CachingFileInStream(stream, self._page_cache)
         return stream
 
+    def _refresh_path_conf(self) -> None:
+        resp = self.meta_master.get_path_conf()
+        self._path_conf = resp.get("properties", {})
+        self._path_conf_hash = resp.get("hash")
+
+    def path_default(self, path: "str | AlluxioURI",
+                     key) -> Optional[str]:
+        """Per-path cluster default for a property, longest prefix wins
+        (reference: PathProperties served by the meta master)."""
+        if not self._path_conf:
+            return None
+        from alluxio_tpu.master.path_properties import resolve_path_property
+
+        name = key if isinstance(key, str) else key.name
+        return resolve_path_property(self._path_conf,
+                                     AlluxioURI(path).path, name)
+
     def create_file(self, path: "str | AlluxioURI", *,
                     write_type: Optional[str] = None,
                     block_size_bytes: Optional[int] = None,
                     tier: str = "", pinned: bool = False,
                     **opts) -> FileOutStream:
         self._invalidate(path)
-        wt = write_type or self._conf.get(Keys.USER_FILE_WRITE_TYPE_DEFAULT)
+        wt = write_type or \
+            self.path_default(path, Keys.USER_FILE_WRITE_TYPE_DEFAULT) or \
+            self._conf.get(Keys.USER_FILE_WRITE_TYPE_DEFAULT)
+        if "replication_min" not in opts:
+            rep = self.path_default(path, Keys.USER_FILE_REPLICATION_MIN)
+            if rep is not None:
+                opts["replication_min"] = int(rep)
         persist_on_complete = wt == WriteType.ASYNC_THROUGH
         info = self.fs_master.create_file(
             AlluxioURI(path).path, block_size_bytes=block_size_bytes,
@@ -264,6 +305,10 @@ class FileSystem:
             resp = self.meta_master.get_configuration()
             self._conf.merge(resp["properties"], Source.CLUSTER_DEFAULT)
             self._config_hash = resp["hash"]
+            try:
+                self._refresh_path_conf()
+            except Exception:  # noqa: BLE001 - older master without the RPC
+                pass
             return True
         return False
 

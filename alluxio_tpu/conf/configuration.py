@@ -22,12 +22,15 @@ from alluxio_tpu.conf.property_key import (
 
 
 class Source(enum.IntEnum):
-    """Priority-ordered provenance of a config value (higher wins)."""
+    """Priority-ordered provenance of a config value (higher wins).
+    Order mirrors the reference's ``Source.Type``: cluster defaults served
+    by the master sit just above built-in defaults, so any locally-set
+    site/env/runtime value beats them."""
 
     DEFAULT = 0
-    SITE_PROPERTY = 1
-    ENVIRONMENT = 2
-    CLUSTER_DEFAULT = 3
+    CLUSTER_DEFAULT = 1
+    SITE_PROPERTY = 2
+    ENVIRONMENT = 3
     PATH_DEFAULT = 4
     RUNTIME = 5
     MOUNT_OPTION = 6

@@ -469,6 +469,10 @@ class Keys:
     USER_METADATA_CACHE_EXPIRATION_TIME = _k(
         "atpu.user.metadata.cache.expiration.time", KeyType.DURATION, default="10min",
         scope=Scope.CLIENT)
+    USER_CONF_CLUSTER_DEFAULT_ENABLED = _k(
+        "atpu.user.conf.cluster.default.enabled", KeyType.BOOL, default=True,
+        description="Pull cluster-default configuration from the master at "
+                    "client start (reference: meta_master.proto:196-211).")
     USER_CONF_SYNC_INTERVAL = _k("atpu.user.conf.sync.interval", KeyType.DURATION,
                                  default="1min", scope=Scope.CLIENT)
     USER_FILE_METADATA_SYNC_INTERVAL = _k(

@@ -181,6 +181,12 @@ class FileSystemMaster:
         self._maybe_sync(uri, sync_interval_ms)
         with self.inode_tree.lock.read_locked():
             lookup = self.inode_tree.lookup(uri)
+            # POSIX stat semantics: EXECUTE on every ancestor (no READ on
+            # the target itself) — without this, stat leaks metadata of
+            # paths under 0700 directories
+            self._perm.check_traverse(self._auth_user(),
+                                      lookup.inodes[:-1] if lookup.exists
+                                      else lookup.inodes)
             if not lookup.exists:
                 loaded = None
             else:
@@ -768,8 +774,9 @@ class FileSystemMaster:
                     ctx.append(EntryType.SET_ATTRIBUTE, payload)
 
     # -------------------------------------------------------------- ACLs
-    ACL_XATTR = "system.acl"
-    DEFAULT_ACL_XATTR = "system.default.acl"
+    from alluxio_tpu.security.authorization import (
+        ACL_XATTR, DEFAULT_ACL_XATTR,
+    )
 
     def set_acl(self, path: "str | AlluxioURI", entries: List[str], *,
                 default: bool = False, recursive: bool = False) -> None:

@@ -66,9 +66,16 @@ class MasterProcess:
                 Keys.USER_BLOCK_SIZE_BYTES_DEFAULT),
             permission_checker=checker,
             umask=int(conf.get(Keys.SECURITY_AUTHORIZATION_PERMISSION_UMASK)))
+        from alluxio_tpu.master.path_properties import (
+            ConfigurationChecker, PathProperties,
+        )
         from alluxio_tpu.master.sync import ActiveSyncManager
 
         self.active_sync = ActiveSyncManager(self.fs_master, self.journal)
+        self.path_properties = PathProperties(self.journal)
+        self.config_checker = ConfigurationChecker()
+        self.config_checker.register(
+            "master", {k: str(v) for k, v in conf.to_map().items()})
         self._root_ufs_uri = root_ufs_uri or conf.get(Keys.HOME) + \
             "/underFSStorage"
         self.rpc_server: Optional[RpcServer] = None
@@ -110,7 +117,9 @@ class MasterProcess:
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
             start_time_ms=self.start_time_ms,
-            safe_mode_fn=self.in_safe_mode, journal=self.journal))
+            safe_mode_fn=self.in_safe_mode, journal=self.journal,
+            path_properties=self.path_properties,
+            config_checker=self.config_checker))
         self.rpc_port = self.rpc_server.start()
         return self.rpc_port
 

@@ -93,7 +93,9 @@ class LocalCluster:
             tiered_identity=TieredIdentity.from_spec(
                 f"host=localhost-w{index},slice=slice0"))
         worker = BlockWorker(wconf, bm_client, fs_client,
-                             ufs_manager=None, address=address)
+                             ufs_manager=None, address=address,
+                             meta_master_client=MetaMasterClient(
+                                 self.master.address))
         # UFS resolution must be in place before the RPC server serves a
         # single read (a UFS-descriptor read in the gap would crash on None)
         worker.ufs_manager = WorkerUfsManager(fs_client)

@@ -209,6 +209,26 @@ class MetaMasterClient(_BaseClient):
     def get_metrics(self) -> Dict[str, float]:
         return self._call("get_metrics", {})["metrics"]
 
+    def set_path_conf(self, path: str, properties: Dict[str, str]) -> None:
+        self._call("set_path_conf", {"path": str(path),
+                                     "properties": properties})
+
+    def remove_path_conf(self, path: str,
+                         keys: Optional[List[str]] = None) -> None:
+        self._call("remove_path_conf", {"path": str(path), "keys": keys})
+
+    def get_path_conf(self) -> dict:
+        """{"properties": {path: {k: v}}, "hash": str}"""
+        return self._call("get_path_conf", {})
+
+    def register_node_conf(self, node_id: str,
+                           config: Dict[str, str]) -> None:
+        self._call("register_node_conf", {"node_id": node_id,
+                                          "config": config})
+
+    def get_config_report(self) -> dict:
+        return self._call("get_config_report", {})
+
     def checkpoint(self) -> None:
         self._call("checkpoint", {}, timeout=300.0)
 

@@ -181,7 +181,9 @@ def block_master_service(bm: BlockMaster) -> ServiceDefinition:
 def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         start_time_ms: int = 0,
                         safe_mode_fn=lambda: False,
-                        journal=None) -> ServiceDefinition:
+                        journal=None,
+                        path_properties=None,
+                        config_checker=None) -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
     config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
@@ -208,4 +210,18 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
         return {}
 
     svc.unary("checkpoint", _checkpoint)
+
+    if path_properties is not None:
+        svc.unary("set_path_conf", lambda r: (
+            path_properties.add(r["path"], r["properties"]), {})[-1])
+        svc.unary("remove_path_conf", lambda r: (
+            path_properties.remove(r["path"], r.get("keys")), {})[-1])
+        svc.unary("get_path_conf", lambda r: {
+            "properties": path_properties.get_all(),
+            "hash": path_properties.hash()})
+    if config_checker is not None:
+        svc.unary("register_node_conf", lambda r: (
+            config_checker.register(r["node_id"], r.get("config", {})),
+            {})[-1])
+        svc.unary("get_config_report", lambda r: config_checker.report())
     return svc

@@ -19,6 +19,16 @@ READ = 4
 WRITE = 2
 EXECUTE = 1
 
+#: xattr keys carrying ACLs (single source of truth; the file master and
+#: the checker both use these)
+ACL_XATTR = "system.acl"
+DEFAULT_ACL_XATTR = "system.default.acl"
+
+
+def acl_entries_of(inode) -> "Optional[List[str]]":
+    raw = inode.xattr.get(ACL_XATTR, "")
+    return raw.split(",") if raw else None
+
 
 def bits_to_string(bits: int) -> str:
     return (("r" if bits & READ else "-") + ("w" if bits & WRITE else "-")
@@ -110,22 +120,29 @@ def check_bits(*, bits_wanted: int, user: str, groups: Sequence[str],
                acl_entries: Optional[List[str]] = None) -> bool:
     """POSIX + ACL evaluation order (reference:
     AccessControlList.checkPermission): owner, named users, owning/named
-    groups (mask-limited), other."""
+    groups, other. Per POSIX.1e, each matching group entry is evaluated
+    INDIVIDUALLY (mask-limited): access is granted iff at least one entry
+    alone carries every requested bit — entries are never OR-merged."""
     if user == owner:
         return (mode >> 6) & bits_wanted == bits_wanted
     acl = AccessControlList.from_entries(acl_entries or [])
     if user in acl.named_users:
         return acl.effective(acl.named_users[user]) & bits_wanted \
             == bits_wanted
-    group_bits = None
+    matched_group = False
     if group and group in groups:
-        group_bits = (mode >> 3) & 7
+        matched_group = True
+        # the owning-group bits are mask-limited when an extended ACL exists
+        if acl.effective((mode >> 3) & 7) & bits_wanted == bits_wanted:
+            return True
     for g in groups:
         if g in acl.named_groups:
-            b = acl.effective(acl.named_groups[g])
-            group_bits = b if group_bits is None else (group_bits | b)
-    if group_bits is not None:
-        return group_bits & bits_wanted == bits_wanted
+            matched_group = True
+            if acl.effective(acl.named_groups[g]) & bits_wanted \
+                    == bits_wanted:
+                return True
+    if matched_group:
+        return False
     return mode & bits_wanted == bits_wanted
 
 
@@ -158,9 +175,7 @@ class PermissionChecker:
             if not check_bits(bits_wanted=EXECUTE, user=user.name,
                               groups=user.groups, owner=inode.owner,
                               group=inode.group, mode=inode.mode,
-                              acl_entries=list(inode.xattr.get(
-                                  "system.acl", "").split(",")) if
-                              inode.xattr.get("system.acl") else None):
+                              acl_entries=acl_entries_of(inode)):
                 raise PermissionDeniedError(
                     f"user {user.name} lacks execute on "
                     f"ancestor {inode.name or '/'}")
@@ -169,14 +184,10 @@ class PermissionChecker:
               path: str = "") -> None:
         if not self.enabled or user is None or self.is_superuser(user):
             return
-        entries = None
-        raw = inode.xattr.get("system.acl", "")
-        if raw:
-            entries = raw.split(",")
         if not check_bits(bits_wanted=bits_wanted, user=user.name,
                           groups=user.groups, owner=inode.owner,
                           group=inode.group, mode=inode.mode,
-                          acl_entries=entries):
+                          acl_entries=acl_entries_of(inode)):
             raise PermissionDeniedError(
                 f"user {user.name} lacks "
                 f"{bits_to_string(bits_wanted).replace('-', '')} on "

@@ -31,8 +31,26 @@ def get_os_user() -> str:
         return os.environ.get("USER", f"uid-{os.getuid()}")
 
 
+_GROUP_CACHE: dict = {}
+_GROUP_CACHE_TTL_S = 60.0
+
+
 def get_os_groups(user: str) -> List[str]:
-    """OS group mapping (reference: ShellBasedUnixGroupsMapping)."""
+    """OS group mapping, cached with a TTL — grp.getgrall() enumerates the
+    whole group database (an NSS/LDAP round trip on some hosts) and this
+    runs on the master's per-RPC authentication path (reference: the
+    GroupMappingService cache)."""
+    import time
+
+    hit = _GROUP_CACHE.get(user)
+    if hit is not None and time.monotonic() - hit[1] < _GROUP_CACHE_TTL_S:
+        return list(hit[0])
+    groups = _get_os_groups_uncached(user)
+    _GROUP_CACHE[user] = (groups, time.monotonic())
+    return list(groups)
+
+
+def _get_os_groups_uncached(user: str) -> List[str]:
     try:
         import grp
         import pwd

@@ -11,7 +11,9 @@ import sys
 import time
 
 from alluxio_tpu.conf import Source
-from alluxio_tpu.shell.command import Command, Shell, human_size
+from alluxio_tpu.shell.command import (
+    Command, CommandError, Shell, human_size,
+)
 
 ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 
@@ -109,6 +111,18 @@ class DoctorCommand(Command):
                        choices=["configuration"])
 
     def run(self, args, ctx):
+        # cluster-wide consistency report (ServerConfigurationChecker);
+        # degrade gracefully against masters without the RPC
+        try:
+            report = ctx.meta_client().get_config_report()
+        except Exception:  # noqa: BLE001
+            report = {"status": "UNAVAILABLE", "errors": [], "warns": []}
+        ctx.print(f"Server-side configuration check: {report['status']}")
+        for e in report.get("errors", []):
+            ctx.print(f"ERROR: {e}")
+        for w in report.get("warns", []):
+            ctx.print(f"WARN: {w}")
+        # local-vs-cluster diff
         server_conf = ctx.meta_client().get_configuration()
         server = server_conf.get("properties", {})
         local = ctx.conf.to_map(min_source=Source.SITE_PROPERTY)
@@ -119,11 +133,53 @@ class DoctorCommand(Command):
                 ctx.print(f"WARN: {key} differs: server='{val}' "
                           f"client='{mine}'")
                 issues += 1
-        if server_conf.get("hash") != ctx.conf.hash():
-            ctx.print("INFO: client configuration hash differs from the "
-                      "cluster default (expected when overrides are set)")
-        if issues == 0:
-            ctx.print("No server-/client-side configuration conflicts found.")
+        if issues == 0 and report["status"] == "PASSED":
+            ctx.print("No configuration conflicts found.")
+        return 0 if report["status"] != "FAILED" else 1
+
+
+@ADMIN_SHELL.register
+class PathConfCommand(Command):
+    name = "pathConf"
+    description = "Manage per-path configuration defaults."
+
+    def configure(self, p):
+        sub = p.add_subparsers(dest="op", required=True)
+        sub.add_parser("list")
+        show = sub.add_parser("show")
+        show.add_argument("path")
+        add = sub.add_parser("add")
+        add.add_argument("--property", action="append", default=[],
+                         dest="props", help="key=value (repeatable)")
+        add.add_argument("path")
+        rm = sub.add_parser("remove")
+        rm.add_argument("--keys", default=None,
+                        help="comma-separated keys (all when omitted)")
+        rm.add_argument("path")
+
+    def run(self, args, ctx):
+        mc = ctx.meta_client()
+        if args.op == "list":
+            for path in sorted(mc.get_path_conf()["properties"]):
+                ctx.print(path)
+        elif args.op == "show":
+            props = mc.get_path_conf()["properties"].get(args.path, {})
+            for k in sorted(props):
+                ctx.print(f"{k}={props[k]}")
+        elif args.op == "add":
+            kv = {}
+            for p in args.props:
+                if "=" not in p:
+                    raise CommandError(
+                        f"--property must be key=value, got {p!r}")
+                k, _, v = p.partition("=")
+                kv[k] = v
+            mc.set_path_conf(args.path, kv)
+            ctx.print(f"Properties of path {args.path} updated")
+        elif args.op == "remove":
+            keys = args.keys.split(",") if args.keys else None
+            mc.remove_path_conf(args.path, keys)
+            ctx.print(f"Properties of path {args.path} removed")
         return 0
 
 

@@ -44,7 +44,9 @@ def launch_master(conf: Configuration) -> int:
 
 
 def launch_worker(conf: Configuration) -> int:
-    from alluxio_tpu.rpc.clients import BlockMasterClient, FsMasterClient
+    from alluxio_tpu.rpc.clients import (
+        BlockMasterClient, FsMasterClient, MetaMasterClient,
+    )
     from alluxio_tpu.rpc.core import RpcServer
     from alluxio_tpu.rpc.worker_service import worker_service
     from alluxio_tpu.worker.process import BlockWorker
@@ -53,7 +55,8 @@ def launch_worker(conf: Configuration) -> int:
     master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
                    f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
     fs_client = FsMasterClient(master_addr)
-    worker = BlockWorker(conf, BlockMasterClient(master_addr), fs_client)
+    worker = BlockWorker(conf, BlockMasterClient(master_addr), fs_client,
+                         meta_master_client=MetaMasterClient(master_addr))
     worker.ufs_manager = WorkerUfsManager(fs_client)
     server = RpcServer(bind_host="0.0.0.0",
                        port=conf.get_int(Keys.WORKER_RPC_PORT))

@@ -98,7 +98,9 @@ class BlockWorker:
     def __init__(self, conf: Configuration, block_master_client,
                  fs_master_client=None,
                  ufs_manager: Optional[UfsManager] = None,
-                 address: Optional[WorkerNetAddress] = None) -> None:
+                 address: Optional[WorkerNetAddress] = None,
+                 meta_master_client=None) -> None:
+        self._meta_client = meta_master_client
         self._conf = conf
         self.store = build_store_from_conf(conf)
         self.ufs_manager = ufs_manager or UfsManager()
@@ -135,6 +137,13 @@ class BlockWorker:
         """Register then start heartbeats
         (reference: ``DefaultBlockWorker.start:197-242``)."""
         self._master_sync.register_with_master()
+        if self._meta_client is not None:
+            try:  # config consistency report (ServerConfigurationChecker)
+                self._meta_client.register_node_conf(
+                    f"worker-{self.address.host}:{self.address.rpc_port}",
+                    {k: str(v) for k, v in self._conf.to_map().items()})
+            except Exception:  # noqa: BLE001 - older master
+                LOG.debug("config report failed", exc_info=True)
         hb_interval = self._conf.get_duration_s(
             Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL)
         mgmt_interval = self._conf.get_duration_s(
@@ -176,13 +185,24 @@ class BlockWorker:
     def commit_block(self, session_id: int, block_id: int,
                      pinned: bool = False) -> None:
         """Commit locally then report to the master (reference:
-        ``DefaultBlockWorker.commitBlock`` -> BlockMasterClient.commitBlock)."""
-        meta = self.store.commit_block(session_id, block_id, pinned)
+        ``DefaultBlockWorker.commitBlock`` -> BlockMasterClient.commitBlock).
+
+        The heartbeat "committed" delta is emitted only AFTER the master
+        acknowledges: a delta arriving before the commit RPC makes the
+        master free the block as an orphan (observed race)."""
+        meta = self.store.commit_block(session_id, block_id, pinned,
+                                       emit=False)
         client = self._master_sync._client
-        if self._master_sync.worker_id is not None:
-            used = self.store.meta.get_tier(meta.tier_alias).used_bytes
-            client.commit_block(self._master_sync.worker_id, used,
-                                meta.tier_alias, block_id, meta.length)
+        try:
+            if self._master_sync.worker_id is not None:
+                used = self.store.meta.get_tier(meta.tier_alias).used_bytes
+                client.commit_block(self._master_sync.worker_id, used,
+                                    meta.tier_alias, block_id, meta.length)
+        finally:
+            # emit even when the RPC failed: the heartbeat delta then tells
+            # the master about the block, which either records it (RPC
+            # actually landed) or frees the orphan — both clean outcomes
+            self.store._emit("committed", block_id)
 
     def abort_block(self, session_id: int, block_id: int) -> None:
         self.store.abort_block(session_id, block_id)

@@ -165,8 +165,14 @@ class TieredBlockStore:
             temp.bytes_reserved += additional
 
     def commit_block(self, session_id: int, block_id: int,
-                     pinned: bool = False) -> BlockMeta:
-        """Temp -> committed: rename into place, fix accounting, annotate."""
+                     pinned: bool = False, emit: bool = True) -> BlockMeta:
+        """Temp -> committed: rename into place, fix accounting, annotate.
+
+        ``emit=False``: suppress the "committed" listener event; the caller
+        emits it after the master acknowledges the commit. Otherwise the
+        heartbeat delta can reach the master BEFORE the synchronous
+        commit RPC, and the master frees the "orphan" (reference split:
+        onCommitBlockToLocal vs onCommitBlockToMaster)."""
         with self._alloc_lock:
             temp = self.meta.get_temp(block_id)
             if temp is None:
@@ -196,7 +202,8 @@ class TieredBlockStore:
                 self.pinned_blocks.add(block_id)
         self.annotator.on_commit(block_id)
         self._m.counter("Worker.BlocksCommitted").inc()
-        self._emit("committed", block_id)
+        if emit:
+            self._emit("committed", block_id)
         return final
 
     def abort_block(self, session_id: int, block_id: int) -> None:

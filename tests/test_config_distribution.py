@@ -1,0 +1,141 @@
+"""Config distinctives (reference §5.6): cluster-default distribution,
+per-path defaults, live config reload, consistency report."""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from alluxio_tpu.client.file_system import FileSystem
+from alluxio_tpu.conf import Configuration, Keys, Source
+from alluxio_tpu.master.path_properties import (
+    ConfigurationChecker, resolve_path_property,
+)
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1,
+                      start_worker_heartbeats=True,
+                      conf_overrides={
+                          Keys.USER_FILE_WRITE_TYPE_DEFAULT: "MUST_CACHE",
+                      }) as c:
+        yield c
+
+
+class TestClusterDefaults:
+    def test_client_pulls_cluster_defaults(self, cluster):
+        # the cluster conf sets MUST_CACHE at RUNTIME source on the master;
+        # a vanilla client should receive it as a cluster default
+        fs = FileSystem(cluster.master.address)
+        assert fs._conf.get(Keys.USER_FILE_WRITE_TYPE_DEFAULT) == \
+            "MUST_CACHE"
+        assert fs._conf.source(Keys.USER_FILE_WRITE_TYPE_DEFAULT) == \
+            Source.CLUSTER_DEFAULT
+
+    def test_local_settings_beat_cluster_defaults(self, cluster):
+        conf = Configuration(load_env=False)
+        conf.set(Keys.USER_FILE_WRITE_TYPE_DEFAULT, "THROUGH",
+                 source=Source.SITE_PROPERTY)
+        fs = FileSystem(cluster.master.address, conf=conf)
+        assert fs._conf.get(Keys.USER_FILE_WRITE_TYPE_DEFAULT) == "THROUGH"
+
+    def test_config_hash_reload(self, cluster):
+        fs = FileSystem(cluster.master.address)
+        assert fs.check_config_sync() is False  # primes the hash
+        cluster.conf.set(Keys.USER_FILE_PASSIVE_CACHE_ENABLED, False)
+        assert fs.check_config_sync() is True
+        assert fs.check_config_sync() is False
+
+
+class TestPathProperties:
+    def test_resolution_longest_prefix(self):
+        props = {"/": {"k": "root"}, "/a": {"k": "a"},
+                 "/a/b": {"k": "ab"}}
+        assert resolve_path_property(props, "/a/b/c", "k") == "ab"
+        assert resolve_path_property(props, "/a/x", "k") == "a"
+        assert resolve_path_property(props, "/z", "k") == "root"
+        assert resolve_path_property({}, "/z", "k") is None
+        # /ab must NOT match prefix /a
+        assert resolve_path_property({"/a": {"k": "a"}}, "/ab", "k") is None
+
+    def test_path_conf_applied_to_writes(self, cluster):
+        mc = cluster.meta_client()
+        mc.set_path_conf("/cache-only", {
+            str(Keys.USER_FILE_WRITE_TYPE_DEFAULT.name): "MUST_CACHE"})
+        mc.set_path_conf("/durable", {
+            str(Keys.USER_FILE_WRITE_TYPE_DEFAULT.name): "CACHE_THROUGH"})
+        fs = cluster.file_system()
+        fs._refresh_path_conf()
+        fs.create_directory("/durable")
+        fs.create_directory("/cache-only")
+        fs.write_all("/durable/f", b"d")
+        fs.write_all("/cache-only/f", b"c")
+        assert fs.get_status("/durable/f").persisted
+        assert not fs.get_status("/cache-only/f").persisted
+
+    def test_path_conf_survives_restart(self, tmp_path):
+        with LocalCluster(str(tmp_path), num_workers=0) as c:
+            c.meta_client().set_path_conf(
+                "/p", {str(Keys.USER_FILE_REPLICATION_MIN.name): "2"})
+        with LocalCluster(str(tmp_path), num_workers=0) as c:
+            props = c.meta_client().get_path_conf()["properties"]
+            assert props["/p"][str(Keys.USER_FILE_REPLICATION_MIN.name)] \
+                == "2"
+
+    def test_remove_path_conf(self, cluster):
+        mc = cluster.meta_client()
+        key = str(Keys.USER_FILE_REPLICATION_MIN.name)
+        mc.set_path_conf("/r", {key: "2",
+                                str(Keys.USER_FILE_WRITE_TYPE_DEFAULT.name):
+                                "THROUGH"})
+        mc.remove_path_conf("/r", [key])
+        props = mc.get_path_conf()["properties"]["/r"]
+        assert key not in props and len(props) == 1
+        mc.remove_path_conf("/r")
+        assert "/r" not in mc.get_path_conf()["properties"]
+
+    def test_unknown_key_rejected(self, cluster):
+        from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+        with pytest.raises(InvalidArgumentError):
+            cluster.meta_client().set_path_conf("/x", {"no.such.key": "1"})
+
+
+class TestConfigChecker:
+    def test_report_statuses(self):
+        ck = ConfigurationChecker()
+        ck.register("master", {"atpu.security.authentication.type": "SIMPLE",
+                               "atpu.master.rpc.port": "19998"})
+        ck.register("worker-1",
+                    {"atpu.security.authentication.type": "SIMPLE"})
+        assert ck.report()["status"] == "PASSED"
+        # WARN: non-enforced key differs
+        ck.register("worker-2", {"atpu.master.rpc.port": "29998"})
+        r = ck.report()
+        assert r["status"] == "WARN" and r["warns"]
+        # FAILED: enforced key differs
+        ck.register("worker-3",
+                    {"atpu.security.authentication.type": "NOSASL"})
+        r = ck.report()
+        assert r["status"] == "FAILED"
+        assert any("authentication" in e for e in r["errors"])
+
+    def test_worker_reports_registered(self, cluster):
+        report = cluster.meta_client().get_config_report()
+        assert report["status"] in ("PASSED", "WARN")
+
+    def test_doctor_shows_report(self, cluster):
+        from alluxio_tpu.shell.command import ShellContext
+        from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+
+        conf = cluster.conf.copy()
+        conf.set(Keys.MASTER_HOSTNAME, "localhost")
+        conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+        out = io.StringIO()
+        code = ADMIN_SHELL.run(["doctor"], ShellContext(conf, out=out,
+                                                        err=out))
+        assert code == 0
+        assert "configuration check" in out.getvalue()

@@ -296,6 +296,16 @@ class Keys:
     # --- master ---
     MASTER_HOSTNAME = _k("atpu.master.hostname", default="localhost", scope=Scope.ALL)
     MASTER_RPC_PORT = _k("atpu.master.rpc.port", KeyType.INT, default=19998)
+    MASTER_RPC_ADDRESSES = _k(
+        "atpu.master.rpc.addresses", scope=Scope.ALL,
+        description="Comma-separated master addresses for HA deployments; "
+                    "overrides hostname:port when set (reference: "
+                    "alluxio.master.rpc.addresses).")
+    MASTER_HA_ENABLED = _k(
+        "atpu.master.ha.enabled", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="Run the master fault-tolerant: file-lock election on "
+                    "the shared journal dir, standby tailing until primacy.")
     MASTER_WEB_PORT = _k("atpu.master.web.port", KeyType.INT, default=19999)
     MASTER_JOURNAL_TYPE = _k("atpu.master.journal.type", KeyType.ENUM,
                              default="LOCAL", choices=("LOCAL", "UFS", "EMBEDDED", "NOOP"),
@@ -353,6 +363,16 @@ class Keys:
     MASTER_UFS_PATH_CACHE_CAPACITY = _k(
         "atpu.master.ufs.path.cache.capacity", KeyType.INT, default=100_000,
         scope=Scope.MASTER)
+    MASTER_JOURNAL_INIT_FROM_BACKUP = _k(
+        "atpu.master.journal.init.from.backup",
+        description="Backup file to seed an EMPTY journal from at boot "
+                    "(reference: initFromBackup, "
+                    "AlluxioMasterProcess.java:173-190).")
+    MASTER_STANDBY_TAIL_INTERVAL = _k(
+        "atpu.master.standby.journal.tail.interval", KeyType.DURATION,
+        default="1s", scope=Scope.MASTER,
+        description="Standby journal tailing period (reference: "
+                    "UfsJournalCheckpointThread.java:47).")
     MASTER_BACKUP_DIR = _k("atpu.master.backup.directory",
                            default="/tmp/alluxio_tpu/backups", scope=Scope.MASTER)
     MASTER_DAILY_BACKUP_ENABLED = _k("atpu.master.daily.backup.enabled",

@@ -1,0 +1,158 @@
+"""High availability: primary election, standby tailing, failover.
+
+Re-designs of the reference HA stack:
+- ``PrimarySelector`` SPI (``master/{PrimarySelector,
+  ZkPrimarySelector}.java`` + ``journal/raft/RaftPrimarySelector.java``):
+  here the in-tree implementation is a **file-lock selector** — an OS
+  ``flock`` on ``<journal>/primary.lock`` IS the fence: a deposed primary
+  cannot re-acquire while the new one lives, and a crashed one releases
+  automatically. Suited to masters sharing a journal directory (same host
+  or POSIX-locking shared fs); multi-host quorum = EMBEDDED journal.
+- Standby tailing (``UfsJournalCheckpointThread.java:47``): a standby
+  replays new segments on an interval and takes periodic checkpoints so
+  failover replay is short.
+- ``FaultTolerantMasterProcess`` (``master/FaultTolerantAlluxioMaster
+  Process.java``): boot as standby, serve when primacy arrives.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from alluxio_tpu.journal.system import LocalJournalSystem
+from alluxio_tpu.journal.format import JournalEntry
+
+LOG = logging.getLogger(__name__)
+
+
+class PrimarySelector:
+    """Election SPI (reference: PrimarySelector)."""
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def try_acquire(self) -> bool:
+        raise NotImplementedError
+
+    def is_primary(self) -> bool:
+        raise NotImplementedError
+
+    def release(self) -> None: ...
+
+    def wait_for_primacy(self, timeout_s: Optional[float] = None,
+                         poll_s: float = 0.1) -> bool:
+        deadline = None if timeout_s is None else \
+            time.monotonic() + timeout_s
+        while True:
+            if self.try_acquire():
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+
+class AlwaysPrimarySelector(PrimarySelector):
+    """Single-master deployments (no HA)."""
+
+    def try_acquire(self) -> bool:
+        return True
+
+    def is_primary(self) -> bool:
+        return True
+
+
+class FileLockPrimarySelector(PrimarySelector):
+    """flock-based election over the shared journal directory. The held
+    lock doubles as the write fence (reference: the UFS journal fences via
+    log rotation; Raft via terms)."""
+
+    LOCK_FILE = "primary.lock"
+
+    def __init__(self, journal_folder: str) -> None:
+        self._path = os.path.join(journal_folder, self.LOCK_FILE)
+        self._fd: Optional[int] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+
+    def try_acquire(self) -> bool:
+        import fcntl
+
+        with self._lock:
+            if self._fd is not None:
+                return True
+            fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                return False
+            os.ftruncate(fd, 0)
+            os.write(fd, str(os.getpid()).encode())
+            self._fd = fd
+            return True
+
+    def is_primary(self) -> bool:
+        with self._lock:
+            return self._fd is not None
+
+    def release(self) -> None:
+        import fcntl
+
+        with self._lock:
+            if self._fd is None:
+                return
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            finally:
+                os.close(self._fd)
+                self._fd = None
+
+    stop = release
+
+
+class JournalTailer:
+    """Standby-side catch-up: periodically applies new journal entries and
+    takes checkpoints so a later failover replays only a short tail
+    (reference: UfsJournalCheckpointThread)."""
+
+    def __init__(self, journal: LocalJournalSystem, *,
+                 interval_s: float = 1.0,
+                 checkpoint_period_entries: int = 10_000) -> None:
+        self._journal = journal
+        self._interval = interval_s
+        self._ckpt_period = checkpoint_period_entries
+        self._applied_at_ckpt = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self._journal.start()
+        self._thread = threading.Thread(target=self._run,
+                                        name="journal-tailer", daemon=True)
+        self._stop.clear()
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                applied = self._journal.catch_up()
+                if applied and self._journal.sequence - \
+                        self._applied_at_ckpt >= self._ckpt_period:
+                    self._journal.checkpoint_standby()
+                    self._applied_at_ckpt = self._journal.sequence
+            except Exception:  # noqa: BLE001 - keep tailing
+                LOG.exception("standby journal tail failed")
+            self._stop.wait(self._interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -33,6 +33,25 @@ from alluxio_tpu.utils.exceptions import JournalClosedError
 
 LOG_DIR = "logs"
 CKPT_DIR = "checkpoints"
+ACTIVE_LOG = "current.log"
+
+
+def sorted_segments(log_dir: str) -> List[str]:
+    """Closed segments by start sequence, then the active log."""
+    if not os.path.isdir(log_dir):
+        return []
+    segs = [f for f in os.listdir(log_dir) if f.endswith(".log")]
+    return sorted(segs, key=lambda f: (1 << 62) if f == ACTIVE_LOG
+                  else int(f.split("-")[0], 16))
+
+
+def latest_checkpoint_name(ckpt_dir: str) -> Optional[str]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    cks = [f for f in os.listdir(ckpt_dir) if f.endswith(".ckpt")]
+    if not cks:
+        return None
+    return max(cks, key=lambda f: int(f.split(".")[0], 16))
 
 
 class JournalContext:
@@ -164,20 +183,10 @@ class LocalJournalSystem(JournalSystem):
 
     # -- replay -------------------------------------------------------------
     def _list_segments(self) -> List[str]:
-        if not os.path.isdir(self._log_dir):
-            return []
-        segs = [f for f in os.listdir(self._log_dir) if f.endswith(".log")]
-        # closed segments sort by start sequence; the active log is newest
-        return sorted(segs, key=lambda f: (1 << 62) if f == "current.log"
-                      else int(f.split("-")[0], 16))
+        return sorted_segments(self._log_dir)
 
     def _latest_checkpoint(self) -> Optional[str]:
-        if not os.path.isdir(self._ckpt_dir):
-            return None
-        cks = [f for f in os.listdir(self._ckpt_dir) if f.endswith(".ckpt")]
-        if not cks:
-            return None
-        return max(cks, key=lambda f: int(f.split(".")[0], 16))
+        return latest_checkpoint_name(self._ckpt_dir)
 
     def _replay(self) -> None:
         for comp in self._components.values():
@@ -194,7 +203,11 @@ class LocalJournalSystem(JournalSystem):
         max_seq = start_seq
         for seg in self._list_segments():
             path = os.path.join(self._log_dir, seg)
-            with open(path, "rb") as f:
+            try:
+                f = open(path, "rb")
+            except FileNotFoundError:  # GC'd by a live primary mid-scan
+                continue
+            with f:
                 for entry in JournalEntry.decode_stream(f):
                     if entry.sequence <= start_seq:
                         continue
@@ -206,7 +219,7 @@ class LocalJournalSystem(JournalSystem):
     # -- writing ------------------------------------------------------------
     def _open_log(self) -> None:
         self._file_start_seq = self._seq + 1
-        path = os.path.join(self._log_dir, "current.log")
+        path = os.path.join(self._log_dir, ACTIVE_LOG)
         self._file = open(path, "ab")
 
     def _close_log(self) -> None:
@@ -216,7 +229,7 @@ class LocalJournalSystem(JournalSystem):
         os.fsync(self._file.fileno())
         self._file.close()
         self._file = None
-        cur = os.path.join(self._log_dir, "current.log")
+        cur = os.path.join(self._log_dir, ACTIVE_LOG)
         if os.path.exists(cur) and self._seq >= self._file_start_seq:
             final = os.path.join(
                 self._log_dir,
@@ -275,7 +288,8 @@ class LocalJournalSystem(JournalSystem):
             "components": {name: comp.snapshot()
                            for name, comp in self._components.items()},
         }
-        tmp = os.path.join(self._ckpt_dir, f".tmp.{self._seq:016x}")
+        tmp = os.path.join(self._ckpt_dir,
+                           f".tmp.{self._seq:016x}.{os.getpid()}")
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(snap, use_bin_type=True))
             f.flush()
@@ -285,15 +299,125 @@ class LocalJournalSystem(JournalSystem):
         self._last_checkpoint_seq = self._seq
         # GC fully-covered closed segments (keep current.log)
         for seg in self._list_segments():
-            if seg == "current.log":
+            if seg == ACTIVE_LOG:
                 continue
             end = int(seg.split("-")[1].split(".")[0], 16)
             if end <= self._seq:
-                os.remove(os.path.join(self._log_dir, seg))
+                try:
+                    os.remove(os.path.join(self._log_dir, seg))
+                except FileNotFoundError:
+                    pass  # a standby's checkpoint GC'd it first
         # rotate the active log so the pre-checkpoint tail can be dropped too
         if self._file is not None:
             self._close_log()
             self._open_log()
+
+    # -- standby mode (reference: standby masters tail the journal) ---------
+    def standby_start(self) -> None:
+        """Initial standby load: checkpoint + all durable segments, without
+        opening a write log."""
+        with self._lock:
+            self.start()
+            self._replay()
+
+    def catch_up(self) -> int:
+        """Apply entries newer than the local sequence (the tailer tick).
+        Tolerates the primary's in-flight torn tail. STRICTLY contiguous:
+        a sequence gap (e.g. the primary rotated the active log between
+        our listdir and open, so we read the new log first) triggers a
+        full rescan instead of silently skipping entries. Returns the
+        number of entries applied."""
+        applied = 0
+        with self._lock:
+            # a newer checkpoint than our state implies entries we can no
+            # longer read from GC'd segments: reload from scratch
+            ck = self._latest_checkpoint()
+            if ck and int(ck.split(".")[0], 16) > self._seq:
+                self._replay()
+                return 0
+            gap = False
+            for seg in self._list_segments():
+                path = os.path.join(self._log_dir, seg)
+                try:
+                    f = open(path, "rb")
+                except FileNotFoundError:  # GC'd between list and open
+                    continue
+                with f:
+                    for entry in JournalEntry.decode_stream(f):
+                        if entry.sequence <= self._seq:
+                            continue
+                        if entry.sequence != self._seq + 1:
+                            gap = True
+                            break
+                        self._apply(entry)
+                        self._seq = entry.sequence
+                        applied += 1
+                if gap:
+                    break
+            if gap:
+                # rotation raced the scan: rebuild deterministically
+                self._replay()
+        return applied
+
+    def gain_primacy_from_standby(self) -> None:
+        """Promotion for an already-tailing standby: finish the tail and
+        open the write log — no state reset, so failover is O(tail), not
+        O(snapshot) (reference: the standby's caught-up state serves)."""
+        with self._lock:
+            self.catch_up()
+            self._open_log()
+            self._primary = True
+
+    def checkpoint_standby(self) -> None:
+        """Checkpoint from standby state (no write log held). Shortens the
+        primary-promotion replay (reference: checkpoint on standby)."""
+        with self._lock:
+            if self._primary:
+                return
+            self._checkpoint_locked()
+
+    # -- backup / restore (reference: BackupLeaderRole.java:62 +
+    # initFromBackup AlluxioMasterProcess.java:173-190) --------------------
+    def write_backup(self, backup_dir: str) -> str:
+        """Full metadata backup = one checkpoint-format file; returns its
+        path. Safe on a live primary (state snapshot under the lock)."""
+        os.makedirs(backup_dir, exist_ok=True)
+        with self._lock:
+            snap = {
+                "sequence": self._seq,
+                "components": {name: comp.snapshot()
+                               for name, comp in self._components.items()},
+            }
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(backup_dir,
+                            f"atpu-backup-{stamp}-{snap['sequence']}.bak")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(snap, use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, path)
+        return path
+
+    def init_from_backup(self, backup_path: str) -> bool:
+        """Seed an EMPTY journal from a backup file: the backup becomes the
+        initial checkpoint so the normal replay path restores it. Returns
+        False (and does nothing) when the journal already has state."""
+        self.start()
+        if self._latest_checkpoint() is not None or any(
+                self._list_segments()):
+            return False
+        with open(backup_path, "rb") as f:
+            snap = msgpack.unpackb(f.read(), raw=False,
+                                   strict_map_key=False)
+        seq = int(snap["sequence"])
+        tmp = os.path.join(self._ckpt_dir, ".tmp.restore")
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(snap, use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, os.path.join(self._ckpt_dir, f"{seq:016x}.ckpt"))
+        return True
 
     # -- introspection ------------------------------------------------------
     @property

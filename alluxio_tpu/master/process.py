@@ -91,10 +91,19 @@ class MasterProcess:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
-        """Boot to serving; returns the bound RPC port."""
-        self.start_time_ms = self._clock.millis()
+        """Boot straight to primary; returns the bound RPC port."""
         self.journal.start()
+        backup = self._conf.get(Keys.MASTER_JOURNAL_INIT_FROM_BACKUP)
+        if backup and hasattr(self.journal, "init_from_backup"):
+            # seed an empty journal from a metadata backup (reference:
+            # initFromBackup, AlluxioMasterProcess.java:173-190)
+            self.journal.init_from_backup(str(backup))
         self.journal.gain_primacy()
+        return self._start_serving()
+
+    def _start_serving(self) -> int:
+        """Primacy is held: start masters, heartbeats and the RPC server."""
+        self.start_time_ms = self._clock.millis()
         self.fs_master.start(self._root_ufs_uri)
         self._safe_mode_until = time.monotonic() + self._conf.get_duration_s(
             Keys.MASTER_SAFEMODE_WAIT)
@@ -173,3 +182,95 @@ class MasterProcess:
     @property
     def address(self) -> str:
         return f"localhost:{self.rpc_port}"
+
+
+class FaultTolerantMasterProcess(MasterProcess):
+    """HA master: boots as a journal-tailing standby and starts serving
+    when the primary selector grants primacy (reference:
+    ``FaultTolerantAlluxioMasterProcess`` + standby tailing)."""
+
+    def __init__(self, conf: Configuration, *, selector=None, **kwargs
+                 ) -> None:
+        super().__init__(conf, **kwargs)
+        from alluxio_tpu.journal.ha import (
+            FileLockPrimarySelector, JournalTailer,
+        )
+
+        self.selector = selector or FileLockPrimarySelector(
+            conf.get(Keys.MASTER_JOURNAL_FOLDER))
+        import threading
+
+        self._tailer = JournalTailer(
+            self.journal,
+            interval_s=conf.get_duration_s(
+                Keys.MASTER_STANDBY_TAIL_INTERVAL))
+        self._promote_thread = None
+        self._promote_lock = threading.Lock()
+        self._stopped = False
+        self.serving = False
+
+    def _init_from_backup_if_configured(self) -> None:
+        backup = self._conf.get(Keys.MASTER_JOURNAL_INIT_FROM_BACKUP)
+        if backup and hasattr(self.journal, "init_from_backup"):
+            self.journal.init_from_backup(str(backup))
+
+    def start(self) -> int:  # type: ignore[override]
+        """Standby boot: tail the journal; a background thread waits for
+        primacy and promotes. Returns 0 (no RPC port while standby) —
+        callers poll ``rpc_port``/``serving``."""
+        import threading
+
+        self.selector.start()
+        self.journal.start()
+        self._init_from_backup_if_configured()
+        if self.selector.try_acquire():
+            self.journal.gain_primacy()
+            self.serving = True
+            return self._start_serving()
+        self.journal.standby_start()
+        self._tailer.start()
+        self._promote_thread = threading.Thread(
+            target=self._wait_and_promote, name="primacy-waiter",
+            daemon=True)
+        self._promote_thread.start()
+        return 0
+
+    def _wait_and_promote(self) -> None:
+        while not self._stopped:
+            if self.selector.wait_for_primacy(timeout_s=0.5):
+                with self._promote_lock:
+                    if self._stopped:
+                        # stop() raced our acquisition: hand the lock back
+                        # so another master can promote
+                        self.selector.release()
+                        return
+                    self.promote()
+                return
+
+    def promote(self) -> int:
+        """Standby -> primary: stop tailing, finish the tail in place (no
+        state reset — the standby is already caught up), open the write
+        log, start serving."""
+        self._tailer.stop()
+        if hasattr(self.journal, "gain_primacy_from_standby"):
+            self.journal.gain_primacy_from_standby()
+        else:
+            self.journal.gain_primacy()
+        port = self._start_serving()
+        self.serving = True
+        return port
+
+    def stop(self) -> None:
+        with self._promote_lock:
+            self._stopped = True
+        if self._promote_thread is not None:
+            self._promote_thread.join(timeout=10)
+            self._promote_thread = None
+        self._tailer.stop()
+        was_serving = self.serving
+        self.serving = False
+        if was_serving:
+            super().stop()
+        else:
+            self.journal.stop()
+        self.selector.release()

@@ -24,20 +24,44 @@ from alluxio_tpu.utils.wire import (
 
 
 class _BaseClient:
+    """``address`` may be a comma-separated list for HA deployments: on an
+    UNAVAILABLE failure the client rotates to the next master and the retry
+    policy re-issues the call (reference: ``AbstractMasterClient``
+    re-resolving the leader across the configured masters)."""
+
     service = ""
 
     def __init__(self, address: str, *, retry_duration_s: float = 30.0,
                  base_sleep_s: float = 0.05, max_sleep_s: float = 3.0,
                  metadata=None) -> None:
-        self._channel = RpcChannel(address, metadata=metadata)
+        self._channels = [RpcChannel(a.strip(), metadata=metadata)
+                          for a in str(address).split(",") if a.strip()]
+        self._active = 0
         self._retry_duration_s = retry_duration_s
         self._base_sleep_s = base_sleep_s
         self._max_sleep_s = max_sleep_s
 
+    @property
+    def _channel(self) -> RpcChannel:
+        return self._channels[self._active]
+
+    def _rotate(self) -> None:
+        self._active = (self._active + 1) % len(self._channels)
+
     def _call(self, method: str, request: dict, timeout: float = 30.0):
+        from alluxio_tpu.utils.exceptions import UnavailableError
+
+        def attempt():
+            try:
+                return self._channel.call(self.service, method, request,
+                                          timeout=timeout)
+            except UnavailableError:
+                if len(self._channels) > 1:
+                    self._rotate()
+                raise
+
         return retry(
-            lambda: self._channel.call(self.service, method, request,
-                                       timeout=timeout),
+            attempt,
             ExponentialTimeBoundedRetry(self._retry_duration_s,
                                         self._base_sleep_s,
                                         self._max_sleep_s))
@@ -231,6 +255,9 @@ class MetaMasterClient(_BaseClient):
 
     def checkpoint(self) -> None:
         self._call("checkpoint", {}, timeout=300.0)
+
+    def backup(self, directory: Optional[str] = None) -> dict:
+        return self._call("backup", {"directory": directory}, timeout=600.0)
 
 
 class WorkerClient(_BaseClient):

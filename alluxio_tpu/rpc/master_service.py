@@ -211,6 +211,21 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
 
     svc.unary("checkpoint", _checkpoint)
 
+    def _backup(r):
+        if journal is None or not hasattr(journal, "write_backup"):
+            from alluxio_tpu.utils.exceptions import FailedPreconditionError
+
+            raise FailedPreconditionError(
+                "this master's journal does not support backups")
+        from alluxio_tpu.conf import Keys
+
+        backup_dir = r.get("directory") or conf.get(Keys.MASTER_BACKUP_DIR)
+        path = journal.write_backup(str(backup_dir))
+        return {"backup_uri": path,
+                "entry_count": getattr(journal, "sequence", 0)}
+
+    svc.unary("backup", _backup)
+
     if path_properties is not None:
         svc.unary("set_path_conf", lambda r: (
             path_properties.add(r["path"], r["properties"]), {})[-1])

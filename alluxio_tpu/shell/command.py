@@ -37,6 +37,9 @@ class ShellContext:
 
     @property
     def master_address(self) -> str:
+        addresses = self.conf.get(Keys.MASTER_RPC_ADDRESSES)
+        if addresses:
+            return str(addresses)
         return (f"{self.conf.get(Keys.MASTER_HOSTNAME)}:"
                 f"{self.conf.get_int(Keys.MASTER_RPC_PORT)}")
 

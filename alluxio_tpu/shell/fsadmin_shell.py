@@ -186,15 +186,44 @@ class PathConfCommand(Command):
 @ADMIN_SHELL.register
 class JournalCommand(Command):
     name = "journal"
-    description = "Journal operations: checkpoint."
+    description = "Journal operations: checkpoint | dump."
 
     def configure(self, p):
-        p.add_argument("op", choices=["checkpoint"])
+        p.add_argument("op", choices=["checkpoint", "dump"])
+        p.add_argument("--folder", default=None,
+                       help="journal dir for dump (default: configured)")
+        p.add_argument("--start", type=int, default=0)
+        p.add_argument("--end", type=int, default=None)
 
     def run(self, args, ctx):
         if args.op == "checkpoint":
             ctx.meta_client().checkpoint()
             ctx.print("Successfully took a checkpoint on the primary master")
+            return 0
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.journal.tool import dump_journal
+
+        folder = args.folder or str(ctx.conf.get(
+            Keys.MASTER_JOURNAL_FOLDER))
+        n = dump_journal(folder, ctx.out, start_seq=args.start,
+                         end_seq=args.end)
+        ctx.print(f"({n} entries)")
+        return 0
+
+
+@ADMIN_SHELL.register
+class BackupCommand(Command):
+    name = "backup"
+    description = "Write a full metadata backup on the primary master."
+
+    def configure(self, p):
+        p.add_argument("directory", nargs="?", default=None)
+
+    def run(self, args, ctx):
+        resp = ctx.meta_client().backup(args.directory)
+        ctx.print(f"Backup Host: {ctx.master_address}")
+        ctx.print(f"Backup URI: {resp['backup_uri']}")
+        ctx.print(f"Backup Entry Count: {resp['entry_count']}")
         return 0
 
 

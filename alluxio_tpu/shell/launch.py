@@ -34,7 +34,23 @@ def _serve_until_signal(stop_fn, banner: str) -> int:
     return 0
 
 
+def _master_address(conf: Configuration) -> str:
+    addresses = conf.get(Keys.MASTER_RPC_ADDRESSES)
+    if addresses:
+        return str(addresses)
+    return (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
+            f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+
+
 def launch_master(conf: Configuration) -> int:
+    if conf.get_bool(Keys.MASTER_HA_ENABLED):
+        from alluxio_tpu.master.process import FaultTolerantMasterProcess
+
+        proc = FaultTolerantMasterProcess(conf)
+        proc.start()
+        banner = ("alluxio-tpu master started (HA): "
+                  + ("serving" if proc.serving else "standby, tailing"))
+        return _serve_until_signal(proc.stop, banner)
     from alluxio_tpu.master.process import MasterProcess
 
     proc = MasterProcess(conf)
@@ -52,8 +68,7 @@ def launch_worker(conf: Configuration) -> int:
     from alluxio_tpu.worker.process import BlockWorker
     from alluxio_tpu.worker.ufs_manager import WorkerUfsManager
 
-    master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
-                   f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+    master_addr = _master_address(conf)
     fs_client = FsMasterClient(master_addr)
     worker = BlockWorker(conf, BlockMasterClient(master_addr), fs_client,
                          meta_master_client=MetaMasterClient(master_addr))
@@ -77,8 +92,7 @@ def launch_worker(conf: Configuration) -> int:
 def launch_job_master(conf: Configuration) -> int:
     from alluxio_tpu.job.process import JobMasterProcess
 
-    master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
-                   f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+    master_addr = _master_address(conf)
     proc = JobMasterProcess(conf, master_addr)
     port = proc.start()
     return _serve_until_signal(
@@ -88,8 +102,7 @@ def launch_job_master(conf: Configuration) -> int:
 def launch_job_worker(conf: Configuration) -> int:
     from alluxio_tpu.job.process import make_job_worker
 
-    master_addr = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
-                   f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+    master_addr = _master_address(conf)
     job_master_addr = (f"{conf.get(Keys.JOB_MASTER_HOSTNAME)}:"
                        f"{conf.get_int(Keys.JOB_MASTER_RPC_PORT)}")
     jw = make_job_worker(conf, job_master_addr, master_addr,

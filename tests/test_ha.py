@@ -1,0 +1,256 @@
+"""HA tests: election/fencing, standby tailing, promotion, backup/restore,
+journal dump, client failover (reference: ``tests/.../server/ft/journal/*``
++ ``JournalBackupIntegrationTest``)."""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+import time
+
+import pytest
+
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.journal.ha import FileLockPrimarySelector, JournalTailer
+from alluxio_tpu.journal.system import LocalJournalSystem
+from alluxio_tpu.journal.tool import dump_journal
+from alluxio_tpu.master.process import (
+    FaultTolerantMasterProcess, MasterProcess,
+)
+
+
+def make_conf(tmp_path, **overrides) -> Configuration:
+    c = Configuration(load_env=False)
+    c.set(Keys.HOME, str(tmp_path))
+    c.set(Keys.MASTER_JOURNAL_FOLDER, str(tmp_path / "journal"))
+    c.set(Keys.MASTER_RPC_PORT, 0)
+    c.set(Keys.MASTER_SAFEMODE_WAIT, "0s")
+    c.set(Keys.MASTER_BACKUP_DIR, str(tmp_path / "backups"))
+    c.set(Keys.MASTER_STANDBY_TAIL_INTERVAL, "50ms")
+    for k, v in overrides.items():
+        c.set(k, v)
+    return c
+
+
+class _Recorder:
+    """Minimal Journaled component for journal-level tests."""
+
+    journal_name = "Recorder"
+
+    def __init__(self) -> None:
+        self.values = []
+
+    def process_entry(self, entry) -> bool:
+        if entry.type == "inode_file":  # reuse a registered type
+            self.values.append(entry.payload.get("v"))
+            return True
+        return False
+
+    def snapshot(self) -> dict:
+        return {"values": list(self.values)}
+
+    def restore(self, snap) -> None:
+        self.values = list(snap.get("values", []))
+
+    def reset_state(self) -> None:
+        self.values = []
+
+
+class TestFileLockSelector:
+    def test_mutual_exclusion_and_release(self, tmp_path):
+        a = FileLockPrimarySelector(str(tmp_path))
+        b = FileLockPrimarySelector(str(tmp_path))
+        a.start(), b.start()
+        assert a.try_acquire()
+        assert a.is_primary()
+        # NOTE: flock is per-(process, file) — within one process a second
+        # fd CAN take the lock, so cross-object exclusion is only
+        # meaningful across processes; here we only verify handoff
+        a.release()
+        assert not a.is_primary()
+        assert b.try_acquire()
+        b.release()
+
+    def test_wait_for_primacy_timeout(self, tmp_path):
+        a = FileLockPrimarySelector(str(tmp_path))
+        a.start()
+        assert a.wait_for_primacy(timeout_s=1.0)
+        a.release()
+
+
+class TestStandbyTailing:
+    def test_catch_up_applies_new_entries(self, tmp_path):
+        folder = str(tmp_path / "j")
+        primary = LocalJournalSystem(folder)
+        rec_p = _Recorder()
+        primary.register(rec_p)
+        primary.start()
+        primary.gain_primacy()
+        with primary.create_context() as ctx:
+            ctx.append("inode_file", {"v": 1})
+        standby = LocalJournalSystem(folder)
+        rec_s = _Recorder()
+        standby.register(rec_s)
+        standby.standby_start()
+        assert rec_s.values == [1]
+        with primary.create_context() as ctx:
+            ctx.append("inode_file", {"v": 2})
+            ctx.append("inode_file", {"v": 3})
+        assert standby.catch_up() == 2
+        assert rec_s.values == [1, 2, 3]
+        # tailer thread variant
+        tailer = JournalTailer(standby, interval_s=0.05)
+        tailer.start()
+        with primary.create_context() as ctx:
+            ctx.append("inode_file", {"v": 4})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and rec_s.values[-1] != 4:
+            time.sleep(0.05)
+        tailer.stop()
+        assert rec_s.values[-1] == 4
+        primary.stop(), standby.stop()
+
+    def test_standby_checkpoint_shortens_replay(self, tmp_path):
+        folder = str(tmp_path / "j")
+        primary = LocalJournalSystem(folder)
+        rec = _Recorder()
+        primary.register(rec)
+        primary.start()
+        primary.gain_primacy()
+        for i in range(20):
+            with primary.create_context() as ctx:
+                ctx.append("inode_file", {"v": i})
+        standby = LocalJournalSystem(folder)
+        rec_s = _Recorder()
+        standby.register(rec_s)
+        standby.standby_start()
+        standby.checkpoint_standby()
+        assert standby.last_checkpoint_sequence == standby.sequence
+        primary.stop(), standby.stop()
+
+
+class TestFaultTolerantMaster:
+    def test_single_ft_master_serves_immediately(self, tmp_path):
+        conf = make_conf(tmp_path)
+        m = FaultTolerantMasterProcess(conf)
+        try:
+            m.start()
+            assert m.serving and m.rpc_port
+            from alluxio_tpu.rpc.clients import FsMasterClient
+
+            FsMasterClient(m.address).create_directory("/ha-dir")
+        finally:
+            m.stop()
+
+    def test_standby_promotes_on_release(self, tmp_path):
+        conf1 = make_conf(tmp_path)
+        conf2 = make_conf(tmp_path)
+        m1 = FaultTolerantMasterProcess(conf1)
+        m1.start()
+        assert m1.serving
+        from alluxio_tpu.rpc.clients import FsMasterClient
+
+        FsMasterClient(m1.address).create_directory("/before-failover")
+        # second FT master: in-process flock would succeed (same pid), so
+        # force standby behavior with a selector stub gated on m1
+        class _Gate(FileLockPrimarySelector):
+            def try_acquire(self_inner) -> bool:  # noqa: N805
+                if m1.serving:
+                    return False
+                return super(_Gate, self_inner).try_acquire()
+
+        m2 = FaultTolerantMasterProcess(
+            conf2, selector=_Gate(str(tmp_path / "journal")))
+        try:
+            m2.start()
+            assert not m2.serving
+            # let the tailer absorb the entry
+            time.sleep(0.3)
+            m1.stop()  # releases the lock -> m2 promotes
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not m2.serving:
+                time.sleep(0.1)
+            assert m2.serving
+            c2 = FsMasterClient(m2.address)
+            assert c2.exists("/before-failover")
+            c2.create_directory("/after-failover")
+            assert c2.exists("/after-failover")
+        finally:
+            m2.stop()
+
+
+class TestBackupRestore:
+    def test_backup_and_seed_new_cluster(self, tmp_path):
+        conf = make_conf(tmp_path / "a")
+        m = MasterProcess(conf, root_ufs_uri=str(tmp_path / "ufs"))
+        os.makedirs(tmp_path / "ufs", exist_ok=True)
+        m.start()
+        from alluxio_tpu.rpc.clients import FsMasterClient, MetaMasterClient
+
+        FsMasterClient(m.address).create_directory("/backed-up/deep")
+        resp = MetaMasterClient(m.address).backup()
+        assert os.path.exists(resp["backup_uri"])
+        m.stop()
+        # new cluster, EMPTY journal, seeded from the backup
+        conf2 = make_conf(tmp_path / "b")
+        conf2.set(Keys.MASTER_JOURNAL_INIT_FROM_BACKUP, resp["backup_uri"])
+        m2 = MasterProcess(conf2, root_ufs_uri=str(tmp_path / "ufs"))
+        m2.start()
+        try:
+            assert FsMasterClient(m2.address).exists("/backed-up/deep")
+        finally:
+            m2.stop()
+
+    def test_init_from_backup_refuses_nonempty_journal(self, tmp_path):
+        folder = str(tmp_path / "j")
+        j = LocalJournalSystem(folder)
+        rec = _Recorder()
+        j.register(rec)
+        j.start()
+        j.gain_primacy()
+        with j.create_context() as ctx:
+            ctx.append("inode_file", {"v": 1})
+        backup = j.write_backup(str(tmp_path / "bk"))
+        j.stop()
+        j2 = LocalJournalSystem(folder)
+        j2.register(_Recorder())
+        assert j2.init_from_backup(backup) is False  # journal not empty
+
+
+class TestJournalDump:
+    def test_dump_prints_entries(self, tmp_path):
+        folder = str(tmp_path / "j")
+        j = LocalJournalSystem(folder)
+        j.register(_Recorder())
+        j.start()
+        j.gain_primacy()
+        with j.create_context() as ctx:
+            ctx.append("inode_file", {"v": 42})
+        j.checkpoint()
+        with j.create_context() as ctx:
+            ctx.append("inode_file", {"v": 43})
+        j.stop()
+        out = io.StringIO()
+        n = dump_journal(folder, out)
+        text = out.getvalue()
+        assert "checkpoint" in text and "inode_file" in text
+        assert n >= 1
+
+
+class TestClientFailover:
+    def test_client_rotates_to_live_master(self, tmp_path):
+        conf = make_conf(tmp_path)
+        m = MasterProcess(conf, root_ufs_uri=str(tmp_path / "ufs"))
+        os.makedirs(tmp_path / "ufs", exist_ok=True)
+        m.start()
+        from alluxio_tpu.rpc.clients import FsMasterClient
+
+        # dead address first: the client must rotate and succeed
+        dead = "localhost:1"  # nothing listens on port 1
+        c = FsMasterClient(f"{dead},{m.address}", retry_duration_s=15.0)
+        try:
+            c.create_directory("/failover-ok")
+            assert c.exists("/failover-ok")
+        finally:
+            m.stop()

@@ -27,6 +27,12 @@ class CacheEvictor:
         """The next victim (not removed; caller calls update_on_delete)."""
         raise NotImplementedError
 
+    def evict_matching(self, pred) -> Optional[PageId]:
+        """First victim IN POLICY ORDER satisfying ``pred`` (reference:
+        the evictor's evictMatching shape) — lets a caller skip pages it
+        cannot evict (e.g. pinned) without abandoning the policy."""
+        raise NotImplementedError
+
     @staticmethod
     def create(kind: str) -> "CacheEvictor":
         k = kind.upper()
@@ -60,6 +66,10 @@ class LRUCacheEvictor(CacheEvictor):
         with self._lock:
             return next(iter(self._order)) if self._order else None
 
+    def evict_matching(self, pred) -> Optional[PageId]:
+        with self._lock:
+            return next((p for p in self._order if pred(p)), None)
+
 
 class LFUCacheEvictor(CacheEvictor):
     def __init__(self) -> None:
@@ -84,3 +94,8 @@ class LFUCacheEvictor(CacheEvictor):
             if not self._counts:
                 return None
             return min(self._counts, key=self._counts.get)
+
+    def evict_matching(self, pred) -> Optional[PageId]:
+        with self._lock:
+            cands = [p for p in self._counts if pred(p)]
+            return min(cands, key=self._counts.get) if cands else None

@@ -51,10 +51,19 @@ class DevicePageLease:
 
 
 class HbmPageStore:
-    """Device-memory page store with pin-lease eviction safety."""
+    """Device-memory page store with pin-lease eviction safety.
 
-    def __init__(self, capacity_bytes: int, device=None) -> None:
+    Eviction policy is a pluggable :class:`CacheEvictor` (LRU default) —
+    the same SPI the host page cache uses (reference:
+    ``client/file/cache/evictor/CacheEvictor.java``) — with pinned pages
+    skipped: the evictor nominates victims, the store vetoes pinned ones.
+    """
+
+    def __init__(self, capacity_bytes: int, device=None,
+                 evictor: str = "LRU") -> None:
         import jax  # deferred: control-plane processes never import jax
+
+        from alluxio_tpu.client.cache.evictor import CacheEvictor
 
         self._jax = jax
         self._capacity = capacity_bytes
@@ -64,6 +73,8 @@ class HbmPageStore:
         self._pins: Dict[PageId, int] = {}
         self._used = 0
         self._lock = threading.RLock()
+        self._evictor = evictor if not isinstance(evictor, str) \
+            else CacheEvictor.create(evictor)
 
     # -- capacity -----------------------------------------------------------
     @property
@@ -74,6 +85,11 @@ class HbmPageStore:
     @property
     def capacity_bytes(self) -> int:
         return self._capacity
+
+    @property
+    def page_count(self) -> int:
+        with self._lock:
+            return len(self._pages)
 
     def has(self, page_id: PageId) -> bool:
         with self._lock:
@@ -99,6 +115,23 @@ class HbmPageStore:
             self._pages[page_id] = device_arr
             self._sizes[page_id] = size
             self._used += size
+            self._evictor.update_on_put(page_id)
+            return True
+
+    def adopt(self, page_id: PageId, device_array) -> bool:
+        """Retain an ALREADY device-resident array (e.g. the loader just
+        ``device_put`` it for a consumer) without a second transfer.
+        Returns False when it cannot fit after eviction."""
+        with self._lock:
+            if page_id in self._pages:
+                return True
+            size = device_array.nbytes
+            if size > self._capacity or not self._ensure_room(size):
+                return False
+            self._pages[page_id] = device_array
+            self._sizes[page_id] = size
+            self._used += size
+            self._evictor.update_on_put(page_id)
             return True
 
     def get(self, page_id: PageId) -> Optional[DevicePageLease]:
@@ -108,6 +141,7 @@ class HbmPageStore:
             if arr is None:
                 return None
             self._pins[page_id] = self._pins.get(page_id, 0) + 1
+            self._evictor.update_on_get(page_id)
             return DevicePageLease(self, page_id, arr)
 
     def _unpin(self, page_id: PageId) -> None:
@@ -132,15 +166,21 @@ class HbmPageStore:
                 return False
             self._used -= self._sizes.pop(page_id, 0)
             self._pins.pop(page_id, None)
+            self._evictor.update_on_delete(page_id)
             del arr
             return True
 
     def _ensure_room(self, size: int) -> bool:
-        """Evict unpinned pages (insertion order ~ LRU-ish; the manager's
-        evictor drives real policy — this is the safety net)."""
+        """Evict per the evictor's policy until ``size`` fits, skipping
+        pinned pages (the evictor nominates the first evictable candidate
+        in policy order; pinned pages are excluded by predicate)."""
         while self._used + size > self._capacity:
-            victim = next((pid for pid in self._pages
-                           if self._pins.get(pid, 0) == 0), None)
+            victim = self._evictor.evict_matching(
+                lambda p: self._pins.get(p, 0) == 0 and p in self._pages)
+            if victim is None:
+                # evictor view stale/empty: any unpinned page as last resort
+                victim = next((pid for pid in self._pages
+                               if self._pins.get(pid, 0) == 0), None)
             if victim is None:
                 return False
             self.delete(victim)

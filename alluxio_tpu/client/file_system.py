@@ -121,6 +121,26 @@ class FileSystem:
             from alluxio_tpu.client.cache.manager import LocalCacheManager
 
             self._page_cache = LocalCacheManager.from_conf(self._conf)
+        self._metrics_thread = None
+        if self._conf.get_bool(Keys.USER_METRICS_COLLECTION_ENABLED):
+            from alluxio_tpu.heartbeat import (
+                HeartbeatContext, HeartbeatThread,
+            )
+
+            self._metrics_thread = HeartbeatThread(
+                HeartbeatContext.CLIENT_METRICS_HEARTBEAT,
+                _ClientMetricsSync(self), self._conf.get_duration_s(
+                    Keys.USER_METRICS_HEARTBEAT_INTERVAL))
+            self._metrics_thread.start()
+
+    def send_metrics(self) -> None:
+        """Ship this client's metric snapshot to the master for cluster
+        aggregation (reference: ``client/metrics/ClientMasterSync``)."""
+        from alluxio_tpu.metrics import metrics
+
+        self.meta_master.metrics_heartbeat(
+            f"client-{socket.gethostname()}-{id(self):x}",
+            metrics().snapshot())
 
     # ------------------------------------------------------------- metadata
     def get_status(self, path: "str | AlluxioURI") -> FileInfo:
@@ -314,6 +334,26 @@ class FileSystem:
 
     # -------------------------------------------------------------- cleanup
     def close(self) -> None:
+        if self._metrics_thread is not None:
+            self._metrics_thread.stop()
+            self._metrics_thread = None
         self.store.close()
         if self._page_cache is not None:
             self._page_cache.close()
+
+
+class _ClientMetricsSync:
+    """Heartbeat executor shipping client metrics (reference:
+    ``client/metrics/ClientMasterSync.java``)."""
+
+    def __init__(self, fs: FileSystem) -> None:
+        self._fs = fs
+
+    def heartbeat(self) -> None:
+        try:
+            self._fs.send_metrics()
+        except Exception:  # noqa: BLE001 master transition: retry next tick
+            pass
+
+    def close(self) -> None:
+        pass

@@ -93,20 +93,8 @@ class DeviceBlockLoader:
         host = self._host_bytes(path, index)
         arr = self._jax.device_put(host, self._device)
         if self._hbm is not None:
-            self._retain(pid, arr)
+            self._hbm.adopt(pid, arr)  # no second transfer
         return arr
-
-    def _retain(self, pid: PageId, arr) -> None:
-        """Adopt an already-transferred array into the HBM store (no second
-        copy): bypass put()'s host path."""
-        with self._hbm._lock:
-            if pid in self._hbm._pages:
-                return
-            size = arr.nbytes
-            if size <= self._hbm._capacity and self._hbm._ensure_room(size):
-                self._hbm._pages[pid] = arr
-                self._hbm._sizes[pid] = size
-                self._hbm._used += size
 
     # -- iteration -----------------------------------------------------------
     def __iter__(self) -> Iterator:
@@ -126,7 +114,7 @@ class DeviceBlockLoader:
         if self._hbm is None:
             return {"hbm_bytes": 0}
         return {"hbm_bytes": self._hbm.used_bytes,
-                "hbm_pages": len(self._hbm._pages)}
+                "hbm_pages": self._hbm.page_count}
 
     def close(self) -> None:
         for f in self._streams.values():

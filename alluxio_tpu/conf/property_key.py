@@ -320,6 +320,29 @@ class Keys:
     MASTER_JOURNAL_CHECKPOINT_PERIOD_ENTRIES = _k(
         "atpu.master.journal.checkpoint.period.entries", KeyType.INT,
         default=2_000_000, scope=Scope.MASTER)
+    MASTER_EMBEDDED_JOURNAL_ADDRESSES = _k(
+        "atpu.master.embedded.journal.addresses", default="",
+        scope=Scope.ALL,
+        description="Comma-separated host:port quorum member addresses for "
+                    "the EMBEDDED (Raft) journal (reference: "
+                    "alluxio.master.embedded.journal.addresses).")
+    MASTER_EMBEDDED_JOURNAL_ADDRESS = _k(
+        "atpu.master.embedded.journal.address", default="",
+        scope=Scope.MASTER,
+        description="This master's own quorum address; must appear in "
+                    "atpu.master.embedded.journal.addresses.")
+    MASTER_EMBEDDED_JOURNAL_ELECTION_TIMEOUT_MIN = _k(
+        "atpu.master.embedded.journal.election.timeout.min",
+        KeyType.DURATION, default="300ms", scope=Scope.MASTER)
+    MASTER_EMBEDDED_JOURNAL_ELECTION_TIMEOUT_MAX = _k(
+        "atpu.master.embedded.journal.election.timeout.max",
+        KeyType.DURATION, default="600ms", scope=Scope.MASTER)
+    MASTER_EMBEDDED_JOURNAL_HEARTBEAT_INTERVAL = _k(
+        "atpu.master.embedded.journal.heartbeat.interval",
+        KeyType.DURATION, default="100ms", scope=Scope.MASTER)
+    MASTER_EMBEDDED_JOURNAL_SNAPSHOT_PERIOD_ENTRIES = _k(
+        "atpu.master.embedded.journal.snapshot.period.entries", KeyType.INT,
+        default=100_000, scope=Scope.MASTER)
     MASTER_JOURNAL_LOG_SIZE_BYTES_MAX = _k(
         "atpu.master.journal.log.size.bytes.max", KeyType.BYTES, default="64MB",
         scope=Scope.MASTER)
@@ -495,6 +518,19 @@ class Keys:
                     "client start (reference: meta_master.proto:196-211).")
     USER_CONF_SYNC_INTERVAL = _k("atpu.user.conf.sync.interval", KeyType.DURATION,
                                  default="1min", scope=Scope.CLIENT)
+    USER_METRICS_COLLECTION_ENABLED = _k(
+        "atpu.user.metrics.collection.enabled", KeyType.BOOL, default=False,
+        scope=Scope.CLIENT,
+        description="Ship client metric snapshots to the master for "
+                    "cluster aggregation (reference: ClientMasterSync).")
+    USER_METRICS_HEARTBEAT_INTERVAL = _k(
+        "atpu.user.metrics.heartbeat.interval", KeyType.DURATION,
+        default="10s", scope=Scope.CLIENT)
+    WORKER_METRICS_HEARTBEAT_INTERVAL = _k(
+        "atpu.worker.metrics.heartbeat.interval", KeyType.DURATION,
+        default="10s", scope=Scope.WORKER,
+        description="Cadence of worker metric snapshots shipped to the "
+                    "master for cluster aggregation.")
     USER_FILE_METADATA_SYNC_INTERVAL = _k(
         "atpu.user.file.metadata.sync.interval", KeyType.DURATION, default="-1s",
         scope=Scope.CLIENT,

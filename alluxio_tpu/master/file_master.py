@@ -924,14 +924,27 @@ class FileSystemMaster:
         """Diff UFS vs inode state via fingerprints; reload on change.
         ``recursive`` extends the diff to the whole subtree (the
         ``DescendantType.ALL`` mode of ``InodeSyncStream``). Returns True
-        if anything changed."""
-        uri = AlluxioURI(path)
-        changed = self._sync_one(uri)
-        if recursive:
-            changed = self._sync_children(uri) or changed
-        self._sync_cache.notify_synced(uri.path, self._now(),
-                                       recursive=recursive)
-        return changed
+        if anything changed.
+
+        Reconciliation runs with master privileges (auth user rebound to
+        None, trusted in-process), matching the reference where
+        ``InodeSyncStream`` performs internal deletes/loads as the master —
+        a read-only caller's on-access sync must not fail permission checks
+        for namespace repair it did not itself request."""
+        from alluxio_tpu.security.user import (
+            reset_authenticated_user, set_authenticated_user,
+        )
+        token = set_authenticated_user(None)
+        try:
+            uri = AlluxioURI(path)
+            changed = self._sync_one(uri)
+            if recursive:
+                changed = self._sync_children(uri) or changed
+            self._sync_cache.notify_synced(uri.path, self._now(),
+                                           recursive=recursive)
+            return changed
+        finally:
+            reset_authenticated_user(token)
 
     def _sync_one(self, uri: AlluxioURI, *,
                   status: "UfsStatus | None" = None,

@@ -1,0 +1,101 @@
+"""MetricsMaster: cluster-wide metric aggregation at the metadata master.
+
+Re-design of ``core/server/master/src/main/java/alluxio/master/metrics/
+{DefaultMetricsMaster,MetricsStore}.java`` + ``grpc/metric_master.proto``:
+workers and clients ship their metric snapshots on a heartbeat; the master
+stores them per source and serves ``Cluster.*`` aggregates (sums across
+sources, with the instance prefix rewritten) alongside its own metrics —
+what ``fsadmin report metrics`` and the Prometheus endpoint read.
+
+Aggregation is additive-only: counters/meters/gauges sum across sources;
+timer percentile sub-metrics (non-additive) are skipped, as the reference
+aggregates counters and throughput meters, not latency histograms.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+_NON_ADDITIVE_SUFFIXES = (".p50", ".p95", ".p99", ".mean", ".min", ".max")
+_INSTANCE_PREFIXES = ("Worker.", "Client.", "JobWorker.", "Process.")
+
+
+class MetricsStore:
+    """Per-source metric reports + cluster aggregation."""
+
+    def __init__(self, *, source_ttl_s: float = 300.0,
+                 clock=time.monotonic) -> None:
+        self._reports: Dict[str, Dict[str, float]] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._ttl = source_ttl_s
+        self._clock = clock
+
+    def report(self, source: str, metrics: Dict[str, float]) -> None:
+        """A node's full snapshot replaces its previous one (the reference
+        ships complete snapshots, not deltas — idempotent under retry)."""
+        now = self._clock()
+        with self._lock:
+            self._reports[source] = {str(k): float(v)
+                                     for k, v in (metrics or {}).items()}
+            self._last_seen[source] = now
+            self._gc(now)
+
+    def clear_source(self, source: str) -> None:
+        with self._lock:
+            self._reports.pop(source, None)
+            self._last_seen.pop(source, None)
+
+    def _gc(self, now: float) -> None:
+        dead = [s for s, t in self._last_seen.items()
+                if now - t > self._ttl]
+        for s in dead:
+            self._reports.pop(s, None)
+            self._last_seen.pop(s, None)
+
+    def cluster_metrics(self) -> Dict[str, float]:
+        """``Cluster.<name>`` = sum over sources of additive metrics."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            self._gc(self._clock())
+            for snap in self._reports.values():
+                for name, value in snap.items():
+                    if name.endswith(_NON_ADDITIVE_SUFFIXES):
+                        continue
+                    for p in _INSTANCE_PREFIXES:
+                        if name.startswith(p):
+                            name = name[len(p):]
+                            break
+                    key = f"Cluster.{name}"
+                    out[key] = out.get(key, 0.0) + value
+        return out
+
+    def source_count(self) -> int:
+        with self._lock:
+            return len(self._reports)
+
+    def sources(self) -> Dict[str, float]:
+        """source -> seconds since last report (fsadmin diagnostics)."""
+        now = self._clock()
+        with self._lock:
+            return {s: now - t for s, t in self._last_seen.items()}
+
+
+class MetricsMaster:
+    """Facade the master process owns (reference: DefaultMetricsMaster)."""
+
+    def __init__(self, store: Optional[MetricsStore] = None) -> None:
+        self.store = store or MetricsStore()
+
+    def handle_heartbeat(self, request: dict) -> dict:
+        source = str(request.get("source") or "unknown")
+        self.store.report(source, request.get("metrics") or {})
+        return {}
+
+    def merged_snapshot(self, own: Dict[str, float]) -> Dict[str, float]:
+        merged = dict(own)
+        merged.update(self.store.cluster_metrics())
+        merged["Cluster.metrics.sources"] = float(self.store.source_count())
+        return merged

@@ -44,6 +44,11 @@ class PathProperties:
         self._journal = journal
         self._props: Dict[str, Dict[str, str]] = {}
         self._lock = threading.Lock()
+        # serializes add/remove: each journals the FULL merged map, so two
+        # concurrent mutators reading the same pre-state would lose one
+        # caller's keys (read-modify-write race). Separate from self._lock
+        # because journal application re-enters process_entry -> self._lock.
+        self._mutate_lock = threading.Lock()
         journal.register(self)
 
     # -- API -----------------------------------------------------------------
@@ -52,29 +57,33 @@ class PathProperties:
         for k in properties:
             if not REGISTRY.is_valid(k):
                 raise InvalidArgumentError(f"unknown property key: {k}")
-        with self._journal.create_context() as ctx:
-            merged = dict(self._props.get(path, {}))
+        with self._mutate_lock:
+            with self._lock:
+                merged = dict(self._props.get(path, {}))
             merged.update({k: str(v) for k, v in properties.items()})
-            ctx.append(EntryType.PATH_PROPERTIES,
-                       {"path": path, "properties": merged})
+            with self._journal.create_context() as ctx:
+                ctx.append(EntryType.PATH_PROPERTIES,
+                           {"path": path, "properties": merged})
 
     def remove(self, path: str, keys: Optional[List[str]] = None) -> None:
         path = AlluxioURI(path).path
-        with self._lock:
-            if path not in self._props:
-                return
-            if keys:
-                remaining = {k: v for k, v in self._props[path].items()
-                             if k not in keys}
+        with self._mutate_lock:
+            with self._lock:
+                if path not in self._props:
+                    return
+                if keys:
+                    remaining = {k: v for k, v in self._props[path].items()
+                                 if k not in keys}
+                else:
+                    remaining = {}
+            if remaining:
+                with self._journal.create_context() as ctx:
+                    ctx.append(EntryType.PATH_PROPERTIES,
+                               {"path": path, "properties": remaining})
             else:
-                remaining = {}
-        if remaining:
-            with self._journal.create_context() as ctx:
-                ctx.append(EntryType.PATH_PROPERTIES,
-                           {"path": path, "properties": remaining})
-        else:
-            with self._journal.create_context() as ctx:
-                ctx.append(EntryType.REMOVE_PATH_PROPERTIES, {"path": path})
+                with self._journal.create_context() as ctx:
+                    ctx.append(EntryType.REMOVE_PATH_PROPERTIES,
+                               {"path": path})
 
     def get_all(self) -> Dict[str, Dict[str, str]]:
         with self._lock:

@@ -42,12 +42,28 @@ class MasterProcess:
                  root_ufs_uri: Optional[str] = None) -> None:
         self._conf = conf
         self._clock = clock or SystemClock()
-        self.journal = create_journal_system(
-            conf.get(Keys.MASTER_JOURNAL_TYPE),
-            conf.get(Keys.MASTER_JOURNAL_FOLDER),
-            max_log_size=conf.get_bytes(Keys.MASTER_JOURNAL_LOG_SIZE_BYTES_MAX),
-            checkpoint_period_entries=conf.get_int(
-                Keys.MASTER_JOURNAL_CHECKPOINT_PERIOD_ENTRIES))
+        jtype = str(conf.get(Keys.MASTER_JOURNAL_TYPE)).upper()
+        if jtype == "EMBEDDED":
+            lo = conf.get_ms(Keys.MASTER_EMBEDDED_JOURNAL_ELECTION_TIMEOUT_MIN)
+            hi = conf.get_ms(Keys.MASTER_EMBEDDED_JOURNAL_ELECTION_TIMEOUT_MAX)
+            self.journal = create_journal_system(
+                jtype, conf.get(Keys.MASTER_JOURNAL_FOLDER),
+                address=str(conf.get(
+                    Keys.MASTER_EMBEDDED_JOURNAL_ADDRESS)),
+                addresses=str(conf.get(
+                    Keys.MASTER_EMBEDDED_JOURNAL_ADDRESSES)),
+                election_timeout_ms=(int(lo), int(hi)),
+                heartbeat_interval_ms=int(conf.get_ms(
+                    Keys.MASTER_EMBEDDED_JOURNAL_HEARTBEAT_INTERVAL)),
+                snapshot_period_entries=conf.get_int(
+                    Keys.MASTER_EMBEDDED_JOURNAL_SNAPSHOT_PERIOD_ENTRIES))
+        else:
+            self.journal = create_journal_system(
+                jtype, conf.get(Keys.MASTER_JOURNAL_FOLDER),
+                max_log_size=conf.get_bytes(
+                    Keys.MASTER_JOURNAL_LOG_SIZE_BYTES_MAX),
+                checkpoint_period_entries=conf.get_int(
+                    Keys.MASTER_JOURNAL_CHECKPOINT_PERIOD_ENTRIES))
         self.block_master = BlockMaster(
             self.journal, clock=self._clock,
             worker_timeout_ms=conf.get_ms(Keys.MASTER_WORKER_TIMEOUT))
@@ -60,6 +76,7 @@ class MasterProcess:
             supergroup=str(conf.get(
                 Keys.SECURITY_AUTHORIZATION_PERMISSION_SUPERGROUP)),
             superuser=get_os_user())
+        self.permission_checker = checker
         self.fs_master = FileSystemMaster(
             self.block_master, self.journal, clock=self._clock,
             default_block_size=conf.get_bytes(
@@ -123,12 +140,17 @@ class MasterProcess:
             self.fs_master, active_sync=self.active_sync,
             audit_writer=self.audit_writer))
         self.rpc_server.add_service(block_master_service(self.block_master))
+        from alluxio_tpu.master.metrics_master import MetricsMaster
+
+        self.metrics_master = MetricsMaster()
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
             start_time_ms=self.start_time_ms,
             safe_mode_fn=self.in_safe_mode, journal=self.journal,
             path_properties=self.path_properties,
-            config_checker=self.config_checker))
+            config_checker=self.config_checker,
+            permission_checker=self.permission_checker,
+            metrics_master=self.metrics_master))
         self.rpc_port = self.rpc_server.start()
         return self.rpc_port
 
@@ -196,8 +218,21 @@ class FaultTolerantMasterProcess(MasterProcess):
             FileLockPrimarySelector, JournalTailer,
         )
 
-        self.selector = selector or FileLockPrimarySelector(
-            conf.get(Keys.MASTER_JOURNAL_FOLDER))
+        if selector is not None:
+            self.selector = selector
+        else:
+            from alluxio_tpu.journal.raft import (
+                EmbeddedJournalSystem, RaftPrimarySelector,
+            )
+
+            if isinstance(self.journal, EmbeddedJournalSystem):
+                # embedded journal: Raft election IS primary election, and
+                # followers apply continuously (no tailer needed)
+                self.selector = RaftPrimarySelector(self.journal)
+                self.journal.node.on_step_down(self._on_deposed)
+            else:
+                self.selector = FileLockPrimarySelector(
+                    conf.get(Keys.MASTER_JOURNAL_FOLDER))
         import threading
 
         self._tailer = JournalTailer(
@@ -224,9 +259,13 @@ class FaultTolerantMasterProcess(MasterProcess):
         self.journal.start()
         self._init_from_backup_if_configured()
         if self.selector.try_acquire():
-            self.journal.gain_primacy()
-            self.serving = True
-            return self._start_serving()
+            # under _promote_lock: a Raft step-down firing _on_deposed
+            # mid-boot must not demote half-initialized serving state
+            with self._promote_lock:
+                self.journal.gain_primacy()
+                port = self._start_serving()
+                self.serving = True
+            return port
         self.journal.standby_start()
         self._tailer.start()
         self._promote_thread = threading.Thread(
@@ -246,6 +285,36 @@ class FaultTolerantMasterProcess(MasterProcess):
                         return
                     self.promote()
                 return
+
+    def _on_deposed(self) -> None:
+        """Raft step-down while serving: stop client RPCs and rejoin the
+        election loop as a standby. Journal writes already fail fast
+        (propose raises when not leader), so this is availability hygiene,
+        not the fence — terms are the fence. Runs on its own thread: the
+        raft node invokes callbacks under its lock."""
+        import threading
+
+        def demote():
+            with self._promote_lock:
+                if self._stopped or not self.serving:
+                    return
+                self.serving = False
+                for t in self._threads:
+                    t.stop()
+                self._threads = []
+                if self.rpc_server is not None:
+                    self.rpc_server.stop()
+                    self.rpc_server = None
+                if getattr(self, "audit_writer", None) is not None:
+                    self.audit_writer.stop()
+                    self.audit_writer = None
+                self._promote_thread = threading.Thread(
+                    target=self._wait_and_promote, name="primacy-waiter",
+                    daemon=True)
+                self._promote_thread.start()
+
+        threading.Thread(target=demote, name="raft-demote",
+                         daemon=True).start()
 
     def promote(self) -> int:
         """Standby -> primary: stop tailing, finish the tail in place (no

@@ -70,10 +70,17 @@ class MultiProcessCluster:
 
     def __init__(self, base_dir: str, *, num_masters: int = 1,
                  num_workers: int = 1,
+                 journal_type: str = "LOCAL",
                  extra_conf: Optional[Dict[str, str]] = None) -> None:
+        """``journal_type``: LOCAL = shared journal dir + flock election
+        (masters must share a filesystem); EMBEDDED = per-master journal
+        dirs + Raft quorum over the embedded journal ports (true
+        multi-host HA; reference: EmbeddedJournalIntegrationTest)."""
         self.base = base_dir
         self.journal_dir = os.path.join(base_dir, "journal")
+        self.journal_type = journal_type.upper()
         self.master_ports = [free_port() for _ in range(num_masters)]
+        self.raft_ports = [free_port() for _ in range(num_masters)]
         self.worker_ports = [free_port() for _ in range(num_workers)]
         self.masters: List[ManagedProcess] = []
         self.workers: List[ManagedProcess] = []
@@ -108,10 +115,23 @@ class MultiProcessCluster:
         self.wait_for_workers(len(self.worker_ports))
         return self
 
+    @property
+    def raft_addresses(self) -> str:
+        return ",".join(f"127.0.0.1:{p}" for p in self.raft_ports)
+
     def start_master(self, index: int) -> ManagedProcess:
         env = self._common_env()
         env["ATPU_MASTER_RPC_PORT"] = str(self.master_ports[index])
         env["ATPU_MASTER_HA_ENABLED"] = "true"
+        if self.journal_type == "EMBEDDED":
+            env["ATPU_MASTER_JOURNAL_TYPE"] = "EMBEDDED"
+            # each quorum member keeps its OWN journal (no shared fs)
+            env["ATPU_MASTER_JOURNAL_FOLDER"] = os.path.join(
+                self.base, f"journal-m{index}")
+            env["ATPU_MASTER_EMBEDDED_JOURNAL_ADDRESSES"] = \
+                self.raft_addresses
+            env["ATPU_MASTER_EMBEDDED_JOURNAL_ADDRESS"] = \
+                f"127.0.0.1:{self.raft_ports[index]}"
         p = ManagedProcess(
             "master", env,
             os.path.join(self.base, "logs", f"master{index}.out"))

@@ -250,6 +250,13 @@ class MetaMasterClient(_BaseClient):
         self._call("register_node_conf", {"node_id": node_id,
                                           "config": config})
 
+    def metrics_heartbeat(self, source: str,
+                          metrics: Dict[str, float]) -> None:
+        """Ship a node's metric snapshot for cluster aggregation
+        (reference: ``metric_master.proto`` ClientMasterSync)."""
+        self._call("metrics_heartbeat", {"source": source,
+                                         "metrics": metrics})
+
     def get_config_report(self) -> dict:
         return self._call("get_config_report", {})
 

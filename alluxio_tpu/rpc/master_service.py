@@ -183,12 +183,23 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         safe_mode_fn=lambda: False,
                         journal=None,
                         path_properties=None,
-                        config_checker=None) -> ServiceDefinition:
+                        config_checker=None,
+                        permission_checker=None,
+                        metrics_master=None) -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
     config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
-    trigger used by ``fsadmin journal checkpoint``)."""
+    trigger used by ``fsadmin journal checkpoint``).
+
+    Admin ops (backup / checkpoint / path-conf mutation) are gated behind
+    superuser, as the reference gates them behind admin privilege."""
     svc = ServiceDefinition(META_SERVICE)
+
+    def _require_admin() -> None:
+        if permission_checker is not None:
+            from alluxio_tpu.security.user import authenticated_user
+
+            permission_checker.check_superuser(authenticated_user())
     svc.unary("get_configuration", lambda r: {
         "properties": conf.to_map(min_source=Source.SITE_PROPERTY),
         "hash": conf.hash()})
@@ -196,11 +207,24 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
     svc.unary("get_master_info", lambda r: {
         "cluster_id": cluster_id, "start_time_ms": start_time_ms,
         "safe_mode": bool(safe_mode_fn())})
-    svc.unary("get_metrics", lambda r: {"metrics": metrics().snapshot()})
-    svc.unary("metrics_heartbeat", lambda r: (
-        metrics() and None, {})[-1])
+    def _get_metrics(r):
+        snap = metrics().snapshot()
+        if metrics_master is not None:
+            snap = metrics_master.merged_snapshot(snap)
+        return {"metrics": snap}
+
+    def _metrics_heartbeat(r):
+        """Worker/client metric snapshots -> cluster aggregation
+        (reference: DefaultMetricsMaster + metric_master.proto)."""
+        if metrics_master is not None:
+            return metrics_master.handle_heartbeat(r)
+        return {}
+
+    svc.unary("get_metrics", _get_metrics)
+    svc.unary("metrics_heartbeat", _metrics_heartbeat)
 
     def _checkpoint(r):
+        _require_admin()
         if journal is None:
             from alluxio_tpu.utils.exceptions import FailedPreconditionError
 
@@ -212,25 +236,47 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
     svc.unary("checkpoint", _checkpoint)
 
     def _backup(r):
+        _require_admin()
         if journal is None or not hasattr(journal, "write_backup"):
             from alluxio_tpu.utils.exceptions import FailedPreconditionError
 
             raise FailedPreconditionError(
                 "this master's journal does not support backups")
-        from alluxio_tpu.conf import Keys
+        import os
 
-        backup_dir = r.get("directory") or conf.get(Keys.MASTER_BACKUP_DIR)
-        path = journal.write_backup(str(backup_dir))
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+        root = str(conf.get(Keys.MASTER_BACKUP_DIR))
+        backup_dir = r.get("directory") or root
+        # confine request-supplied dirs under the configured backup root:
+        # a remote admin must not write tarballs to arbitrary master paths
+        resolved = os.path.realpath(str(backup_dir))
+        root_resolved = os.path.realpath(root)
+        if resolved != root_resolved and \
+                not resolved.startswith(root_resolved + os.sep):
+            raise InvalidArgumentError(
+                f"backup directory {backup_dir!r} escapes the configured "
+                f"backup root {root!r}")
+        path = journal.write_backup(resolved)
         return {"backup_uri": path,
                 "entry_count": getattr(journal, "sequence", 0)}
 
     svc.unary("backup", _backup)
 
+    def _set_path_conf(r):
+        _require_admin()
+        path_properties.add(r["path"], r["properties"])
+        return {}
+
+    def _remove_path_conf(r):
+        _require_admin()
+        path_properties.remove(r["path"], r.get("keys"))
+        return {}
+
     if path_properties is not None:
-        svc.unary("set_path_conf", lambda r: (
-            path_properties.add(r["path"], r["properties"]), {})[-1])
-        svc.unary("remove_path_conf", lambda r: (
-            path_properties.remove(r["path"], r.get("keys")), {})[-1])
+        svc.unary("set_path_conf", _set_path_conf)
+        svc.unary("remove_path_conf", _remove_path_conf)
         svc.unary("get_path_conf", lambda r: {
             "properties": path_properties.get_all(),
             "hash": path_properties.hash()})

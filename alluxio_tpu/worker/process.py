@@ -92,6 +92,27 @@ def build_store_from_conf(conf: Configuration) -> TieredBlockStore:
     return TieredBlockStore(meta, allocator, annotator)
 
 
+class _MetricsReporter:
+    """Ships this worker's metric snapshot to the master each tick for
+    cluster aggregation (reference: worker side of metric_master.proto)."""
+
+    def __init__(self, meta_client, source: str) -> None:
+        self._client = meta_client
+        self._source = source
+
+    def heartbeat(self) -> None:
+        from alluxio_tpu.metrics import metrics
+
+        try:
+            self._client.metrics_heartbeat(self._source,
+                                           metrics().snapshot())
+        except Exception:  # noqa: BLE001 master transition: retry next tick
+            LOG.debug("metrics heartbeat failed", exc_info=True)
+
+    def close(self) -> None:
+        pass
+
+
 class BlockWorker:
     """The worker: tiered store + protocols. Reference: DefaultBlockWorker."""
 
@@ -156,6 +177,14 @@ class BlockWorker:
             HeartbeatThread(HeartbeatContext.WORKER_MANAGEMENT_TASKS,
                             self._mgmt, mgmt_interval),
         ]
+        if self._meta_client is not None:
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.WORKER_CLIENT_METRICS,
+                _MetricsReporter(
+                    self._meta_client,
+                    f"worker-{self.address.host}:{self.address.rpc_port}"),
+                self._conf.get_duration_s(
+                    Keys.WORKER_METRICS_HEARTBEAT_INTERVAL)))
         if self._pin_sync is not None:
             self._threads.append(
                 HeartbeatThread(HeartbeatContext.WORKER_PIN_LIST_SYNC,

@@ -102,21 +102,15 @@ class HbmPageStore:
         import numpy as np
 
         arr = np.frombuffer(host_buffer, dtype=np.uint8)
-        size = arr.nbytes
         with self._lock:
             if page_id in self._pages:
                 return True
-            if size > self._capacity:
-                return False
-            if not self._ensure_room(size):
-                return False
-            # device_put from a zero-copy numpy view: one DMA host->HBM
-            device_arr = self._jax.device_put(arr, self._device)
-            self._pages[page_id] = device_arr
-            self._sizes[page_id] = size
-            self._used += size
-            self._evictor.update_on_put(page_id)
-            return True
+            if arr.nbytes > self._capacity:
+                return False  # precheck: skip a doomed transfer
+            # device_put from a zero-copy numpy view: one DMA host->HBM;
+            # retention bookkeeping lives in adopt() (single code path)
+            return self.adopt(page_id,
+                              self._jax.device_put(arr, self._device))
 
     def adopt(self, page_id: PageId, device_array) -> bool:
         """Retain an ALREADY device-resident array (e.g. the loader just

@@ -61,6 +61,17 @@ class DeviceBlockLoader:
     def __len__(self) -> int:
         return len(self._plan)
 
+    @property
+    def plan(self) -> List[tuple]:
+        """The load plan as public ``(path, block_index)`` pairs (the
+        mesh data plane builds its placement from this)."""
+        return [(path, i) for (path, i, _pid) in self._plan]
+
+    def host_block(self, path: str, index: int):
+        """Public host-side read of one block (zero-copy numpy view on the
+        short-circuit path, else a streamed copy)."""
+        return self._host_bytes(path, index)
+
     # -- single block --------------------------------------------------------
     def _host_bytes(self, path: str, index: int):
         """Host-side view of one block: zero-copy numpy over mmap when the

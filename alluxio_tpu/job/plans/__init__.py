@@ -11,7 +11,9 @@ def register_builtin_plans(registry) -> None:
     from alluxio_tpu.job.plans.replicate import (
         EvictDefinition, MoveDefinition, ReplicateDefinition,
     )
+    from alluxio_tpu.job.plans.transform import TransformDefinition
 
     for plan in (LoadDefinition(), MigrateDefinition(), PersistDefinition(),
-                 ReplicateDefinition(), EvictDefinition(), MoveDefinition()):
+                 ReplicateDefinition(), EvictDefinition(), MoveDefinition(),
+                 TransformDefinition()):
         registry.register(plan)

@@ -112,9 +112,10 @@ class RaftLog:
             self.term = meta["term"]
             self.voted_for = meta.get("voted_for")
             self.start_index = meta.get("start_index", 1)
+        dirty = False
         if os.path.exists(self._log_path):
+            off = 0
             with open(self._log_path, "rb") as f:
-                off = 0
                 while True:
                     hdr = f.read(_FRAME.size)
                     if len(hdr) < _FRAME.size:
@@ -127,15 +128,21 @@ class RaftLog:
                         RaftRecord.from_wire(msgpack.unpackb(body, raw=False)))
                     self._offsets.append(off)
                     off += _FRAME.size + length
+            # a torn tail MUST be truncated away before appending: 'ab'
+            # positions past the garbage, and records written after it
+            # would be unreadable on the next restart (scan stops at the
+            # torn frame) — silently losing acknowledged entries
+            dirty = off != os.path.getsize(self._log_path)
             # drop any pre-start_index remnants (post-snapshot-truncation
             # crash window)
             while self.records and self.records[0].index < self.start_index:
                 self.records.pop(0)
                 self._offsets.pop(0)
-        self._file = open(self._log_path, "ab")
-        if self._file.tell() == 0:
-            self._offsets = []
-            self._rewrite()  # normalizes after torn-tail truncate
+                dirty = True
+        if dirty:
+            self._rewrite()
+        else:
+            self._file = open(self._log_path, "ab")
 
     def save_meta(self) -> None:
         tmp = self._meta_path + ".tmp"
@@ -282,6 +289,9 @@ class RaftNode:
         self.lock = threading.RLock()
         self.commit_cv = threading.Condition(self.lock)
         self.apply_cv = threading.Condition(self.lock)
+        # serializes snapshot FILE IO (periodic + admin checkpoint +
+        # install) without stalling consensus under self.lock
+        self._snap_io_lock = threading.Lock()
         #: index -> RaftRecord for batches proposed by THIS node's callers.
         #: The proposing thread applies its own batch once committed and
         #: in-order (it holds the owning component's write lock — the same
@@ -366,36 +376,45 @@ class RaftNode:
             self.log.truncate_prefix(snap["index"])
 
     def take_snapshot(self) -> None:
-        """Snapshot local applied state; truncate the covered log prefix."""
-        with self.lock:
-            index, seq = self.applied_index, self.applied_seq
-            term = self.log.term_at(index, snapshot_term=self.snapshot_term)
-            if index == 0:
-                return
-            comps = self._snapshot_fn()
-        d = self._snap_dir()
-        os.makedirs(d, exist_ok=True)
-        blob = msgpack.packb({"term": term, "index": index, "seq": seq,
-                              "components": comps}, use_bin_type=True)
-        tmp = os.path.join(d, ".tmp.snap")
+        """Snapshot local applied state; truncate the covered log prefix.
+        File IO happens outside the consensus lock (under _snap_io_lock,
+        which also serializes concurrent periodic/admin/install callers)."""
+        with self._snap_io_lock:
+            with self.lock:
+                index, seq = self.applied_index, self.applied_seq
+                term = self.log.term_at(index,
+                                        snapshot_term=self.snapshot_term)
+                if index == 0:
+                    return
+                comps = self._snapshot_fn()
+            d = self._snap_dir()
+            os.makedirs(d, exist_ok=True)
+            blob = msgpack.packb({"term": term, "index": index, "seq": seq,
+                                  "components": comps}, use_bin_type=True)
+            self._write_snapshot_file(d, term, index, blob)
+            with self.lock:
+                self.snapshot_term = term
+                self._entries_since_snapshot = 0
+                if self.log.start_index <= index:
+                    self.log.truncate_prefix(index)
+            # GC older snapshots
+            keep = self._latest_snapshot_path()
+            for f in os.listdir(d):
+                if f.endswith(".snap") and os.path.join(d, f) != keep:
+                    try:
+                        os.remove(os.path.join(d, f))
+                    except OSError:
+                        pass
+
+    def _write_snapshot_file(self, d: str, term: int, index: int,
+                             blob: bytes) -> None:
+        """Caller holds _snap_io_lock (unique tmp per thread regardless)."""
+        tmp = os.path.join(d, f".tmp.{os.getpid()}.{threading.get_ident()}")
         with open(tmp, "wb") as f:
             f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(d, f"{term:08x}_{index:016x}.snap"))
-        with self.lock:
-            self.snapshot_term = term
-            self._entries_since_snapshot = 0
-            if self.log.start_index <= index:
-                self.log.truncate_prefix(index)
-        # GC older snapshots
-        for f in os.listdir(d):
-            if f.endswith(".snap") and \
-                    os.path.join(d, f) != self._latest_snapshot_path():
-                try:
-                    os.remove(os.path.join(d, f))
-                except OSError:
-                    pass
 
     # -- elections -----------------------------------------------------------
     def _reset_election_deadline(self) -> None:
@@ -585,22 +604,23 @@ class RaftNode:
             self.applied_index = snap["index"]
             self.applied_seq = snap["seq"]
             self.commit_index = max(self.commit_index, snap["index"])
-            # discard the whole log; it is covered by the snapshot
-            self.log.records = []
-            self.log.start_index = snap["index"] + 1
-            self.log.save_meta()
-            self.log._rewrite()
-            # persist as a local snapshot so a restart recovers from it
+        # persist the snapshot file BEFORE truncating the durable log
+        # (a crash in between leaves snapshot+old-log, which recovery
+        # reconciles; truncating first would leave a hole) — and do the
+        # file IO outside the consensus lock
+        with self._snap_io_lock:
             d = self._snap_dir()
             os.makedirs(d, exist_ok=True)
             blob = msgpack.packb(snap, use_bin_type=True)
-            tmp = os.path.join(d, ".tmp.snap")
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, os.path.join(
-                d, f"{snap['term']:08x}_{snap['index']:016x}.snap"))
+            self._write_snapshot_file(d, snap["term"], snap["index"], blob)
+        with self.lock:
+            # discard the log prefix the snapshot covers (usually all)
+            self.log.records = [r for r in self.log.records
+                                if r.index > snap["index"]]
+            self.log.start_index = max(self.log.start_index,
+                                       snap["index"] + 1)
+            self.log.save_meta()
+            self.log._rewrite()
             return {"term": self.log.term, "ok": True,
                     "match_index": self.log.last_index}
 
@@ -659,6 +679,15 @@ class RaftNode:
                         raise JournalClosedError(
                             "timed out waiting for quorum commit")
                     self.commit_cv.wait(timeout=min(remaining, 0.5))
+                if self.log.get(idx) is not rec:
+                    # deposed before replication: a new leader's record
+                    # truncated ours away — the committed slot at idx is
+                    # NOT our batch; never apply the stale entries (the
+                    # apply loop handles the real record once we unregister
+                    # in the finally block)
+                    raise JournalClosedError(
+                        "entry superseded after leadership loss; not "
+                        "acknowledged")
                 for e in rec.entries:
                     self._apply_fn(e)
                     self.applied_seq = max(self.applied_seq, e.sequence)
@@ -701,32 +730,30 @@ class RaftNode:
                     continue
                 term = self.log.term
                 nxt = self.next_index.get(nid, self.log.last_index + 1)
-                if nxt < self.log.start_index:
-                    # peer needs truncated history: ship a snapshot
-                    snap_path = self._latest_snapshot_path()
-                    if snap_path is None:
-                        # no snapshot on disk yet (all state in log):
-                        # take one now outside the lock
-                        need_snap = True
-                        payload = None
-                    else:
-                        need_snap = True
-                        with open(snap_path, "rb") as f:
-                            payload = msgpack.unpackb(
-                                f.read(), raw=False, strict_map_key=False)
-                else:
-                    need_snap = False
-                    payload = None
+                need_snap = nxt < self.log.start_index
+                if not need_snap:
                     prev = nxt - 1
                     prev_term = self.log.term_at(
                         prev, snapshot_term=self.snapshot_term)
                     recs = [r.to_wire() for r in self.log.slice_from(nxt)]
                     commit = self.commit_index
+            payload = None
+            if need_snap:
+                # read + decode the (possibly large) snapshot file OUTSIDE
+                # the consensus lock — a slow standby must not stall
+                # appends/votes into an election timeout
+                snap_path = self._latest_snapshot_path()
+                if snap_path is not None:
+                    with open(snap_path, "rb") as f:
+                        payload = msgpack.unpackb(
+                            f.read(), raw=False, strict_map_key=False)
             try:
                 if need_snap:
                     if payload is None:
+                        # no snapshot on disk yet (all state in log):
+                        # take one, then retry with it available
                         self.take_snapshot()
-                        continue  # retry loop with snapshot available
+                        continue
                     resp = _peer_call(addr, "install_snapshot", {
                         "term": term, "leader_id": self.node_id,
                         "snapshot": payload}, timeout=10.0)

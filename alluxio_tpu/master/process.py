@@ -90,6 +90,26 @@ class MasterProcess:
 
         self.active_sync = ActiveSyncManager(self.fs_master, self.journal)
         self.path_properties = PathProperties(self.journal)
+        from alluxio_tpu.table.master import TableMaster
+
+        def _table_fs_factory():
+            from alluxio_tpu.client.file_system import FileSystem
+            from alluxio_tpu.conf import Configuration
+
+            return FileSystem(self.address,
+                              conf=Configuration(load_env=False))
+
+        def _table_job_factory():
+            from alluxio_tpu.rpc.job_service import JobMasterClient
+
+            return JobMasterClient(
+                f"localhost:{conf.get_int(Keys.JOB_MASTER_RPC_PORT)}")
+
+        # registered with the journal BEFORE replay so catalog entries
+        # from prior runs find their component
+        self.table_master = TableMaster(self.journal,
+                                        fs_factory=_table_fs_factory,
+                                        job_client_factory=_table_job_factory)
         self.config_checker = ConfigurationChecker()
         self.config_checker.register(
             "master", {k: str(v) for k, v in conf.to_map().items()})
@@ -141,7 +161,9 @@ class MasterProcess:
             audit_writer=self.audit_writer))
         self.rpc_server.add_service(block_master_service(self.block_master))
         from alluxio_tpu.master.metrics_master import MetricsMaster
+        from alluxio_tpu.rpc.table_service import table_master_service
 
+        self.rpc_server.add_service(table_master_service(self.table_master))
         self.metrics_master = MetricsMaster()
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,

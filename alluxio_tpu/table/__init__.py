@@ -1,0 +1,16 @@
+"""Structured-data (table) service: catalog + UDB SPI + transforms.
+
+Re-design of the reference's ``table/`` module (12.5k LoC Java:
+``table/server/master/.../AlluxioCatalog.java:55``, ``DefaultTableMaster``,
+UDB SPI ``table/server/common/.../udb/UnderDatabase.java``,
+``transform/TransformManager.java:82``) for the TPU data plane: the
+catalog snapshots an under-database's schemas/partitions into journaled
+master state; reads are **column projections** straight out of Parquet
+through the caching FS client (the path bench config #4 measures); the
+compact transform runs as a job-service plan.
+"""
+
+from alluxio_tpu.table.master import TableMaster  # noqa: F401
+from alluxio_tpu.table.udb import (  # noqa: F401
+    FsUnderDatabase, UdbPartition, UdbTable, UnderDatabase, udb_factory,
+)

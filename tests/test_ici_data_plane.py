@@ -1,0 +1,133 @@
+"""ICI data plane: mesh-sharded warm blocks + collective reads
+(SURVEY §5.8 TPU-native mapping; VERDICT round-1 item 3).
+
+Runs on the virtual 8-device CPU mesh from conftest. The key assertion:
+once the warm set is resident, peer reads are collectives — ZERO new
+host/gRPC block reads happen (metrics counters hold still)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from alluxio_tpu.client.streams import WriteType
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.minicluster import LocalCluster
+from alluxio_tpu.parallel.ici_store import MeshBlockCache
+from alluxio_tpu.parallel.mesh import make_mesh
+
+BLOCK = 4096
+N_FILES = 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    import jax
+
+    return make_mesh(devices=jax.devices())
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1, block_size=BLOCK,
+                      worker_mem_bytes=64 << 20) as c:
+        yield c
+
+
+def _write_dataset(fs):
+    rng = np.random.default_rng(7)
+    payloads = []
+    for i in range(N_FILES):
+        data = rng.integers(0, 255, size=BLOCK, dtype=np.uint8).tobytes()
+        fs.write_all(f"/ici/b{i}", data, write_type=WriteType.MUST_CACHE)
+        payloads.append(np.frombuffer(data, np.uint8))
+    return payloads
+
+
+class TestMeshBlockCache:
+    def test_load_global_shards_by_mesh_position(self, cluster, mesh):
+        fs = cluster.file_system()
+        payloads = _write_dataset(fs)
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK)
+        cached = cache.load_global(fs, [f"/ici/b{i}"
+                                        for i in range(N_FILES)])
+        assert cached.shape == (N_FILES, BLOCK)
+        # block map keyed by mesh position: 2 blocks per device, contiguous
+        placement = cache.describe_placement(cached)
+        assert len(placement) == 8
+        for pos, blocks in placement.items():
+            assert blocks == [2 * pos, 2 * pos + 1]
+        # contents survive the shard/assemble round-trip
+        got = np.asarray(cached)
+        for i, p in enumerate(payloads):
+            np.testing.assert_array_equal(got[i], p)
+        fs.close()
+
+    def test_warm_collective_reads_no_host_traffic(self, cluster, mesh):
+        """gather_all / ring_shift / global_batch touch NO host path: the
+        short-circuit and streamed-block counters must not move."""
+        fs = cluster.file_system()
+        payloads = _write_dataset(fs)
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK)
+        cached = cache.load_global(fs, [f"/ici/b{i}"
+                                        for i in range(N_FILES)])
+        m = metrics()
+        before = (m.counter("Client.JaxShortCircuitBlocks").count,
+                  m.counter("Client.JaxStreamedBlocks").count)
+
+        full = np.asarray(cache.gather_all(cached))
+        for i, p in enumerate(payloads):
+            np.testing.assert_array_equal(full[i], p)
+
+        shifted = cache.ring_shift(cached, shift=1)
+        sh = np.asarray(shifted)
+        # device p now holds device (p+1)%8's shard: global rows rotate
+        # by per_dev=2
+        np.testing.assert_array_equal(sh[0], payloads[2])
+        np.testing.assert_array_equal(sh[-2], payloads[0])
+
+        batch = np.asarray(cache.global_batch(cached, [3, 11, 6]))
+        np.testing.assert_array_equal(batch[0], payloads[3])
+        np.testing.assert_array_equal(batch[1], payloads[11])
+        np.testing.assert_array_equal(batch[2], payloads[6])
+
+        after = (m.counter("Client.JaxShortCircuitBlocks").count,
+                 m.counter("Client.JaxStreamedBlocks").count)
+        assert after == before, \
+            "warm collective reads must not touch the host data path"
+        fs.close()
+
+    def test_replicate_hot_block_to_all_devices(self, cluster, mesh):
+        fs = cluster.file_system()
+        payloads = _write_dataset(fs)
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK)
+        cached = cache.load_global(fs, [f"/ici/b{i}"
+                                        for i in range(N_FILES)])
+        hot = cache.replicate(cached, 5)
+        assert hot.shape == (BLOCK,)
+        # fully replicated: every device holds the whole block
+        assert hot.sharding.is_fully_replicated
+        assert len(hot.addressable_shards) == 8
+        np.testing.assert_array_equal(np.asarray(hot), payloads[5])
+        fs.close()
+
+    def test_ragged_tail_padded(self, cluster, mesh):
+        """n_blocks not divisible by mesh size: tail blocks pad with
+        zeros and real blocks stay addressable."""
+        fs = cluster.file_system()
+        rng = np.random.default_rng(3)
+        n = 5  # 5 blocks over 8 devices
+        payloads = []
+        for i in range(n):
+            data = rng.integers(0, 255, size=BLOCK,
+                                dtype=np.uint8).tobytes()
+            fs.write_all(f"/rag/b{i}", data,
+                         write_type=WriteType.MUST_CACHE)
+            payloads.append(np.frombuffer(data, np.uint8))
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK)
+        cached = cache.load_global(fs, [f"/rag/b{i}" for i in range(n)])
+        assert cached.shape[0] == 8  # padded to 1 per device
+        got = np.asarray(cache.global_batch(cached, list(range(n))))
+        for i, p in enumerate(payloads):
+            np.testing.assert_array_equal(got[i], p)
+        fs.close()

@@ -375,6 +375,12 @@ class Keys:
     MASTER_REPLICATION_CHECK_INTERVAL = _k(
         "atpu.master.replication.check.interval", KeyType.DURATION, default="1min",
         scope=Scope.MASTER)
+    TABLE_TRANSFORM_MONITOR_INTERVAL = _k(
+        "atpu.table.transform.manager.job.monitor.interval", KeyType.DURATION,
+        default="10s", scope=Scope.MASTER,
+        description="How often the table master polls running transform "
+                    "jobs and commits completed layouts (reference: "
+                    "TransformManager.java:82 heartbeat).")
     MASTER_PERSISTENCE_SCHEDULER_INTERVAL = _k(
         "atpu.master.persistence.scheduler.interval", KeyType.DURATION, default="1s",
         scope=Scope.MASTER)

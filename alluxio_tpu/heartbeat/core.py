@@ -26,6 +26,7 @@ class HeartbeatContext:
     MASTER_ACTIVE_SYNC = "Master.ActiveUfsSync"
     MASTER_DAILY_BACKUP = "Master.DailyBackup"
     MASTER_JOURNAL_SPACE_MONITOR = "Master.JournalSpaceMonitor"
+    MASTER_TABLE_TRANSFORM_MONITOR = "Master.TableTransformMonitor"
     WORKER_BLOCK_SYNC = "Worker.BlockSync"
     WORKER_PIN_LIST_SYNC = "Worker.PinListSync"
     WORKER_STORAGE_HEALTH = "Worker.StorageHealth"

@@ -57,6 +57,7 @@ class EntryType:
     ATTACH_DB = "attach_db"
     DETACH_DB = "detach_db"
     ADD_TABLE = "add_table"
+    REMOVE_TABLE = "remove_table"
     ADD_TRANSFORM_JOB_INFO = "add_transform_job_info"
     REMOVE_TRANSFORM_JOB_INFO = "remove_transform_job_info"
 

@@ -102,6 +102,11 @@ class RaftLog:
         self.term = 0
         self.voted_for: Optional[str] = None
         self._file = None
+        # logical end-of-file: tracked explicitly because a buffered
+        # 'ab' handle's tell() goes stale after ftruncate — offsets
+        # derived from it would point past EOF and corrupt later
+        # truncations (advisor r2 finding, high)
+        self._end = 0
 
     # -- persistence ---------------------------------------------------------
     def open(self) -> None:
@@ -121,11 +126,17 @@ class RaftLog:
                     if len(hdr) < _FRAME.size:
                         break
                     length, crc = _FRAME.unpack(hdr)
+                    if length == 0:
+                        break  # zero padding: crc32(b'')==0 would "pass"
                     body = f.read(length)
                     if len(body) < length or zlib.crc32(body) != crc:
                         break  # torn tail
-                    self.records.append(
-                        RaftRecord.from_wire(msgpack.unpackb(body, raw=False)))
+                    try:
+                        rec = RaftRecord.from_wire(
+                            msgpack.unpackb(body, raw=False))
+                    except Exception:  # noqa: BLE001 crc-coincident garbage
+                        break  # treat as torn tail, same as format.py
+                    self.records.append(rec)
                     self._offsets.append(off)
                     off += _FRAME.size + length
             # a torn tail MUST be truncated away before appending: 'ab'
@@ -142,6 +153,7 @@ class RaftLog:
         if dirty:
             self._rewrite()
         else:
+            self._end = off if os.path.exists(self._log_path) else 0
             self._file = open(self._log_path, "ab")
 
     def save_meta(self) -> None:
@@ -171,6 +183,7 @@ class RaftLog:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._log_path)
+        self._end = off
         self._file = open(self._log_path, "ab")
 
     def close(self) -> None:
@@ -209,8 +222,9 @@ class RaftLog:
     # -- mutation ------------------------------------------------------------
     def append(self, rec: RaftRecord, *, fsync: bool = True) -> None:
         body = msgpack.packb(rec.to_wire(), use_bin_type=True)
-        self._offsets.append(self._file.tell())
+        self._offsets.append(self._end)
         self._file.write(_FRAME.pack(len(body), zlib.crc32(body)) + body)
+        self._end += _FRAME.size + len(body)
         if fsync:
             self._file.flush()
             os.fsync(self._file.fileno())
@@ -235,6 +249,11 @@ class RaftLog:
         self._file.flush()
         self._file.truncate(off)
         os.fsync(self._file.fileno())
+        # reopen so the 'ab' handle's position reflects the new EOF
+        # (a buffered append handle does not follow ftruncate)
+        self._file.close()
+        self._file = open(self._log_path, "ab")
+        self._end = off
 
     def truncate_prefix(self, upto_index: int) -> None:
         """Drop records <= upto_index (after a snapshot covers them)."""
@@ -292,6 +311,12 @@ class RaftNode:
         # serializes snapshot FILE IO (periodic + admin checkpoint +
         # install) without stalling consensus under self.lock
         self._snap_io_lock = threading.Lock()
+        # serializes component-state mutation (apply/restore) against
+        # snapshot capture, so _snapshot_fn() — a full serialization of
+        # every component — never runs under the consensus lock where it
+        # would stall votes/appends past the election timeout (advisor
+        # r2 finding). Lock order: _snap_io_lock -> _state_lock -> lock.
+        self._state_lock = threading.Lock()
         #: index -> RaftRecord for batches proposed by THIS node's callers.
         #: The proposing thread applies its own batch once committed and
         #: in-order (it holds the owning component's write lock — the same
@@ -380,12 +405,16 @@ class RaftNode:
         File IO happens outside the consensus lock (under _snap_io_lock,
         which also serializes concurrent periodic/admin/install callers)."""
         with self._snap_io_lock:
-            with self.lock:
-                index, seq = self.applied_index, self.applied_seq
-                term = self.log.term_at(index,
-                                        snapshot_term=self.snapshot_term)
-                if index == 0:
-                    return
+            with self._state_lock:
+                # _state_lock freezes component state (appliers take it
+                # before mutating); consensus proceeds under self.lock
+                # while the potentially-large serialization runs
+                with self.lock:
+                    index, seq = self.applied_index, self.applied_seq
+                    term = self.log.term_at(
+                        index, snapshot_term=self.snapshot_term)
+                    if index == 0:
+                        return
                 comps = self._snapshot_fn()
             d = self._snap_dir()
             os.makedirs(d, exist_ok=True)
@@ -599,16 +628,27 @@ class RaftNode:
             if snap["index"] <= self.applied_index:
                 return {"term": self.log.term, "ok": True,
                         "match_index": self.log.last_index}
-            self._restore_fn(snap["components"])
-            self.snapshot_term = snap["term"]
-            self.applied_index = snap["index"]
-            self.applied_seq = snap["seq"]
-            self.commit_index = max(self.commit_index, snap["index"])
-        # persist the snapshot file BEFORE truncating the durable log
-        # (a crash in between leaves snapshot+old-log, which recovery
-        # reconciles; truncating first would leave a hole) — and do the
-        # file IO outside the consensus lock
+        # lock order _snap_io_lock -> _state_lock -> self.lock, same as
+        # take_snapshot; _state_lock freezes appliers during restore
         with self._snap_io_lock:
+            with self._state_lock:
+                with self.lock:
+                    # re-check: state may have moved while unlocked
+                    if req["term"] < self.log.term:
+                        return {"term": self.log.term, "ok": False}
+                    if snap["index"] <= self.applied_index:
+                        return {"term": self.log.term, "ok": True,
+                                "match_index": self.log.last_index}
+                self._restore_fn(snap["components"])
+                with self.lock:
+                    self.snapshot_term = snap["term"]
+                    self.applied_index = snap["index"]
+                    self.applied_seq = snap["seq"]
+                    self.commit_index = max(self.commit_index, snap["index"])
+            # persist the snapshot file BEFORE truncating the durable log
+            # (a crash in between leaves snapshot+old-log, which recovery
+            # reconciles; truncating first would leave a hole) — and do
+            # the file IO outside the consensus lock
             d = self._snap_dir()
             os.makedirs(d, exist_ok=True)
             blob = msgpack.packb(snap, use_bin_type=True)
@@ -664,8 +704,8 @@ class RaftNode:
         for ev in self._peer_wakeups.values():
             ev.set()
         deadline = time.monotonic() + timeout_s
-        with self.lock:
-            try:
+        try:
+            with self.lock:
                 while not (self.commit_index >= idx
                            and self.applied_index == idx - 1):
                     if self._stopped:
@@ -679,23 +719,31 @@ class RaftNode:
                         raise JournalClosedError(
                             "timed out waiting for quorum commit")
                     self.commit_cv.wait(timeout=min(remaining, 0.5))
-                if self.log.get(idx) is not rec:
-                    # deposed before replication: a new leader's record
-                    # truncated ours away — the committed slot at idx is
-                    # NOT our batch; never apply the stale entries (the
-                    # apply loop handles the real record once we unregister
-                    # in the finally block)
-                    raise JournalClosedError(
-                        "entry superseded after leadership loss; not "
-                        "acknowledged")
-                for e in rec.entries:
-                    self._apply_fn(e)
-                    self.applied_seq = max(self.applied_seq, e.sequence)
-                    self._entries_since_snapshot += 1
-                self.applied_index = idx
-                self.apply_cv.notify_all()
-                self.commit_cv.notify_all()
-            finally:
+            # committed + predecessor applied: apply on this thread.
+            # _state_lock taken BEFORE self.lock (lock order) freezes
+            # component state against snapshot capture; applied_index
+            # cannot move meanwhile — our record is in _local_batches so
+            # the apply loop skips it, and nothing can apply idx+1 first.
+            with self._state_lock:
+                with self.lock:
+                    if self.log.get(idx) is not rec:
+                        # deposed before replication: a new leader's record
+                        # truncated ours away — the committed slot at idx
+                        # is NOT our batch; never apply the stale entries
+                        # (the apply loop handles the real record once we
+                        # unregister in the finally block)
+                        raise JournalClosedError(
+                            "entry superseded after leadership loss; not "
+                            "acknowledged")
+                    for e in rec.entries:
+                        self._apply_fn(e)
+                        self.applied_seq = max(self.applied_seq, e.sequence)
+                        self._entries_since_snapshot += 1
+                    self.applied_index = idx
+                    self.apply_cv.notify_all()
+                    self.commit_cv.notify_all()
+        finally:
+            with self.lock:
                 self._local_batches.pop(idx, None)
                 self.apply_cv.notify_all()
 
@@ -808,15 +856,25 @@ class RaftNode:
                     self.apply_cv.wait(timeout=0.5)
                 if self._stopped:
                     return
-                for e in rec.entries:
-                    self._apply_fn(e)
-                    self.applied_seq = max(self.applied_seq, e.sequence)
-                    self._entries_since_snapshot += 1
-                self.applied_index = rec.index
-                self.commit_cv.notify_all()
-                self.apply_cv.notify_all()
-                snap_due = self._entries_since_snapshot >= \
-                    self._snapshot_period
+            # apply under _state_lock -> lock (same order as propose /
+            # take_snapshot); re-verify the record is still the next one
+            # (a conflict truncation may have replaced it while unlocked)
+            snap_due = False
+            with self._state_lock:
+                with self.lock:
+                    if self._stopped:
+                        return
+                    if self.log.get(self.applied_index + 1) is not rec:
+                        continue
+                    for e in rec.entries:
+                        self._apply_fn(e)
+                        self.applied_seq = max(self.applied_seq, e.sequence)
+                        self._entries_since_snapshot += 1
+                    self.applied_index = rec.index
+                    self.commit_cv.notify_all()
+                    self.apply_cv.notify_all()
+                    snap_due = self._entries_since_snapshot >= \
+                        self._snapshot_period
             if snap_due:
                 try:
                     self.take_snapshot()

@@ -26,18 +26,27 @@ class MetricsStore:
     """Per-source metric reports + cluster aggregation."""
 
     def __init__(self, *, source_ttl_s: float = 300.0,
+                 max_sources: int = 4096,
                  clock=time.monotonic) -> None:
         self._reports: Dict[str, Dict[str, float]] = {}
         self._last_seen: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._ttl = source_ttl_s
+        self._max_sources = max_sources
         self._clock = clock
 
     def report(self, source: str, metrics: Dict[str, float]) -> None:
         """A node's full snapshot replaces its previous one (the reference
-        ships complete snapshots, not deltas — idempotent under retry)."""
+        ships complete snapshots, not deltas — idempotent under retry).
+        New sources beyond ``max_sources`` are dropped — bounds memory
+        against spoofed source-name floods (advisor r2 finding)."""
         now = self._clock()
         with self._lock:
+            if source not in self._reports and \
+                    len(self._reports) >= self._max_sources:
+                self._gc(now)
+                if len(self._reports) >= self._max_sources:
+                    return
             self._reports[source] = {str(k): float(v)
                                      for k, v in (metrics or {}).items()}
             self._last_seen[source] = now

@@ -163,7 +163,9 @@ class MasterProcess:
         from alluxio_tpu.master.metrics_master import MetricsMaster
         from alluxio_tpu.rpc.table_service import table_master_service
 
-        self.rpc_server.add_service(table_master_service(self.table_master))
+        self.rpc_server.add_service(table_master_service(
+            self.table_master,
+            permission_checker=self.permission_checker))
         self.metrics_master = MetricsMaster()
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
@@ -191,6 +193,10 @@ class MasterProcess:
                 HeartbeatContext.MASTER_ACTIVE_SYNC,
                 _Exec(self.active_sync.heartbeat),
                 conf.get_duration_s(Keys.MASTER_ACTIVE_SYNC_INTERVAL)),
+            HeartbeatThread(
+                HeartbeatContext.MASTER_TABLE_TRANSFORM_MONITOR,
+                _Exec(self.table_master.heartbeat),
+                conf.get_duration_s(Keys.TABLE_TRANSFORM_MONITOR_INTERVAL)),
         ]
         for t in self._threads:
             t.start()

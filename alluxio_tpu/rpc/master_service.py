@@ -215,8 +215,17 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
 
     def _metrics_heartbeat(r):
         """Worker/client metric snapshots -> cluster aggregation
-        (reference: DefaultMetricsMaster + metric_master.proto)."""
+        (reference: DefaultMetricsMaster + metric_master.proto).
+        Requires an authenticated caller — an anonymous client must not
+        be able to forge sources and inflate Cluster.* aggregates."""
         if metrics_master is not None:
+            if permission_checker is not None:
+                from alluxio_tpu.security.user import authenticated_user
+                from alluxio_tpu.utils.exceptions import UnauthenticatedError
+
+                if authenticated_user() is None:
+                    raise UnauthenticatedError(
+                        "metrics_heartbeat requires an authenticated user")
             return metrics_master.handle_heartbeat(r)
         return {}
 

@@ -15,26 +15,52 @@ from alluxio_tpu.utils.retry import ExponentialTimeBoundedRetry, retry
 TABLE_SERVICE = "table_master"
 
 
-def table_master_service(table_master) -> ServiceDefinition:
+def table_master_service(table_master,
+                         permission_checker=None) -> ServiceDefinition:
+    """Catalog mutations (attach/detach/sync/transform) are superuser-
+    gated, exactly as the meta admin RPCs are (``master_service.py``
+    ``check_superuser``): an arbitrary authenticated user must not be able
+    to attach UDBs, rewrite data under ``_transformed/``, or detach the
+    catalog. Reads stay open."""
     svc = ServiceDefinition(TABLE_SERVICE)
-    svc.unary("attach_database", lambda r: {
-        "db": table_master.attach_database(
-            r["udb_type"], r["connection"], r.get("db_name", ""))})
-    svc.unary("detach_database", lambda r: (
-        table_master.detach_database(r["db"]), {})[-1])
-    svc.unary("sync_database", lambda r: {
-        "tables": table_master.sync_database(r["db"])})
+
+    def _require_admin() -> None:
+        if permission_checker is not None:
+            from alluxio_tpu.security.user import authenticated_user
+
+            permission_checker.check_superuser(authenticated_user())
+
+    def _attach(r):
+        _require_admin()
+        return {"db": table_master.attach_database(
+            r["udb_type"], r["connection"], r.get("db_name", ""))}
+
+    def _detach(r):
+        _require_admin()
+        table_master.detach_database(r["db"])
+        return {}
+
+    def _sync(r):
+        _require_admin()
+        return {"tables": table_master.sync_database(r["db"])}
+
+    def _transform(r):
+        _require_admin()
+        return {"job_id": table_master.transform_table(
+            r["db"], r["table"],
+            definition=r.get("definition", "compact"),
+            options=r.get("options"))}
+
+    svc.unary("attach_database", _attach)
+    svc.unary("detach_database", _detach)
+    svc.unary("sync_database", _sync)
     svc.unary("get_all_databases", lambda r: {
         "dbs": table_master.list_databases()})
     svc.unary("get_all_tables", lambda r: {
         "tables": table_master.list_tables(r["db"])})
     svc.unary("get_table", lambda r: {
         "table": table_master.get_table(r["db"], r["table"])})
-    svc.unary("transform_table", lambda r: {
-        "job_id": table_master.transform_table(
-            r["db"], r["table"],
-            definition=r.get("definition", "compact"),
-            options=r.get("options"))})
+    svc.unary("transform_table", _transform)
     svc.unary("transform_status", lambda r: {
         "info": table_master.transform_status(r["job_id"])})
     return svc

@@ -34,6 +34,7 @@ class ShellContext:
         self._block_client = None
         self._meta_client = None
         self._job_client = None
+        self._table_client = None
 
     @property
     def master_address(self) -> str:
@@ -82,6 +83,13 @@ class ShellContext:
 
             self._job_client = JobMasterClient(self.job_master_address)
         return self._job_client
+
+    def table_client(self):
+        if self._table_client is None:
+            from alluxio_tpu.rpc.table_service import TableMasterClient
+
+            self._table_client = TableMasterClient(self.master_address)
+        return self._table_client
 
     def close(self) -> None:
         if self._fs is not None:

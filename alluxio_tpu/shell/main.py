@@ -21,6 +21,7 @@ Commands:
   fs         file system user shell (ls/cat/cp/pin/...)
   fsadmin    administration shell (report/doctor/journal/...)
   job        job service shell (ls/stat/cancel)
+  table      table/catalog shell (attachdb/ls/sync/transform)
   format     format master journal / worker storage
   master     run a master process
   worker     run a worker process
@@ -104,6 +105,10 @@ def main(argv=None) -> int:
         from alluxio_tpu.shell.job_shell import JOB_SHELL
 
         return JOB_SHELL.run(rest, ctx)
+    if cmd == "table":
+        from alluxio_tpu.shell.table_shell import TABLE_SHELL
+
+        return TABLE_SHELL.run(rest, ctx)
     if cmd == "format":
         from alluxio_tpu.shell.format import main as format_main
 

@@ -41,6 +41,10 @@ class TableMaster(Journaled):
         #: job_id -> transform info wire
         self._transforms: Dict[int, Dict[str, Any]] = {}
         self._lock = threading.Lock()
+        # held across check+journal on mutations so two concurrent
+        # attaches of the same db can't both pass the existence check
+        # (same discipline as PathProperties._mutate_lock)
+        self._mutate_lock = threading.Lock()
         journal.register(self)
 
     # -- helpers -------------------------------------------------------------
@@ -58,39 +62,51 @@ class TableMaster(Journaled):
         udb = udb_factory(udb_type, self._file_system(), connection,
                           db_name)
         name = udb.database_name()
-        with self._lock:
-            if name in self._dbs:
-                raise AlreadyExistsError(f"database {name} is attached")
-        tables = [udb.get_table(t) for t in udb.table_names()]
-        with self._journal.create_context() as ctx:
-            ctx.append(EntryType.ATTACH_DB, {
-                "db": name, "type": udb_type, "connection": connection})
-            for t in tables:
-                ctx.append(EntryType.ADD_TABLE,
-                           {"db": name, "table": t.to_wire()})
+        with self._mutate_lock:
+            with self._lock:
+                if name in self._dbs:
+                    raise AlreadyExistsError(f"database {name} is attached")
+            tables = [udb.get_table(t) for t in udb.table_names()]
+            with self._journal.create_context() as ctx:
+                ctx.append(EntryType.ATTACH_DB, {
+                    "db": name, "type": udb_type, "connection": connection})
+                for t in tables:
+                    ctx.append(EntryType.ADD_TABLE,
+                               {"db": name, "table": t.to_wire()})
         return name
 
     def detach_database(self, db_name: str) -> None:
-        with self._lock:
-            if db_name not in self._dbs:
-                raise NotFoundError(f"database {db_name} is not attached")
-        with self._journal.create_context() as ctx:
-            ctx.append(EntryType.DETACH_DB, {"db": db_name})
+        with self._mutate_lock:
+            with self._lock:
+                if db_name not in self._dbs:
+                    raise NotFoundError(
+                        f"database {db_name} is not attached")
+            with self._journal.create_context() as ctx:
+                ctx.append(EntryType.DETACH_DB, {"db": db_name})
 
     def sync_database(self, db_name: str) -> int:
-        """Re-snapshot the UDB; returns the table count."""
-        with self._lock:
-            db = self._dbs.get(db_name)
-            if db is None:
-                raise NotFoundError(f"database {db_name} is not attached")
-            udb_type, connection = db["type"], db["connection"]
-        udb = udb_factory(udb_type, self._file_system(), connection,
-                          db_name)
-        tables = [udb.get_table(t) for t in udb.table_names()]
-        with self._journal.create_context() as ctx:
-            for t in tables:
-                ctx.append(EntryType.ADD_TABLE,
-                           {"db": db_name, "table": t.to_wire()})
+        """Re-snapshot the UDB; returns the table count. Tables dropped
+        from the UDB are journaled as removals so the catalog converges
+        (reference: AlluxioCatalog sync removes stale tables too)."""
+        with self._mutate_lock:
+            with self._lock:
+                db = self._dbs.get(db_name)
+                if db is None:
+                    raise NotFoundError(
+                        f"database {db_name} is not attached")
+                udb_type, connection = db["type"], db["connection"]
+                known = set(db["tables"])
+            udb = udb_factory(udb_type, self._file_system(), connection,
+                              db_name)
+            tables = [udb.get_table(t) for t in udb.table_names()]
+            dropped = known - {t.name for t in tables}
+            with self._journal.create_context() as ctx:
+                for t in tables:
+                    ctx.append(EntryType.ADD_TABLE,
+                               {"db": db_name, "table": t.to_wire()})
+                for name in sorted(dropped):
+                    ctx.append(EntryType.REMOVE_TABLE,
+                               {"db": db_name, "table_name": name})
         return len(tables)
 
     def list_databases(self) -> List[str]:
@@ -137,37 +153,68 @@ class TableMaster(Journaled):
         return job_id
 
     def transform_status(self, job_id: int) -> Dict[str, Any]:
+        """Read-only status report. Layout commit happens on the master's
+        transform-monitor heartbeat (``heartbeat()``), matching the
+        reference's TransformManager.java:82 — a client polling status
+        must not be the thing that commits."""
         with self._lock:
             info = self._transforms.get(job_id)
+            if info is not None:
+                info = dict(info)
         if info is None:
             raise NotFoundError(f"no transform with job id {job_id}")
+        if info.get("applied"):
+            return {**info, "status": "COMPLETED", "error": ""}
+        if self._job_factory is None:
+            return {**info, "status": "UNKNOWN",
+                    "error": "no job service configured"}
         status = self._job_factory().get_status(job_id)
-        out = {**info, "status": status.status,
-               "error": status.error_message}
-        if status.status == "COMPLETED" and not info.get("applied"):
-            self._apply_transform(info, status)
-            out["applied"] = True
-        return out
+        return {**info, "status": status.status,
+                "error": status.error_message}
+
+    def heartbeat(self) -> None:
+        """Transform-monitor tick: poll running transform jobs; commit the
+        layout of completed ones (reference: TransformManager.java:82 —
+        the manager monitors via heartbeat, journaling the commit)."""
+        if self._job_factory is None:
+            return
+        with self._lock:
+            pending = [dict(v) for v in self._transforms.values()
+                       if not v.get("applied")]
+        for info in pending:
+            try:
+                status = self._job_factory().get_status(info["job_id"])
+            except Exception:  # noqa: BLE001 job master unreachable: retry
+                continue
+            if status.status == "COMPLETED":
+                self._apply_transform(info, status)
 
     def _apply_transform(self, info: Dict[str, Any], status: dict) -> None:
-        """Commit the transformed layout: journaled partition re-point."""
-        table = self.get_table(info["db"], info["table"])
-        new_parts = []
-        for part in table["partitions"]:
-            spec = part["spec"]
-            new_loc = f"{info['output_root']}/{spec}" if spec \
-                else info["output_root"]
-            fs = self._file_system()
-            if fs.exists(new_loc):
-                new_parts.append({**part, "location": new_loc})
-            else:  # transform produced nothing for this partition
-                new_parts.append(part)
-        table["partitions"] = new_parts
-        with self._journal.create_context() as ctx:
-            ctx.append(EntryType.ADD_TABLE,
-                       {"db": info["db"], "table": table})
-            ctx.append(EntryType.REMOVE_TRANSFORM_JOB_INFO,
-                       {"job_id": info["job_id"], "applied": True})
+        """Commit the transformed layout: journaled partition re-point.
+        Idempotent — _mutate_lock + an applied re-check make concurrent
+        heartbeat ticks / failover replays commit exactly once."""
+        with self._mutate_lock:
+            with self._lock:
+                live = self._transforms.get(info["job_id"])
+                if live is None or live.get("applied"):
+                    return
+            table = self.get_table(info["db"], info["table"])
+            new_parts = []
+            for part in table["partitions"]:
+                spec = part["spec"]
+                new_loc = f"{info['output_root']}/{spec}" if spec \
+                    else info["output_root"]
+                fs = self._file_system()
+                if fs.exists(new_loc):
+                    new_parts.append({**part, "location": new_loc})
+                else:  # transform produced nothing for this partition
+                    new_parts.append(part)
+            table["partitions"] = new_parts
+            with self._journal.create_context() as ctx:
+                ctx.append(EntryType.ADD_TABLE,
+                           {"db": info["db"], "table": table})
+                ctx.append(EntryType.REMOVE_TRANSFORM_JOB_INFO,
+                           {"job_id": info["job_id"], "applied": True})
 
     # -- journal contract ----------------------------------------------------
     def process_entry(self, entry: JournalEntry) -> bool:
@@ -187,6 +234,12 @@ class TableMaster(Journaled):
                 db = self._dbs.get(p["db"])
                 if db is not None:
                     db["tables"][p["table"]["name"]] = p["table"]
+            return True
+        if t == EntryType.REMOVE_TABLE:
+            with self._lock:
+                db = self._dbs.get(p["db"])
+                if db is not None:
+                    db["tables"].pop(p["table_name"], None)
             return True
         if t == EntryType.ADD_TRANSFORM_JOB_INFO:
             with self._lock:

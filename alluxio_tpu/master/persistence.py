@@ -1,0 +1,72 @@
+"""Async-persist scheduling: drain requests into job-service persist jobs.
+
+Re-design of the PersistenceScheduler/PersistenceChecker heartbeats in
+``core/server/master/src/main/java/alluxio/master/file/
+DefaultFileSystemMaster.java:3810,4001``: files completed with
+ASYNC_THROUGH land in the FSM's persist-request queue; each tick this
+scheduler submits a ``persist`` plan per request, then tracks outstanding
+jobs — failed jobs are re-queued (bounded retries), completed ones are
+dropped (the plan itself marks the inode persisted).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Tuple
+
+LOG = logging.getLogger(__name__)
+
+
+class PersistenceScheduler:
+    MAX_ATTEMPTS = 3
+
+    def __init__(self, fs_master, job_client) -> None:
+        self._fsm = fs_master
+        self._jobs = job_client
+        #: job_id -> (path, attempt)
+        self._inflight: Dict[int, Tuple[str, int]] = {}
+        #: path -> attempt count for requeues
+        self._attempts: Dict[str, int] = {}
+
+    def heartbeat(self) -> None:
+        self._check_inflight()
+        self._submit_new()
+
+    def _submit_new(self) -> None:
+        for _inode_id, path in self._fsm.pop_persist_requests().items():
+            attempt = self._attempts.get(path, 0) + 1
+            try:
+                job_id = self._jobs.run({"type": "persist", "path": path})
+            except Exception:  # noqa: BLE001 job master down: requeue
+                LOG.debug("persist submit failed for %s", path,
+                          exc_info=True)
+                self._fsm.schedule_async_persistence(path)
+                continue
+            self._inflight[job_id] = (path, attempt)
+            self._attempts[path] = attempt
+
+    def _check_inflight(self) -> None:
+        for job_id in list(self._inflight):
+            path, attempt = self._inflight[job_id]
+            try:
+                info = self._jobs.get_status(job_id)
+            except Exception:  # noqa: BLE001 transient: retry next tick
+                continue
+            if info.status == "COMPLETED":
+                del self._inflight[job_id]
+                self._attempts.pop(path, None)
+            elif info.status in ("FAILED", "CANCELED"):
+                del self._inflight[job_id]
+                if attempt < self.MAX_ATTEMPTS:
+                    LOG.warning("persist of %s failed (attempt %d): %s — "
+                                "requeueing", path, attempt,
+                                info.error_message)
+                    self._fsm.schedule_async_persistence(path)
+                else:
+                    LOG.error("persist of %s failed after %d attempts: %s",
+                              path, attempt, info.error_message)
+                    self._attempts.pop(path, None)
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)

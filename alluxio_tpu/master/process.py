@@ -219,6 +219,25 @@ class MasterProcess:
         t.start()
         self._threads.append(t)
 
+    def attach_persistence_scheduler(self, job_client,
+                                     interval_s: Optional[float] = None
+                                     ) -> "PersistenceScheduler":
+        """Start the async-persist scheduling loop once a job service
+        exists (reference: the PersistenceScheduler heartbeat,
+        ``DefaultFileSystemMaster.java:3810`` — attaches late here for the
+        same reason as the replication checker)."""
+        from alluxio_tpu.heartbeat import HeartbeatContext as HC
+        from alluxio_tpu.master.persistence import PersistenceScheduler
+
+        scheduler = PersistenceScheduler(self.fs_master, job_client)
+        t = HeartbeatThread(
+            HC.MASTER_PERSISTENCE_SCHEDULER, _Exec(scheduler.heartbeat),
+            interval_s if interval_s is not None else
+            self._conf.get_duration_s(Keys.MASTER_REPLICATION_CHECK_INTERVAL))
+        t.start()
+        self._threads.append(t)
+        return scheduler
+
     def stop(self) -> None:
         for t in self._threads:
             t.stop()

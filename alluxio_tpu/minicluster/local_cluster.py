@@ -133,6 +133,8 @@ class LocalCluster:
             self.job_workers.append(jw)
         self.master.attach_replication_checker(self.job_client(),
                                                interval_s=0.1)
+        self.master.attach_persistence_scheduler(self.job_client(),
+                                                 interval_s=0.1)
 
     def stop(self) -> None:
         for jw in self.job_workers:

@@ -22,6 +22,7 @@ Commands:
   fsadmin    administration shell (report/doctor/journal/...)
   job        job service shell (ls/stat/cancel)
   table      table/catalog shell (attachdb/ls/sync/transform)
+  stress     stress benchmark suite (worker/master/prefetch/table/write)
   format     format master journal / worker storage
   master     run a master process
   worker     run a worker process
@@ -109,6 +110,10 @@ def main(argv=None) -> int:
         from alluxio_tpu.shell.table_shell import TABLE_SHELL
 
         return TABLE_SHELL.run(rest, ctx)
+    if cmd == "stress":
+        from alluxio_tpu.stress.__main__ import main as stress_main
+
+        return stress_main(rest)
     if cmd == "format":
         from alluxio_tpu.shell.format import main as format_main
 

@@ -1,0 +1,12 @@
+"""Stress benchmark suite.
+
+Re-design of the reference ``stress/`` module
+(``stress/shell/src/main/java/alluxio/stress/cli/*``): each bench drives
+one BASELINE.md config against an in-process LocalCluster (default) or a
+live cluster (``--master``), and emits exactly one JSON result line on
+stdout — the ``IOTaskSummary``/``MasterBenchSummary`` analogue.
+"""
+
+from alluxio_tpu.stress.base import BenchResult, drive, percentiles
+
+__all__ = ["BenchResult", "drive", "percentiles"]

@@ -1,0 +1,169 @@
+"""Stress CLI: ``python -m alluxio_tpu.stress <bench> [options]``.
+
+Reference: ``stress/shell/src/main/java/alluxio/stress/cli/*`` — each
+bench prints exactly ONE JSON summary line on stdout (diagnostics on
+stderr), so drivers can pipe results.
+
+Benches:
+  worker       worker read throughput (--mode sequential|random) [#1/#2]
+  master       master metadata op/s (--op CreateFile|GetStatus|...)
+  maxthroughput  binary-search max sustainable master op/s
+  prefetch     distributed load across N workers [#3]
+  table        Parquet column-projection via the catalog [#4]
+  write        async write-through under eviction pressure [#5]
+  suite        run the whole BASELINE config family
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--master", default=None,
+                   help="host:port of a live cluster (default: in-process)")
+    p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--duration", type=float, default=5.0,
+                   metavar="SECONDS")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="alluxio-tpu stress")
+    sub = ap.add_subparsers(dest="bench", required=True)
+
+    w = sub.add_parser("worker", help="worker read bench (configs #1/#2)")
+    _add_common(w)
+    w.add_argument("--mode", choices=("sequential", "random"),
+                   default="random")
+    w.add_argument("--shard-mb", type=int, default=64)
+    w.add_argument("--num-shards", type=int, default=4)
+    w.add_argument("--read-bytes", type=int, default=4096)
+
+    m = sub.add_parser("master", help="master metadata op/s")
+    _add_common(m)
+    from alluxio_tpu.stress.master_bench import OPS
+
+    m.add_argument("--op", choices=OPS, default="CreateFile")
+    m.add_argument("--fixed-count", type=int, default=200)
+    m.add_argument("--target-ops", type=float, default=0.0)
+
+    x = sub.add_parser("maxthroughput",
+                       help="binary-search max sustainable master op/s")
+    _add_common(x)
+    x.add_argument("--op", choices=OPS, default="CreateFile")
+    x.add_argument("--fixed-count", type=int, default=200)
+
+    p = sub.add_parser("prefetch", help="distributed load (config #3)")
+    p.add_argument("--num-workers", type=int, default=4)
+    p.add_argument("--num-files", type=int, default=8)
+    p.add_argument("--file-mb", type=int, default=16)
+    p.add_argument("--replication", type=int, default=1)
+
+    t = sub.add_parser("table", help="column projection (config #4)")
+    t.add_argument("--master", default=None)
+    t.add_argument("--partitions", type=int, default=4)
+    t.add_argument("--rows", type=int, default=40_000)
+
+    wr = sub.add_parser("write", help="write-through eviction (config #5)")
+    wr.add_argument("--threads", type=int, default=4)
+    wr.add_argument("--num-files", type=int, default=24)
+    wr.add_argument("--file-mb", type=int, default=8)
+    wr.add_argument("--mem-mb", type=int, default=64)
+
+    sub.add_parser("suite", help="run the whole BASELINE config family")
+    return ap
+
+
+def run_suite() -> list:
+    """The five BASELINE configs + a master-op sample, sized to finish in
+    a few minutes in-process. Returns the list of BenchResults."""
+    from alluxio_tpu.stress import (
+        master_bench, prefetch_bench, table_bench, worker_bench,
+        write_bench,
+    )
+
+    results = []
+    for name, fn in (
+        ("worker-sequential", lambda: worker_bench.run(
+            mode="sequential", threads=4, duration_s=5.0)),
+        ("worker-random-4k", lambda: worker_bench.run(
+            mode="random", threads=8, duration_s=5.0)),
+        ("master-CreateFile", lambda: master_bench.run(
+            op="CreateFile", threads=8, duration_s=5.0)),
+        ("master-GetStatus", lambda: master_bench.run(
+            op="GetStatus", threads=8, duration_s=5.0)),
+        ("master-ListStatus", lambda: master_bench.run(
+            op="ListStatus", threads=8, duration_s=5.0, fixed_count=100)),
+        ("master-DeleteFile", lambda: master_bench.run(
+            op="DeleteFile", threads=8, duration_s=5.0, fixed_count=2000)),
+        ("prefetch", lambda: prefetch_bench.run(
+            num_workers=4, num_files=8, file_bytes=16 << 20)),
+        ("table-projection", lambda: table_bench.run()),
+        ("write-eviction", lambda: write_bench.run()),
+    ):
+        print(f"[suite] running {name} ...", file=sys.stderr, flush=True)
+        try:
+            r = fn()
+        except Exception as e:  # noqa: BLE001 — record and continue
+            from alluxio_tpu.stress.base import BenchResult
+
+            r = BenchResult(bench=name, params={}, metrics={},
+                            errors=1, duration_s=0.0)
+            r.metrics["error"] = f"{type(e).__name__}: {e}"
+            print(f"[suite] {name} FAILED: {e}", file=sys.stderr)
+        print(r.json_line(), flush=True)
+        results.append(r)
+    return results
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.bench == "worker":
+        from alluxio_tpu.stress.worker_bench import run
+
+        r = run(mode=args.mode, master=args.master, threads=args.threads,
+                duration_s=args.duration, shard_bytes=args.shard_mb << 20,
+                num_shards=args.num_shards, read_bytes=args.read_bytes)
+    elif args.bench == "master":
+        from alluxio_tpu.stress.master_bench import run
+
+        r = run(op=args.op, master=args.master, threads=args.threads,
+                duration_s=args.duration, fixed_count=args.fixed_count,
+                target_ops_per_s=args.target_ops)
+    elif args.bench == "maxthroughput":
+        from alluxio_tpu.stress.master_bench import run_max_throughput
+
+        r = run_max_throughput(op=args.op, master=args.master,
+                               threads=args.threads,
+                               duration_s=args.duration,
+                               fixed_count=args.fixed_count)
+    elif args.bench == "prefetch":
+        from alluxio_tpu.stress.prefetch_bench import run
+
+        r = run(num_workers=args.num_workers, num_files=args.num_files,
+                file_bytes=args.file_mb << 20,
+                replication=args.replication)
+    elif args.bench == "table":
+        from alluxio_tpu.stress.table_bench import run
+
+        r = run(master=args.master, partitions=args.partitions,
+                rows_per_partition=args.rows)
+    elif args.bench == "write":
+        from alluxio_tpu.stress.write_bench import run
+
+        r = run(threads=args.threads, num_files=args.num_files,
+                file_bytes=args.file_mb << 20,
+                mem_bytes=args.mem_mb << 20)
+    elif args.bench == "suite":
+        results = run_suite()
+        return 0 if all(x.errors == 0 for x in results) else 1
+    else:  # pragma: no cover — argparse guards
+        return 2
+    print(r.json_line(), flush=True)
+    return 0 if r.errors == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

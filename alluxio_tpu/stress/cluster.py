@@ -1,0 +1,54 @@
+"""Cluster context for stress benches: in-process LocalCluster (default,
+the reference's ``--in-process`` smoke mode, ``BaseParameters.java:81``)
+or a live cluster via ``--master host:port`` (``--cluster`` mode)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+from typing import Dict, Iterator, Optional, Tuple
+
+
+@contextlib.contextmanager
+def bench_cluster(master: Optional[str] = None, *, num_workers: int = 1,
+                  block_size: int = 32 << 20,
+                  worker_mem_bytes: int = 1 << 30,
+                  conf_overrides: Optional[Dict] = None,
+                  start_job_service: bool = False,
+                  start_worker_heartbeats: bool = False,
+                  ) -> Iterator[Tuple[object, object]]:
+    """Yields ``(fs, cluster_or_None)``. With ``master`` set, attaches a
+    FileSystem client to the live cluster; otherwise stands up a scratch
+    LocalCluster on /dev/shm (tears it down afterwards)."""
+    if master:
+        from alluxio_tpu.client.file_system import FileSystem
+        from alluxio_tpu.conf import Configuration
+
+        fs = FileSystem(master, conf=Configuration(load_env=False))
+        try:
+            yield fs, None
+        finally:
+            fs.close()
+        return
+    base = tempfile.mkdtemp(
+        prefix="atpu_stress_",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    try:
+        from alluxio_tpu.minicluster import LocalCluster
+
+        with LocalCluster(base, num_workers=num_workers,
+                          block_size=block_size,
+                          worker_mem_bytes=worker_mem_bytes,
+                          conf_overrides=conf_overrides,
+                          start_job_service=start_job_service,
+                          start_worker_heartbeats=start_worker_heartbeats
+                          ) as cluster:
+            fs = cluster.file_system()
+            try:
+                yield fs, cluster
+            finally:
+                fs.close()
+    finally:
+        shutil.rmtree(base, ignore_errors=True)

@@ -1,0 +1,86 @@
+"""BASELINE config #3: distributed prefetch (DistributedLoad) GB/s.
+
+Reference analogue: the job-service DistributedLoad path
+(``job/server/src/main/java/alluxio/job/plan/load/LoadDefinition.java:65``)
+— files persisted in the UFS but not cached are fanned out across N
+workers' caches by load-plan tasks; the metric is aggregate prefetch
+GB/s from job submission to every block landing in a worker tier.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from alluxio_tpu.stress.base import BenchResult
+from alluxio_tpu.stress.cluster import bench_cluster
+
+
+def run(*, master: Optional[str] = None, num_workers: int = 4,
+        num_files: int = 8, file_bytes: int = 16 << 20,
+        replication: int = 1, block_size: int = 4 << 20,
+        base_path: str = "/stress-prefetch") -> BenchResult:
+    from alluxio_tpu.client.streams import WriteType
+
+    if master:
+        raise NotImplementedError(
+            "prefetch bench provisions its own multi-worker cluster")
+    from alluxio_tpu.conf import Keys
+
+    rng = np.random.default_rng(0)
+    total = num_files * file_bytes
+    with bench_cluster(None, num_workers=num_workers,
+                       block_size=block_size,
+                       worker_mem_bytes=total + (128 << 20),
+                       start_job_service=True,
+                       start_worker_heartbeats=True,
+                       conf_overrides={
+                           Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL: "50ms",
+                       }) as (fs, cluster):
+        # THROUGH: persisted to the UFS, cached nowhere — the cold corpus
+        payload = rng.integers(0, 255, size=file_bytes, dtype=np.uint8
+                               ).tobytes()
+        for i in range(num_files):
+            fs.write_all(f"{base_path}/f-{i:05d}", payload,
+                         write_type=WriteType.THROUGH)
+        # THROUGH frees the cached copy asynchronously (worker heartbeat
+        # applies the Free command): wait until the corpus is truly cold
+        deadline = time.monotonic() + 60.0
+        bc = cluster.block_client()
+        for i in range(num_files):
+            for fbi in fs.fs_master.get_file_block_info_list(
+                    f"{base_path}/f-{i:05d}"):
+                while bc.get_block_info(fbi.block_info.block_id).locations:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("corpus never went cold")
+                    time.sleep(0.02)
+        job_client = cluster.job_client()
+        t0 = time.monotonic()
+        job_id = job_client.run({"type": "load", "path": base_path,
+                                 "replication": replication})
+        info = job_client.wait_for_job(job_id, timeout_s=300.0)
+        wall = time.monotonic() - t0
+        if info.status != "COMPLETED":
+            raise RuntimeError(
+                f"load job {job_id} ended {info.status}: "
+                f"{info.error_message}")
+        # verify every block is cached with the requested replication
+        blocks = cached = 0
+        for i in range(num_files):
+            for fbi in fs.fs_master.get_file_block_info_list(
+                    f"{base_path}/f-{i:05d}"):
+                blocks += 1
+                if len(fbi.block_info.locations) >= replication:
+                    cached += 1
+        moved = total * replication
+        return BenchResult(
+            bench="distributed-prefetch",
+            params={"num_workers": num_workers, "num_files": num_files,
+                    "file_bytes": file_bytes, "replication": replication,
+                    "block_size": block_size},
+            metrics={"gb_per_s": round(moved / wall / 1e9, 3),
+                     "mb_per_s": round(moved / wall / 1e6, 2),
+                     "blocks": blocks, "blocks_at_replication": cached},
+            errors=blocks - cached, duration_s=wall)

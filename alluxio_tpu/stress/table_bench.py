@@ -1,0 +1,102 @@
+"""BASELINE config #4: Parquet column-projection read through the
+table service (TPC-DS-style wide fact table).
+
+Reference analogue: Presto projecting columns through the catalog +
+caching data plane (``table/server/master/.../AlluxioCatalog.java:55``;
+``LocalCacheFileInStream`` page reads). The bench writes a partitioned
+Hive-layout Parquet table into the warm cache, attaches it as an ``fs``
+under-database, and measures a k-of-N column projection via
+``table.reader.read_partition_columns`` — reporting projection GB/s and
+the byte selectivity vs a full scan.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from typing import Optional
+
+import numpy as np
+
+from alluxio_tpu.stress.base import BenchResult
+from alluxio_tpu.stress.cluster import bench_cluster
+
+# store_sales-flavored wide schema: 20 numeric + 3 string columns
+_N_NUM = 20
+_PROJECT = ["ss_sold_date_sk", "ss_quantity", "ss_net_paid"]
+
+
+def _make_parquet(rng: np.random.Generator, rows: int) -> bytes:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    cols = {}
+    names = [f"ss_col_{i}" for i in range(_N_NUM - 3)] + _PROJECT
+    for name in names:
+        cols[name] = rng.integers(0, 1 << 30, size=rows, dtype=np.int64)
+    for name in ("ss_item_desc", "ss_store_name", "ss_promo"):
+        base = rng.integers(0, 26, size=rows, dtype=np.uint8) + 65
+        cols[name] = [chr(b) * 24 for b in base]
+    table = pa.table(cols)
+    buf = io.BytesIO()
+    pq.write_table(table, buf, compression="none", row_group_size=8192)
+    return buf.getvalue()
+
+
+def run(*, master: Optional[str] = None, partitions: int = 4,
+        rows_per_partition: int = 40_000, repeats: int = 3,
+        base_path: str = "/stress-table") -> BenchResult:
+    from alluxio_tpu.client.streams import WriteType
+    from alluxio_tpu.table.reader import read_partition_columns
+
+    rng = np.random.default_rng(0)
+    with bench_cluster(master, block_size=32 << 20,
+                       worker_mem_bytes=1 << 30) as (fs, cluster):
+        total_file_bytes = 0
+        for p in range(partitions):
+            data = _make_parquet(rng, rows_per_partition)
+            total_file_bytes += len(data)
+            fs.write_all(
+                f"{base_path}/db/store_sales/ss_date={2020 + p}/part-0.parquet",
+                data, write_type=WriteType.MUST_CACHE)
+
+        if cluster is not None:
+            table_master = cluster.master.table_master
+            db = table_master.attach_database("fs", f"{base_path}/db")
+            table_wire = table_master.get_table(db, "store_sales")
+        else:
+            from alluxio_tpu.rpc.table_service import TableMasterClient
+
+            client = TableMasterClient(master)
+            db = client.attach_database("fs", f"{base_path}/db")
+            table_wire = client.get_table(db, "store_sales")
+
+        # warm the footers + projected column chunks
+        proj = read_partition_columns(fs, table_wire, columns=_PROJECT)
+        proj_bytes = proj.nbytes
+
+        t0 = time.monotonic()
+        for _ in range(repeats):
+            proj = read_partition_columns(fs, table_wire, columns=_PROJECT)
+        proj_wall = (time.monotonic() - t0) / repeats
+
+        t0 = time.monotonic()
+        full = read_partition_columns(fs, table_wire, columns=None)
+        full_wall = time.monotonic() - t0
+        rows = full.num_rows
+
+        return BenchResult(
+            bench="table-column-projection",
+            params={"partitions": partitions,
+                    "rows_per_partition": rows_per_partition,
+                    "columns_projected": len(_PROJECT),
+                    "columns_total": len(table_wire["schema"]),
+                    "master": master or "in-process"},
+            metrics={
+                "projection_mb_per_s": round(proj_bytes / proj_wall / 1e6, 2),
+                "full_scan_mb_per_s": round(full.nbytes / full_wall / 1e6, 2),
+                "projection_speedup": round(full_wall / proj_wall, 2),
+                "byte_selectivity": round(proj_bytes / full.nbytes, 4),
+                "rows": rows, "file_bytes": total_file_bytes},
+            errors=0 if rows == partitions * rows_per_partition else 1,
+            duration_s=proj_wall * repeats + full_wall)

@@ -1,0 +1,84 @@
+"""BASELINE config #5: async write-through under cache-eviction pressure.
+
+Reference analogue: ``TieredBlockStore`` eviction-on-allocation with the
+LRFU annotator (``worker/block/TieredBlockStore.java:85``,
+``annotator/LRFUAnnotator.java:29``). The bench writes an ASYNC_THROUGH
+corpus several times larger than the MEM tier of a MEM+SSD worker, so
+allocation continuously demotes cold blocks down-tier while the
+persistence scheduler drains writes to the UFS in the background. Metrics:
+ingest MB/s (client-visible write rate under pressure), time-to-durable
+(all files persisted), and where the blocks ended up.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from alluxio_tpu.stress.base import BenchResult, drive, percentiles
+from alluxio_tpu.stress.cluster import bench_cluster
+
+
+def run(*, master: Optional[str] = None, threads: int = 4,
+        num_files: int = 24, file_bytes: int = 8 << 20,
+        mem_bytes: int = 64 << 20, block_size: int = 4 << 20,
+        persist_timeout_s: float = 120.0,
+        base_path: str = "/stress-write") -> BenchResult:
+    from alluxio_tpu.client.streams import WriteType
+    from alluxio_tpu.conf import Keys, Templates
+
+    if master:
+        raise NotImplementedError(
+            "write bench provisions its own tiered cluster")
+    rng = np.random.default_rng(0)
+    total = num_files * file_bytes
+    overrides = {
+        Keys.WORKER_TIERED_STORE_LEVELS: 2,
+        Keys.WORKER_ANNOTATOR_CLASS: "LRFU",
+        # SSD tier big enough for everything MEM spills
+        Templates.WORKER_TIER_DIRS_QUOTA.format(1): str(total + (64 << 20)),
+    }
+    with bench_cluster(None, num_workers=1, block_size=block_size,
+                       worker_mem_bytes=mem_bytes,
+                       conf_overrides=overrides,
+                       start_job_service=True) as (fs, cluster):
+        payload = rng.integers(0, 255, size=file_bytes, dtype=np.uint8
+                               ).tobytes()
+        files_per_thread = num_files // threads
+
+        def op(t: int, i: int) -> int:
+            fs.write_all(f"{base_path}/t{t}/f-{i:05d}", payload,
+                         write_type=WriteType.ASYNC_THROUGH)
+            return file_bytes
+
+        res = drive(threads, op, ops_per_thread=files_per_thread)
+
+        # durability: wait for the persistence scheduler to drain
+        t0 = time.monotonic()
+        deadline = t0 + persist_timeout_s
+        pending = {f"{base_path}/t{t}/f-{i:05d}"
+                   for t in range(threads) for i in range(files_per_thread)}
+        while pending and time.monotonic() < deadline:
+            pending = {p for p in pending if not fs.get_status(p).persisted}
+            if pending:
+                time.sleep(0.1)
+        persist_wall = time.monotonic() - t0
+
+        # tier occupancy after the dust settles
+        store = cluster.workers[0].worker.store
+        tier_usage = {t.alias: t.used_bytes for t in store.meta.tiers}
+
+        return BenchResult(
+            bench="write-through-eviction",
+            params={"threads": threads, "num_files": num_files,
+                    "file_bytes": file_bytes, "mem_bytes": mem_bytes,
+                    "block_size": block_size, "annotator": "LRFU",
+                    "pressure_x": round(total / mem_bytes, 1)},
+            metrics={"ingest_mb_per_s": round(res.mb_per_s, 2),
+                     "time_to_durable_s": round(persist_wall, 2),
+                     "unpersisted": len(pending),
+                     "tier_used_bytes": tier_usage,
+                     **percentiles(res.latencies_s)},
+            errors=res.errors + len(pending), duration_s=res.wall_s)

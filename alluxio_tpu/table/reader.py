@@ -25,6 +25,7 @@ class _SizedStream:
     def __init__(self, stream, size: int) -> None:
         self._s = stream
         self._size = size
+        self._closed = False
 
     def read(self, n: int = -1) -> bytes:
         return self._s.read(n)
@@ -52,10 +53,12 @@ class _SizedStream:
     def writable(self) -> bool:
         return False
 
-    def closed(self) -> bool:  # pyarrow probes attribute-style too
-        return False
+    @property
+    def closed(self) -> bool:  # pyarrow probes this attribute-style
+        return self._closed
 
     def close(self) -> None:
+        self._closed = True
         self._s.close()
 
     def flush(self) -> None:

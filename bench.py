@@ -276,5 +276,30 @@ def _bench_e2e(jax, jnp, fs, device, rng) -> None:
     loader.close()
 
 
+def suite() -> None:
+    """``bench.py --suite``: run the whole BASELINE config family
+    (stress suite) and persist the per-config JSON lines to
+    BENCH_SUITE.json; stdout gets ONE summary line."""
+    from alluxio_tpu.stress.__main__ import run_suite
+
+    results = run_suite()
+    out = [json.loads(r.json_line()) for r in results]
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_SUITE.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    ok = sum(1 for r in results if r.errors == 0)
+    print(json.dumps({
+        "metric": "stress-suite configs passing (BASELINE #1-#5 + "
+                  "master op/s)",
+        "value": ok,
+        "unit": f"of {len(results)} benches",
+        "vs_baseline": round(ok / len(results), 3),
+    }), flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if "--suite" in sys.argv:
+        suite()
+    else:
+        main()

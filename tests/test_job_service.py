@@ -139,6 +139,25 @@ class TestPersist:
         assert info.status == Status.COMPLETED, info.error_message
         assert fs.get_status("/p").persisted
 
+    def test_async_through_persists_via_scheduler(self, cluster):
+        """ASYNC_THROUGH completes without any explicit persist call: the
+        master's PersistenceScheduler heartbeat drains the request into a
+        job-service persist plan (reference: the PersistenceScheduler
+        heartbeat, DefaultFileSystemMaster.java:3810)."""
+        import time
+
+        fs = cluster.file_system()
+        fs.write_all("/ap", b"async" * 5000, write_type="ASYNC_THROUGH")
+        deadline = time.monotonic() + 30.0
+        while not fs.get_status("/ap").persisted:
+            assert time.monotonic() < deadline, \
+                "ASYNC_THROUGH never persisted"
+            time.sleep(0.05)
+        st = fs.get_status("/ap")
+        assert st.persisted
+        # the cached copy stays (ASYNC_THROUGH keeps cache + UFS copy)
+        assert fs.read_all("/ap") == b"async" * 5000
+
 
 class TestReplicate:
     def test_replicate_block(self, cluster):

@@ -1,0 +1,149 @@
+"""Stress-suite smoke tests (reference: the benches' ``--in-process``
+smoke mode, ``stress/common/.../BaseParameters.java:81`` — every bench
+must run end-to-end at toy scale and emit a sane summary)."""
+
+import json
+
+import pytest
+
+from alluxio_tpu.stress.base import (
+    BenchResult, RateLimiter, drive, percentiles,
+)
+
+
+class TestBase:
+    def test_percentiles_empty(self):
+        assert percentiles([])["p50_us"] == 0.0
+
+    def test_percentiles_ordering(self):
+        p = percentiles([0.001 * i for i in range(1, 101)])
+        assert p["p50_us"] <= p["p95_us"] <= p["p99_us"] <= p["max_us"]
+        assert p["max_us"] == pytest.approx(100_000, rel=0.01)
+
+    def test_result_json_line(self):
+        r = BenchResult(bench="x", params={"a": 1},
+                        metrics={"ops_per_s": 5.0}, duration_s=1.0)
+        parsed = json.loads(r.json_line())
+        assert parsed["bench"] == "x"
+        assert parsed["metrics"]["ops_per_s"] == 5.0
+
+    def test_drive_counts_ops_and_bytes(self):
+        res = drive(4, lambda t, i: 10, ops_per_thread=25)
+        assert res.ops == 100
+        assert res.bytes == 1000
+        assert res.errors == 0
+        assert len(res.latencies_s) == 100
+
+    def test_drive_counts_errors(self):
+        def op(t, i):
+            if i % 2:
+                raise RuntimeError("boom")
+            return 1
+
+        res = drive(2, op, ops_per_thread=10)
+        assert res.ops == 10
+        assert res.errors == 10
+
+    def test_rate_limiter_caps_throughput(self):
+        import time
+
+        limiter = RateLimiter(200.0)
+        t0 = time.monotonic()
+        res = drive(4, lambda t, i: 0, duration_s=0.5,
+                    rate_limiter=limiter)
+        wall = time.monotonic() - t0
+        # 200 ops/s over ~0.5s -> ~100 ops (+1 initial token per refill)
+        assert res.ops <= 200.0 * wall * 1.5 + 4
+
+
+class TestWorkerBench:
+    def test_random_4k(self):
+        from alluxio_tpu.stress.worker_bench import run
+
+        r = run(mode="random", threads=2, duration_s=0.5,
+                shard_bytes=2 << 20, num_shards=2)
+        assert r.errors == 0
+        assert r.metrics["ops_per_s"] > 0
+        assert r.metrics["mb_per_s"] > 0
+        assert json.loads(r.json_line())["bench"] == "worker-random"
+
+    def test_sequential(self):
+        from alluxio_tpu.stress.worker_bench import run
+
+        r = run(mode="sequential", threads=2, duration_s=0.5,
+                shard_bytes=8 << 20, num_shards=2)
+        assert r.errors == 0
+        assert r.metrics["mb_per_s"] > 0
+
+    def test_tfrecord_shard_framing(self):
+        import struct
+        import numpy as np
+
+        from alluxio_tpu.stress.worker_bench import make_tfrecord_shard
+
+        shard = make_tfrecord_shard(np.random.default_rng(0), 1 << 20,
+                                    record_bytes=1024)
+        length = struct.unpack_from("<Q", shard, 0)[0]
+        assert length == 1024
+
+
+class TestMasterBench:
+    @pytest.mark.parametrize("op", ["CreateFile", "GetStatus",
+                                    "ListStatus", "DeleteFile",
+                                    "RenameFile"])
+    def test_ops(self, op):
+        from alluxio_tpu.stress.master_bench import run
+
+        r = run(op=op, threads=2, duration_s=0.4, fixed_count=20)
+        assert r.errors == 0, r.json_line()
+        assert r.metrics["ops_per_s"] > 0
+
+
+class TestPrefetchBench:
+    def test_prefetch_moves_cold_corpus(self):
+        from alluxio_tpu.stress.prefetch_bench import run
+
+        r = run(num_workers=2, num_files=2, file_bytes=2 << 20,
+                block_size=1 << 20)
+        assert r.errors == 0, r.json_line()
+        assert r.metrics["blocks"] == 4
+        assert r.metrics["blocks_at_replication"] == 4
+        # cold->warm actually moved bytes (not a no-op pass)
+        assert r.duration_s > 0.01
+
+
+class TestTableBench:
+    def test_projection(self):
+        from alluxio_tpu.stress.table_bench import run
+
+        r = run(partitions=2, rows_per_partition=2000, repeats=1)
+        assert r.errors == 0, r.json_line()
+        assert r.metrics["rows"] == 4000
+        assert 0 < r.metrics["byte_selectivity"] < 0.6
+        assert r.metrics["projection_mb_per_s"] > 0
+
+
+class TestWriteBench:
+    def test_eviction_pressure_and_durability(self):
+        from alluxio_tpu.stress.write_bench import run
+
+        r = run(threads=2, num_files=6, file_bytes=2 << 20,
+                mem_bytes=4 << 20, block_size=1 << 20)
+        assert r.errors == 0, r.json_line()
+        assert r.metrics["unpersisted"] == 0
+        used = r.metrics["tier_used_bytes"]
+        # pressure actually spilled down-tier
+        assert used.get("SSD", 0) > 0
+
+
+class TestCli:
+    def test_cli_worker_json_line(self, capsys):
+        from alluxio_tpu.stress.__main__ import main
+
+        rc = main(["worker", "--mode", "random", "--threads", "1",
+                   "--duration", "0.3", "--shard-mb", "2",
+                   "--num-shards", "1"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
+        assert json.loads(out[0])["bench"] == "worker-random"

@@ -232,13 +232,24 @@ class FileSystem:
                 raise UnavailableError(
                     f"no single worker holds all cached blocks of {path}")
             target = addr_by_key[sorted(candidates)[0]]
-        fingerprint = ""
-        if target is not None:
-            worker = self.store.worker_client(target)
-            fingerprint = worker.persist_file(
-                info.ufs_path, [fbi.block_info.block_id for fbi in fbis],
-                info.mount_id)
-        self.fs_master.mark_persisted(info.path, ufs_fingerprint=fingerprint)
+        if target is None:
+            # zero-block file: nothing to stream; mark directly
+            self.fs_master.mark_persisted(info.path)
+            self._invalidate(path)
+            return ""
+        # persist to a TEMP UFS path; the master promotes it under the
+        # tree lock (commit_persist), so a concurrent delete can never
+        # leave a zombie UFS file for metadata sync to resurrect
+        # (reference: temp persist paths + UfsCleaner for abandoned ones)
+        import uuid
+
+        d, _, name = info.ufs_path.rpartition("/")
+        temp_ufs = f"{d}/.atpu_persist.{name}.{uuid.uuid4().hex[:8]}"
+        worker = self.store.worker_client(target)
+        worker.persist_file(
+            temp_ufs, [fbi.block_info.block_id for fbi in fbis],
+            info.mount_id)
+        fingerprint = self.fs_master.commit_persist(info.path, temp_ufs)
         self._invalidate(path)
         return fingerprint
 

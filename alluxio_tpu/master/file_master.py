@@ -15,6 +15,7 @@ only state mutator (see ``inode_tree.py`` rationale).
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Dict, List, Optional, Set
@@ -36,13 +37,15 @@ from alluxio_tpu.utils.clock import Clock, SystemClock
 from alluxio_tpu.utils.exceptions import (
     DirectoryNotEmptyError, FileAlreadyCompletedError, FileAlreadyExistsError,
     FileDoesNotExistError, FileIncompleteError, InvalidArgumentError,
-    InvalidPathError, PermissionDeniedError,
+    InvalidPathError, PermissionDeniedError, UnavailableError,
 )
 from alluxio_tpu.utils.fingerprint import Fingerprint
 from alluxio_tpu.utils.uri import AlluxioURI
 from alluxio_tpu.utils.wire import (
     BlockInfo, FileBlockInfo, FileInfo, MountPointInfo,
 )
+
+LOG = logging.getLogger(__name__)
 
 ROOT_MOUNT_ID = 1
 _DEVICE_TIERS = ("HBM", "MEM")
@@ -894,6 +897,46 @@ class FileSystemMaster:
             with self._journal.create_context() as ctx:
                 ctx.append(EntryType.PERSIST_FILE, {
                     "id": inode.id, "ufs_fingerprint": ufs_fingerprint})
+
+    def commit_persist(self, path: "str | AlluxioURI",
+                       temp_ufs_path: str) -> str:
+        """Atomically promote a temp UFS persist file written by a worker.
+
+        The async-persist race (reference solves it the same way —
+        persists go to a temporary UFS path and a master-side commit
+        renames into place, ``DefaultFileSystemMaster`` persist jobs +
+        ``UfsCleaner`` for abandoned temps): a worker finishing a persist
+        AFTER the file was deleted must not leave a zombie UFS file that
+        metadata sync would resurrect. Commit happens under the tree
+        write lock — the same lock ``delete`` holds — so either the
+        inode is alive and the rename lands, or the temp is discarded.
+        Returns the serialized UFS fingerprint of the final file."""
+        uri = AlluxioURI(path)
+        with self.inode_tree.lock.write_locked():
+            try:
+                inode = self._existing_file(uri)
+            except FileDoesNotExistError:
+                # deleted while the worker was writing: discard the temp
+                try:
+                    resolution = self.mount_table.resolve(uri)
+                    self._ufs.get(resolution.mount_id).delete_file(
+                        temp_ufs_path)
+                except Exception:  # noqa: BLE001 UfsCleaner sweeps later
+                    LOG.debug("temp persist cleanup failed for %s",
+                              temp_ufs_path, exc_info=True)
+                raise
+            resolution = self.mount_table.resolve(uri)
+            ufs = self._ufs.get(resolution.mount_id)
+            if not ufs.rename_file(temp_ufs_path, resolution.ufs_path):
+                raise UnavailableError(
+                    f"rename {temp_ufs_path} -> {resolution.ufs_path} "
+                    "failed in the UFS")
+            fp = ufs.get_fingerprint(resolution.ufs_path)
+            fingerprint = fp.serialize() if fp is not None else ""
+            with self._journal.create_context() as ctx:
+                ctx.append(EntryType.PERSIST_FILE, {
+                    "id": inode.id, "ufs_fingerprint": fingerprint})
+            return fingerprint
 
     def file_system_heartbeat(self, worker_id: int,
                               persisted_files: List[int]) -> None:

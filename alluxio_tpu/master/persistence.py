@@ -40,7 +40,7 @@ class PersistenceScheduler:
             except Exception:  # noqa: BLE001 job master down: requeue
                 LOG.debug("persist submit failed for %s", path,
                           exc_info=True)
-                self._fsm.schedule_async_persistence(path)
+                self._requeue(path)
                 continue
             self._inflight[job_id] = (path, attempt)
             self._attempts[path] = attempt
@@ -61,11 +61,17 @@ class PersistenceScheduler:
                     LOG.warning("persist of %s failed (attempt %d): %s — "
                                 "requeueing", path, attempt,
                                 info.error_message)
-                    self._fsm.schedule_async_persistence(path)
+                    self._requeue(path)
                 else:
                     LOG.error("persist of %s failed after %d attempts: %s",
                               path, attempt, info.error_message)
                     self._attempts.pop(path, None)
+
+    def _requeue(self, path: str) -> None:
+        try:
+            self._fsm.schedule_async_persistence(path)
+        except Exception:  # noqa: BLE001 deleted file / closing journal
+            LOG.debug("requeue of %s dropped", path, exc_info=True)
 
     @property
     def inflight_count(self) -> int:

@@ -126,6 +126,10 @@ class LocalCluster:
         jconf.set(Keys.JOB_WORKER_HEARTBEAT_INTERVAL, "50ms")
         self.job_master = JobMasterProcess(jconf, self.master.address)
         self.job_master.start()
+        # the metadata master's table service reaches the job master via
+        # its conf; propagate the ephemeral port it actually bound
+        self.conf.set(Keys.JOB_MASTER_RPC_PORT,
+                      int(self.job_master.address.rsplit(":", 1)[1]))
         for i in range(len(self.workers)):
             jw = make_job_worker(jconf, self.job_master.address,
                                  self.master.address, f"localhost-w{i}")

@@ -165,6 +165,11 @@ class FsMasterClient(_BaseClient):
         self._call("mark_persisted", {"path": str(path),
                                       "ufs_fingerprint": ufs_fingerprint})
 
+    def commit_persist(self, path: str, temp_ufs_path: str) -> str:
+        return self._call("commit_persist", {
+            "path": str(path),
+            "temp_ufs_path": temp_ufs_path})["fingerprint"]
+
     def file_system_heartbeat(self, worker_id: int,
                               persisted_files: List[int]) -> None:
         self._call("file_system_heartbeat", {

@@ -143,6 +143,8 @@ def fs_master_service(fsm: FileSystemMaster,
         fsm.mark_persisted(r["path"],
                            ufs_fingerprint=r.get("ufs_fingerprint", "")),
         {})[-1])
+    u("commit_persist", lambda r: {"fingerprint": fsm.commit_persist(
+        r["path"], r["temp_ufs_path"])})
     u("file_system_heartbeat", lambda r: (
         fsm.file_system_heartbeat(r["worker_id"],
                                   r.get("persisted_files", [])), {})[-1])

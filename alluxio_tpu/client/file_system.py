@@ -233,13 +233,17 @@ class FileSystem:
                     f"no single worker holds all cached blocks of {path}")
             target = addr_by_key[sorted(candidates)[0]]
         if target is None:
-            # zero-block file: nothing to stream; mark directly
-            self.fs_master.mark_persisted(info.path)
+            # zero-block file: master creates the empty UFS object, then
+            # marks persisted (a PERSISTED inode with no UFS object would
+            # be deleted by the next metadata sync)
+            fingerprint = self.fs_master.commit_persist(
+                info.path, "", expected_id=info.file_id)
             self._invalidate(path)
-            return ""
-        # persist to a TEMP UFS path; the master promotes it under the
-        # tree lock (commit_persist), so a concurrent delete can never
-        # leave a zombie UFS file for metadata sync to resurrect
+            return fingerprint
+        # persist to a TEMP UFS path; the master promotes it
+        # (commit_persist) only while the SAME inode is still live, so a
+        # concurrent delete or delete+recreate can never leave a zombie
+        # or stale UFS file for metadata sync to resurrect
         # (reference: temp persist paths + UfsCleaner for abandoned ones)
         import uuid
 
@@ -249,7 +253,8 @@ class FileSystem:
         worker.persist_file(
             temp_ufs, [fbi.block_info.block_id for fbi in fbis],
             info.mount_id)
-        fingerprint = self.fs_master.commit_persist(info.path, temp_ufs)
+        fingerprint = self.fs_master.commit_persist(
+            info.path, temp_ufs, expected_id=info.file_id)
         self._invalidate(path)
         return fingerprint
 

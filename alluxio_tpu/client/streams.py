@@ -263,16 +263,26 @@ class FileOutStream:
     def _persist_sync(self) -> None:
         """Synchronous persist via the worker holding the cached blocks
         (reference: CACHE_THROUGH's UfsFileWriteHandler path; here the
-        worker-side persist executor writes the UFS file in one shot)."""
+        worker-side persist executor writes the UFS file in one shot).
+        Uses the same temp-path + master-commit protocol as async persist
+        so a concurrent delete can never leave a zombie UFS file."""
         st = self._fs.get_status(self.info.path)
         if not st.ufs_path:
             return
         worker = self._store.last_write_worker
         if worker is None:
             return
-        fingerprint = worker.persist_file(st.ufs_path, self._block_ids,
-                                          st.mount_id)
-        self._fs.mark_persisted(self.info.path, ufs_fingerprint=fingerprint)
+        if not self._block_ids:  # zero-byte file
+            self._fs.commit_persist(self.info.path, "",
+                                    expected_id=st.file_id)
+            return
+        import uuid
+
+        d, _, name = st.ufs_path.rpartition("/")
+        temp_ufs = f"{d}/.atpu_persist.{name}.{uuid.uuid4().hex[:8]}"
+        worker.persist_file(temp_ufs, self._block_ids, st.mount_id)
+        self._fs.commit_persist(self.info.path, temp_ufs,
+                                expected_id=st.file_id)
 
     def __enter__(self):
         return self

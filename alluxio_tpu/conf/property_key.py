@@ -375,6 +375,27 @@ class Keys:
     MASTER_REPLICATION_CHECK_INTERVAL = _k(
         "atpu.master.replication.check.interval", KeyType.DURATION, default="1min",
         scope=Scope.MASTER)
+    MASTER_LOST_FILES_DETECTION_INTERVAL = _k(
+        "atpu.master.lost.files.detection.interval", KeyType.DURATION,
+        default="5min", scope=Scope.MASTER,
+        description="How often the master scans lost blocks for files "
+                    "with no recoverable copy (reference: "
+                    "LostFileDetector.java).")
+    MASTER_BLOCK_INTEGRITY_CHECK_INTERVAL = _k(
+        "atpu.master.block.integrity.check.interval", KeyType.DURATION,
+        default="1h", scope=Scope.MASTER,
+        description="How often the master frees blocks whose owning file "
+                    "is gone (reference: BlockIntegrityChecker.java).")
+    MASTER_UFS_CLEANUP_INTERVAL = _k(
+        "atpu.master.ufs.cleanup.interval", KeyType.DURATION,
+        default="1h", scope=Scope.MASTER,
+        description="How often mounted UFSes are swept for abandoned "
+                    "persist temp files (reference: UfsCleaner.java).")
+    MASTER_PERSISTENCE_TEMP_TTL = _k(
+        "atpu.master.persistence.temp.ttl", KeyType.DURATION,
+        default="1h", scope=Scope.MASTER,
+        description="Age after which an .atpu_persist.* temp file is "
+                    "considered abandoned.")
     TABLE_TRANSFORM_MONITOR_INTERVAL = _k(
         "atpu.table.transform.manager.job.monitor.interval", KeyType.DURATION,
         default="10s", scope=Scope.MASTER,

@@ -343,6 +343,16 @@ class BlockMaster(Journaled):
         with self._lock:
             return self._workers.get(worker_id)
 
+    def all_block_ids(self) -> List[int]:
+        """Snapshot of every block id in the master map (integrity scan)."""
+        with self._lock:
+            return list(self._blocks)
+
+    def has_locations(self, block_id: int) -> bool:
+        """True when at least one live worker holds the block."""
+        with self._lock:
+            return bool(self._locations.get(block_id))
+
     def lost_blocks(self) -> Set[int]:
         with self._lock:
             return set(self._lost_blocks)

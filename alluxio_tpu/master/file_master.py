@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Set
 
@@ -79,6 +80,8 @@ class FileSystemMaster:
         journal.register(_MountTableJournal(self.mount_table))
         #: paths with in-flight async persist (file id -> alluxio path)
         self._persist_requests: Dict[int, str] = {}
+        # serializes persist commits' UFS IO (see commit_persist)
+        self._persist_mutex = threading.Lock()
         from alluxio_tpu.master.sync import AbsentPathCache, UfsSyncPathCache
 
         #: last-sync bookkeeping (reference: UfsSyncPathCache)
@@ -310,6 +313,7 @@ class FileSystemMaster:
         uri = AlluxioURI(path)
         if uri.is_root():
             raise InvalidPathError("cannot create root")
+        self._check_reserved_name(uri)
         block_size = block_size_bytes or self._default_block_size
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)
@@ -356,6 +360,7 @@ class FileSystemMaster:
         uri = AlluxioURI(path)
         if uri.is_root():
             raise InvalidPathError("cannot create root")
+        self._check_reserved_name(uri)
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)
             if lookup.exists:
@@ -510,6 +515,16 @@ class FileSystemMaster:
             if not alluxio_only and persisted_paths:
                 self._delete_in_ufs(uri, persisted_paths)
 
+    def _check_reserved_name(self, uri: AlluxioURI) -> None:
+        """Framework temp prefixes are reserved: a user file named like
+        one would be hidden from metadata sync and swept from the UFS by
+        the UfsCleaner after the TTL — silent data loss."""
+        from alluxio_tpu.master.integrity import is_infra_temp
+
+        if is_infra_temp(uri.name):
+            raise InvalidPathError(
+                f"{uri.name!r} uses a reserved framework temp prefix")
+
     def _check_ufs_writable(self, uri: AlluxioURI) -> None:
         try:
             resolution = self.mount_table.resolve(uri)
@@ -540,6 +555,7 @@ class FileSystemMaster:
             raise InvalidPathError("cannot rename to/from root")
         if src_uri.is_ancestor_of(dst_uri):
             raise InvalidPathError(f"cannot rename {src_uri} under itself")
+        self._check_reserved_name(dst_uri)
         with self.inode_tree.lock.write_locked():
             src_lookup = self.inode_tree.lookup(src_uri)
             inode = src_lookup.inode
@@ -899,7 +915,8 @@ class FileSystemMaster:
                     "id": inode.id, "ufs_fingerprint": ufs_fingerprint})
 
     def commit_persist(self, path: "str | AlluxioURI",
-                       temp_ufs_path: str) -> str:
+                       temp_ufs_path: str, *,
+                       expected_id: int = 0) -> str:
         """Atomically promote a temp UFS persist file written by a worker.
 
         The async-persist race (reference solves it the same way —
@@ -907,36 +924,84 @@ class FileSystemMaster:
         renames into place, ``DefaultFileSystemMaster`` persist jobs +
         ``UfsCleaner`` for abandoned temps): a worker finishing a persist
         AFTER the file was deleted must not leave a zombie UFS file that
-        metadata sync would resurrect. Commit happens under the tree
-        write lock — the same lock ``delete`` holds — so either the
-        inode is alive and the rename lands, or the temp is discarded.
-        Returns the serialized UFS fingerprint of the final file."""
+        metadata sync would resurrect.
+
+        ``expected_id`` pins the commit to the inode the persist was
+        scheduled for: a delete+recreate at the same path must NOT get the
+        old file's bytes renamed over its data. ``temp_ufs_path=""`` means
+        a zero-block file — the final UFS file is created empty (without
+        it, a later metadata sync would see a PERSISTED inode with no UFS
+        object and remove the file).
+
+        Three phases so the slow UFS rename doesn't stall the whole
+        namespace behind the tree write lock: (1) validate under the
+        lock, (2) rename with the tree lock RELEASED, (3) re-validate
+        under the lock and journal — if the inode vanished or changed
+        during (2), the just-renamed file is deleted, never journaled.
+        A master-wide persist mutex serializes phase 2 across commits:
+        without it, a commit for a RECREATED inode at the same path could
+        land inside another commit's rename window and have its freshly
+        committed UFS file overwritten/cleaned by the stale one. Every
+        persist path (async, sync CACHE_THROUGH, zero-block) flows
+        through this method, so the mutex covers all final-file writes."""
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.write_locked():
-            try:
-                inode = self._existing_file(uri)
-            except FileDoesNotExistError:
-                # deleted while the worker was writing: discard the temp
+
+        def _validated_inode():
+            inode = self._existing_file(uri)
+            if expected_id and inode.id != expected_id:
+                raise FileDoesNotExistError(
+                    f"{uri} was recreated (inode {inode.id} != persist "
+                    f"target {expected_id})")
+            return inode
+
+        with self._persist_mutex:
+            with self.inode_tree.lock.write_locked():
                 try:
-                    resolution = self.mount_table.resolve(uri)
-                    self._ufs.get(resolution.mount_id).delete_file(
-                        temp_ufs_path)
-                except Exception:  # noqa: BLE001 UfsCleaner sweeps later
-                    LOG.debug("temp persist cleanup failed for %s",
-                              temp_ufs_path, exc_info=True)
-                raise
-            resolution = self.mount_table.resolve(uri)
+                    inode = _validated_inode()
+                except (FileDoesNotExistError, InvalidPathError):
+                    self._discard_temp(uri, temp_ufs_path)
+                    raise
+                resolution = self.mount_table.resolve(uri)
             ufs = self._ufs.get(resolution.mount_id)
-            if not ufs.rename_file(temp_ufs_path, resolution.ufs_path):
-                raise UnavailableError(
-                    f"rename {temp_ufs_path} -> {resolution.ufs_path} "
-                    "failed in the UFS")
+            # phase 2: UFS IO outside the tree lock (can be a
+            # multi-second server-side copy on object stores)
+            if temp_ufs_path:
+                if not ufs.rename_file(temp_ufs_path, resolution.ufs_path):
+                    raise UnavailableError(
+                        f"rename {temp_ufs_path} -> {resolution.ufs_path} "
+                        "failed in the UFS")
+            else:  # zero-block file: create the empty UFS object
+                ufs.create(resolution.ufs_path).close()
             fp = ufs.get_fingerprint(resolution.ufs_path)
             fingerprint = fp.serialize() if fp is not None else ""
-            with self._journal.create_context() as ctx:
-                ctx.append(EntryType.PERSIST_FILE, {
-                    "id": inode.id, "ufs_fingerprint": fingerprint})
-            return fingerprint
+            with self.inode_tree.lock.write_locked():
+                try:
+                    inode = _validated_inode()
+                except (FileDoesNotExistError, InvalidPathError):
+                    # deleted/recreated during the rename: the delete's
+                    # own UFS cleanup has already swept the directory —
+                    # remove the file if it survived (no other persist
+                    # can have committed here: we hold the mutex)
+                    try:
+                        ufs.delete_file(resolution.ufs_path)
+                    except Exception:  # noqa: BLE001 best-effort
+                        LOG.debug("post-rename cleanup failed for %s",
+                                  resolution.ufs_path, exc_info=True)
+                    raise
+                with self._journal.create_context() as ctx:
+                    ctx.append(EntryType.PERSIST_FILE, {
+                        "id": inode.id, "ufs_fingerprint": fingerprint})
+                return fingerprint
+
+    def _discard_temp(self, uri: AlluxioURI, temp_ufs_path: str) -> None:
+        if not temp_ufs_path:
+            return
+        try:
+            resolution = self.mount_table.resolve(uri)
+            self._ufs.get(resolution.mount_id).delete_file(temp_ufs_path)
+        except Exception:  # noqa: BLE001 UfsCleaner sweeps later
+            LOG.debug("temp persist cleanup failed for %s",
+                      temp_ufs_path, exc_info=True)
 
     def file_system_heartbeat(self, worker_id: int,
                               persisted_files: List[int]) -> None:
@@ -1043,7 +1108,13 @@ class FileSystemMaster:
         listing = ufs.list_status(resolution.ufs_path)
         if listing is None:
             return False
-        ufs_names = {st.name: st for st in listing}
+        from alluxio_tpu.master.integrity import is_infra_temp
+
+        # in-flight/abandoned framework temps (persist temps, atomic-
+        # create temps) are infrastructure, not data: loading one would
+        # surface it as a file and break when the rename removes it
+        ufs_names = {st.name: st for st in listing
+                     if not is_infra_temp(st.name)}
         changed = False
         with self.inode_tree.lock.read_locked():
             lookup = self.inode_tree.lookup(uri)
@@ -1078,6 +1149,10 @@ class FileSystemMaster:
         """Create inodes mirroring an existing UFS path (metadata load on
         access — reference: ``InodeSyncStream`` loadMetadata). A caller
         that already holds the UFS status passes it to skip the probe."""
+        from alluxio_tpu.master.integrity import is_infra_temp
+
+        if is_infra_temp(uri.name):
+            return None  # framework temps never enter the namespace
         if status is None and self._absent_cache.is_absent(uri.path):
             return None
         try:

@@ -48,6 +48,10 @@ class Inode:
     ttl: int = NO_TTL
     ttl_action: str = TtlAction.DELETE
     persistence_state: str = PersistenceState.NOT_PERSISTED
+    #: an ASYNC_THROUGH persist was pending when the file went LOST;
+    #: recovery must restore TO_BE_PERSISTED, not drop the durability
+    #: request (journaled via SET_ATTRIBUTE so it replays)
+    lost_pending_persist: bool = False
     ufs_fingerprint: str = ""
     xattr: Dict[str, str] = field(default_factory=dict)
 

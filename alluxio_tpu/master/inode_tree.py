@@ -75,6 +75,10 @@ class InodeTree(Journaled):
         self.ttl_buckets = TtlBucketList()
         self.pinned_ids: Set[int] = set()
         self.to_be_persisted_ids: Set[int] = set()
+        #: files currently marked PersistenceState.LOST — rebuilt on
+        #: replay/restore so the LostFileDetector can recover them
+        #: after a master restart
+        self.lost_file_ids: Set[int] = set()
         #: files with replication_min>0 or replication_max>=0; the
         #: ReplicationChecker walks only these (reference: the pinned/
         #: replication-limited inode registries in InodeTreePersistentState)
@@ -228,6 +232,7 @@ class InodeTree(Journaled):
         self._inode_count -= 1
         self.pinned_ids.discard(inode.id)
         self.to_be_persisted_ids.discard(inode.id)
+        self.lost_file_ids.discard(inode.id)
         self.replication_limited_ids.discard(inode.id)
         if inode.ttl >= 0:
             self.ttl_buckets.remove(inode.id)
@@ -272,7 +277,8 @@ class InodeTree(Journaled):
                     inode.id, p.get("op_time_ms", inode.creation_time_ms),
                     inode.ttl)
         for k in ("owner", "group", "mode", "replication_min",
-                  "replication_max", "persistence_state"):
+                  "replication_max", "persistence_state",
+                  "lost_pending_persist"):
             if p.get(k) is not None:
                 setattr(inode, k, p[k])
         self._track_replication(inode)
@@ -280,6 +286,10 @@ class InodeTree(Journaled):
             self.to_be_persisted_ids.add(inode.id)
         elif p.get("persistence_state") is not None:
             self.to_be_persisted_ids.discard(inode.id)
+        if p.get("persistence_state") == PersistenceState.LOST:
+            self.lost_file_ids.add(inode.id)
+        elif p.get("persistence_state") is not None:
+            self.lost_file_ids.discard(inode.id)
         if p.get("xattr") is not None:
             inode.xattr.update(p["xattr"])
         if p.get("op_time_ms"):
@@ -293,6 +303,7 @@ class InodeTree(Journaled):
         inode.persistence_state = PersistenceState.PERSISTED
         inode.ufs_fingerprint = p.get("ufs_fingerprint", inode.ufs_fingerprint)
         self.to_be_persisted_ids.discard(inode.id)
+        self.lost_file_ids.discard(inode.id)
         self._store.put(inode)
 
     def _track_replication(self, inode: Inode) -> None:
@@ -319,6 +330,7 @@ class InodeTree(Journaled):
         self.ttl_buckets.clear()
         self.pinned_ids.clear()
         self.to_be_persisted_ids.clear()
+        self.lost_file_ids.clear()
         self.replication_limited_ids.clear()
         self._inode_count = 0
         self._root_id = snap.get("root_id")
@@ -335,6 +347,8 @@ class InodeTree(Journaled):
                 self.pinned_ids.add(inode.id)
             if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
                 self.to_be_persisted_ids.add(inode.id)
+            if inode.persistence_state == PersistenceState.LOST:
+                self.lost_file_ids.add(inode.id)
             self._track_replication(inode)
 
     def _empty_snapshot(self) -> dict:

@@ -110,6 +110,17 @@ class MasterProcess:
         self.table_master = TableMaster(self.journal,
                                         fs_factory=_table_fs_factory,
                                         job_client_factory=_table_job_factory)
+        from alluxio_tpu.master.integrity import (
+            BlockIntegrityChecker, LostFileDetector, UfsCleaner,
+        )
+
+        self.lost_file_detector = LostFileDetector(self.fs_master,
+                                                   self.block_master)
+        self.block_integrity_checker = BlockIntegrityChecker(
+            self.fs_master, self.block_master)
+        self.ufs_cleaner = UfsCleaner(
+            self.fs_master.mount_table, self.fs_master._ufs,
+            ttl_ms=conf.get_ms(Keys.MASTER_PERSISTENCE_TEMP_TTL))
         self.config_checker = ConfigurationChecker()
         self.config_checker.register(
             "master", {k: str(v) for k, v in conf.to_map().items()})
@@ -197,6 +208,20 @@ class MasterProcess:
                 HeartbeatContext.MASTER_TABLE_TRANSFORM_MONITOR,
                 _Exec(self.table_master.heartbeat),
                 conf.get_duration_s(Keys.TABLE_TRANSFORM_MONITOR_INTERVAL)),
+            HeartbeatThread(
+                HeartbeatContext.MASTER_LOST_FILES_DETECTION,
+                _Exec(self.lost_file_detector.heartbeat),
+                conf.get_duration_s(
+                    Keys.MASTER_LOST_FILES_DETECTION_INTERVAL)),
+            HeartbeatThread(
+                HeartbeatContext.MASTER_BLOCK_INTEGRITY_CHECK,
+                _Exec(self.block_integrity_checker.heartbeat),
+                conf.get_duration_s(
+                    Keys.MASTER_BLOCK_INTEGRITY_CHECK_INTERVAL)),
+            HeartbeatThread(
+                HeartbeatContext.MASTER_UFS_CLEANUP,
+                _Exec(self.ufs_cleaner.heartbeat),
+                conf.get_duration_s(Keys.MASTER_UFS_CLEANUP_INTERVAL)),
         ]
         for t in self._threads:
             t.start()

@@ -165,10 +165,11 @@ class FsMasterClient(_BaseClient):
         self._call("mark_persisted", {"path": str(path),
                                       "ufs_fingerprint": ufs_fingerprint})
 
-    def commit_persist(self, path: str, temp_ufs_path: str) -> str:
+    def commit_persist(self, path: str, temp_ufs_path: str,
+                       expected_id: int = 0) -> str:
         return self._call("commit_persist", {
-            "path": str(path),
-            "temp_ufs_path": temp_ufs_path})["fingerprint"]
+            "path": str(path), "temp_ufs_path": temp_ufs_path,
+            "expected_id": expected_id})["fingerprint"]
 
     def file_system_heartbeat(self, worker_id: int,
                               persisted_files: List[int]) -> None:

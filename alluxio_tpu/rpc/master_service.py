@@ -144,7 +144,8 @@ def fs_master_service(fsm: FileSystemMaster,
                            ufs_fingerprint=r.get("ufs_fingerprint", "")),
         {})[-1])
     u("commit_persist", lambda r: {"fingerprint": fsm.commit_persist(
-        r["path"], r["temp_ufs_path"])})
+        r["path"], r["temp_ufs_path"],
+        expected_id=r.get("expected_id", 0))})
     u("file_system_heartbeat", lambda r: (
         fsm.file_system_heartbeat(r["worker_id"],
                                   r.get("persisted_files", [])), {})[-1])

@@ -1,0 +1,223 @@
+"""Integrity daemon tests (reference: ``core/server/master/.../file/
+{LostFileDetector,BlockIntegrityChecker,UfsCleaner}.java`` test
+strategy): inject the anomaly, tick the daemon, observe repair."""
+
+import os
+import time
+
+import pytest
+
+from alluxio_tpu.master.inode import PersistenceState
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+from alluxio_tpu.utils import ids
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1) as c:
+        yield c
+
+
+class TestLostFileDetector:
+    def test_mark_lost_and_recover(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/precious", b"x" * 1000, write_type="MUST_CACHE")
+        detector = cluster.master.lost_file_detector
+        bm = cluster.master.block_master
+        fsm = cluster.master.fs_master
+
+        # anomaly: the only worker holding the blocks dies
+        wid = cluster.workers[0].worker.worker_id
+        bm.forget_worker(wid)
+        assert bm.lost_blocks(), "blocks should be lost with the worker"
+
+        detector.heartbeat()
+        st = fsm.get_status("/precious")
+        assert st.persistence_state == PersistenceState.LOST
+
+        # repair: the worker re-registers with its block list intact
+        cluster.workers[0].worker._master_sync.register_with_master()
+        assert not bm.lost_blocks()
+        detector.heartbeat()
+        st = fsm.get_status("/precious")
+        assert st.persistence_state == PersistenceState.NOT_PERSISTED
+
+    def test_persisted_file_never_marked_lost(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/durable", b"y" * 1000, write_type="CACHE_THROUGH")
+        bm = cluster.master.block_master
+        bm.forget_worker(cluster.workers[0].worker.worker_id)
+        cluster.master.lost_file_detector.heartbeat()
+        st = cluster.master.fs_master.get_status("/durable")
+        # UFS copy exists: re-fetchable, not lost
+        assert st.persistence_state == PersistenceState.PERSISTED
+
+    def test_lost_file_survives_journal_replay(self, cluster, tmp_path):
+        """The LOST mark is journaled: a restarted master still knows."""
+        fs = cluster.file_system()
+        fs.write_all("/gone", b"z" * 100, write_type="MUST_CACHE")
+        cluster.master.block_master.forget_worker(
+            cluster.workers[0].worker.worker_id)
+        cluster.master.lost_file_detector.heartbeat()
+        cluster.master.stop()
+        from alluxio_tpu.master.process import MasterProcess
+
+        m2 = MasterProcess(cluster.conf,
+                           root_ufs_uri=str(tmp_path / "underFSStorage"))
+        m2.start()
+        cluster.master = m2
+        st = m2.fs_master.get_status("/gone")
+        assert st.persistence_state == PersistenceState.LOST
+        # the LOST registry replays too — recovery works after restart
+        assert m2.fs_master.inode_tree.lost_file_ids
+        # no worker holds the blocks yet: a tick must NOT recover it
+        m2.lost_file_detector.heartbeat()
+        st = m2.fs_master.get_status("/gone")
+        assert st.persistence_state == PersistenceState.LOST
+
+
+class TestBlockIntegrityChecker:
+    def test_orphan_block_freed(self, cluster):
+        bm = cluster.master.block_master
+        checker = cluster.master.block_integrity_checker
+        # anomaly: a block exists in the master map with no owning inode
+        orphan = ids.block_id(123456, 0)
+        bm.commit_block_in_ufs(orphan, 4096)
+        assert orphan in bm.all_block_ids()
+
+        checker.heartbeat()
+        assert orphan not in bm.all_block_ids()
+
+    def test_live_blocks_untouched(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/alive", b"a" * 1000, write_type="MUST_CACHE")
+        bm = cluster.master.block_master
+        before = set(bm.all_block_ids())
+        cluster.master.block_integrity_checker.heartbeat()
+        assert set(bm.all_block_ids()) == before
+        assert fs.read_all("/alive") == b"a" * 1000
+
+
+class TestPersistCommitRaces:
+    def test_delete_recreate_refuses_stale_commit(self, cluster, tmp_path):
+        """A persist scheduled for inode A must not commit over a
+        recreated file at the same path (inode B)."""
+        from alluxio_tpu.utils.exceptions import FileDoesNotExistError
+
+        fs = cluster.file_system()
+        fs.write_all("/f", b"OLD" * 100, write_type="MUST_CACHE")
+        fsm = cluster.master.fs_master
+        old = fs.get_status("/f")
+        # worker "finished" writing the temp for inode A
+        ufs_root = tmp_path / "underFSStorage"
+        temp = ufs_root / ".atpu_persist.f.12345678"
+        temp.write_bytes(b"OLD" * 100)
+        # delete + recreate at the same path
+        fs.delete("/f")
+        fs.write_all("/f", b"NEW" * 100, write_type="MUST_CACHE")
+        with pytest.raises(FileDoesNotExistError):
+            fsm.commit_persist("/f", str(temp), expected_id=old.file_id)
+        assert not temp.exists(), "stale temp must be discarded"
+        assert fs.read_all("/f") == b"NEW" * 100
+        assert not fs.get_status("/f").persisted
+
+    def test_zero_block_persist_creates_ufs_object(self, cluster,
+                                                   tmp_path):
+        """Empty-file persist must create the UFS object; a PERSISTED
+        inode with no UFS object would be swept by metadata sync."""
+        fs = cluster.file_system()
+        fs.write_all("/empty", b"", write_type="MUST_CACHE")
+        fs.persist_now("/empty")
+        st = fs.get_status("/empty")
+        assert st.persisted
+        assert (tmp_path / "underFSStorage" / "empty").exists()
+
+    def test_metadata_sync_ignores_persist_temps(self, cluster, tmp_path):
+        """In-flight persist temps are infrastructure, not namespace
+        content: sync must not load them."""
+        ufs_root = tmp_path / "underFSStorage"
+        (ufs_root / "real.bin").write_bytes(b"data")
+        (ufs_root / ".atpu_persist.x.deadbeef").write_bytes(b"tmp")
+        fsm = cluster.master.fs_master
+        names = {i.name for i in fsm.list_status("/", sync_interval_ms=0)}
+        assert "real.bin" in names
+        assert ".atpu_persist.x.deadbeef" not in names
+
+
+class TestReviewRegressions:
+    def test_reserved_temp_prefixes_rejected_at_create(self, cluster):
+        from alluxio_tpu.utils.exceptions import InvalidPathError
+
+        fs = cluster.file_system()
+        for bad in ("/.atpu_persist.ckpt.1234", "/d/.atpu_tmp_x"):
+            with pytest.raises(InvalidPathError):
+                fs.write_all(bad, b"x", write_type="MUST_CACHE")
+        fs.write_all("/ok", b"x", write_type="MUST_CACHE")
+        with pytest.raises(InvalidPathError):
+            fs.rename("/ok", "/.atpu_persist.sneaky.0000")
+
+    def test_cache_through_delete_race_leaves_no_zombie(self, cluster,
+                                                        tmp_path):
+        """The sync CACHE_THROUGH path uses the same temp+commit
+        protocol: after any outcome there is either a namespace file
+        with a UFS object, or neither — never a UFS-only zombie."""
+        fs = cluster.file_system()
+        fs.write_all("/sync", b"s" * 100, write_type="CACHE_THROUGH")
+        st = fs.get_status("/sync")
+        assert st.persisted
+        ufs_root = tmp_path / "underFSStorage"
+        assert (ufs_root / "sync").exists()
+        # no temp residue
+        assert not [p for p in ufs_root.iterdir()
+                    if p.name.startswith(".atpu_persist.")]
+
+    def test_lost_recovery_restores_pending_persist(self, cluster):
+        """A file LOST while TO_BE_PERSISTED recovers to TO_BE_PERSISTED
+        and re-enters the persist queue (ASYNC_THROUGH contract)."""
+        fs = cluster.file_system()
+        fs.write_all("/pending", b"p" * 200, write_type="ASYNC_THROUGH")
+        fsm = cluster.master.fs_master
+        bm = cluster.master.block_master
+        # ensure the persist request is pending, not yet run (no job
+        # service in this fixture, so it stays queued)
+        assert fs.get_status("/pending").persistence_state == \
+            PersistenceState.TO_BE_PERSISTED
+        detector = cluster.master.lost_file_detector
+        bm.forget_worker(cluster.workers[0].worker.worker_id)
+        detector.heartbeat()
+        assert fs.get_status("/pending").persistence_state == \
+            PersistenceState.LOST
+        fsm.pop_persist_requests()  # drop any queued-before-loss request
+        cluster.workers[0].worker._master_sync.register_with_master()
+        detector.heartbeat()
+        assert fs.get_status("/pending").persistence_state == \
+            PersistenceState.TO_BE_PERSISTED
+        assert "/pending" in fsm.pop_persist_requests().values()
+
+
+class TestUfsCleaner:
+    def test_sweeps_stale_temps_keeps_fresh(self, cluster, tmp_path):
+        ufs_root = tmp_path / "underFSStorage"
+        stale = ufs_root / ".atpu_persist.f.deadbeef"
+        fresh = ufs_root / ".atpu_persist.g.cafecafe"
+        normal = ufs_root / "normal.bin"
+        for p in (stale, fresh, normal):
+            p.write_bytes(b"tmp")
+        old = time.time() - 7200
+        os.utime(stale, (old, old))
+
+        removed = cluster.master.ufs_cleaner.heartbeat()
+        assert removed == 1
+        assert not stale.exists()
+        assert fresh.exists()
+        assert normal.exists()
+
+    def test_sweep_recurses_into_directories(self, cluster, tmp_path):
+        nested = tmp_path / "underFSStorage" / "a" / "b"
+        nested.mkdir(parents=True)
+        t = nested / ".atpu_persist.x.00000000"
+        t.write_bytes(b"tmp")
+        old = time.time() - 7200
+        os.utime(t, (old, old))
+        assert cluster.master.ufs_cleaner.heartbeat() == 1
+        assert not t.exists()

@@ -59,6 +59,11 @@ class BlockStoreClient:
         #: failed-worker memory, :94-95)
         self._failed_workers: Dict[str, float] = {}
 
+    @property
+    def block_master(self):
+        """The block-master client (public: placement reporting etc.)."""
+        return self._bm
+
     # -- worker client cache -------------------------------------------------
     def worker_client(self, address: WorkerNetAddress) -> WorkerClient:
         key = f"{address.host}:{address.data_port or address.rpc_port}"

@@ -21,6 +21,7 @@ part: "prefetch collectives must overlap compute").
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from typing import Iterator, List, Optional, Sequence
 
@@ -51,12 +52,20 @@ class DeviceBlockLoader:
         self._m = metrics()
         #: flat list of (path, block_index, page_id)
         self._plan: List[tuple] = []
+        #: path -> master block ids (public: saves consumers a
+        #: get_status round-trip per path, e.g. placement reporting)
+        self.block_ids_by_path: dict = {}
         for path in paths:
             info = fs.get_status(path)
-            n_blocks = len(info.block_ids)
-            for i in range(n_blocks):
+            self.block_ids_by_path[path] = list(info.block_ids)
+            for i in range(len(info.block_ids)):
                 self._plan.append((path, i, PageId(f"{info.file_id:x}", i)))
-        self._streams = {}
+        # streams are per-thread: FileInStream holds per-block state, so
+        # concurrent host_block callers (mesh load thread pool) must not
+        # share one (close()-races would silently yield empty views)
+        self._tls = threading.local()
+        self._all_streams: List = []
+        self._streams_lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self._plan)
@@ -76,10 +85,15 @@ class DeviceBlockLoader:
     def _host_bytes(self, path: str, index: int):
         """Host-side view of one block: zero-copy numpy over mmap when the
         short-circuit path applies, else a bytes copy from the stream."""
-        f = self._streams.get(path)
+        streams = getattr(self._tls, "streams", None)
+        if streams is None:
+            streams = self._tls.streams = {}
+        f = streams.get(path)
         if f is None:
             f = self._fs.open_file(path)
-            self._streams[path] = f
+            streams[path] = f
+            with self._streams_lock:
+                self._all_streams.append(f)
         stream = f.block_stream(index)
         view = getattr(stream, "numpy_view", None)
         if view is not None:
@@ -128,9 +142,10 @@ class DeviceBlockLoader:
                 "hbm_pages": self._hbm.page_count}
 
     def close(self) -> None:
-        for f in self._streams.values():
-            f.close()
-        self._streams.clear()
+        with self._streams_lock:
+            for f in self._all_streams:
+                f.close()
+            self._all_streams.clear()
         if self._hbm is not None:
             self._hbm.close()
 

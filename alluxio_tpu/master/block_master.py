@@ -26,7 +26,7 @@ from alluxio_tpu.utils.exceptions import (
     BlockDoesNotExistError, NotFoundError,
 )
 from alluxio_tpu.utils.wire import (
-    BlockInfo, BlockLocation, WorkerInfo, WorkerNetAddress,
+    BlockInfo, BlockLocation, TieredIdentity, WorkerInfo, WorkerNetAddress,
 )
 
 
@@ -97,6 +97,14 @@ class BlockMaster(Journaled):
         self._address_to_id: Dict[str, int] = {}
         #: block id -> {worker id -> tier alias}
         self._locations: Dict[int, Dict[int, str]] = {}
+        #: block id -> {mesh position -> reporting host}: the HBM warm
+        #: set reported by JAX clients (§2.11 device-mesh block map)
+        self._device_locations: Dict[int, Dict[int, str]] = {}
+        #: reporting host -> last report time (ms); reports are leases —
+        #: a client that dies without clearing ages out (see
+        #: prune_device_reports, driven by the lost-worker heartbeat)
+        self._device_report_ms: Dict[str, int] = {}
+        self.device_report_ttl_ms = 5 * 60 * 1000
         self._lost_blocks: Set[int] = set()
         #: listeners fired on worker loss (elastic re-replication hook)
         self.lost_worker_listeners: List = []
@@ -217,6 +225,7 @@ class BlockMaster(Journaled):
         """Expire silent workers; fires lost-worker listeners
         (reference: LostWorkerDetectionHeartbeatExecutor,
         ``DefaultBlockMaster.java:1087``)."""
+        self.prune_device_reports()
         now = self._clock.millis()
         newly_lost: List[MasterWorkerInfo] = []
         with self._lock:
@@ -303,8 +312,66 @@ class BlockMaster(Journaled):
             if w is not None:
                 locations.append(BlockLocation(worker_id=wid, address=w.address,
                                                tier_alias=tier))
+        device_locations = [
+            BlockLocation(
+                worker_id=-(pos + 1), tier_alias="HBM",
+                address=WorkerNetAddress(
+                    host=host,
+                    tiered_identity=TieredIdentity.from_spec(
+                        f"host={host},mesh={pos}")))
+            for pos, host in self._device_locations.get(
+                meta.block_id, {}).items()]
         return BlockInfo(block_id=meta.block_id,
-                         length=max(meta.length, 0), locations=locations)
+                         length=max(meta.length, 0), locations=locations,
+                         device_locations=device_locations)
+
+    # ------------------------------------------ device (HBM) warm-set map
+    def report_device_blocks(self, host: str,
+                             mesh_blocks: Dict[int, List[int]]) -> None:
+        """A JAX client reports its warm set: mesh position -> resident
+        block ids (SURVEY §2.11 "block map keyed by device mesh
+        position"). Replaces that host's previous report, so a warm-set
+        turnover is one call. Device residency is cache state like worker
+        tiers — volatile, never journaled."""
+        with self._lock:
+            self._drop_device_host(host)
+            for pos, bids in mesh_blocks.items():
+                for bid in bids:
+                    self._device_locations.setdefault(
+                        int(bid), {})[int(pos)] = host
+            if mesh_blocks:
+                self._device_report_ms[host] = self._clock.millis()
+
+    def _drop_device_host(self, host: str) -> None:
+        for bid in list(self._device_locations):
+            entry = self._device_locations[bid]
+            for pos in [p for p, h in entry.items() if h == host]:
+                del entry[pos]
+            if not entry:
+                del self._device_locations[bid]
+        self._device_report_ms.pop(host, None)
+
+    def prune_device_reports(self) -> List[str]:
+        """Age out device reports from hosts that stopped renewing (a
+        crashed JAX client can't call clear); driven by the same
+        heartbeat as lost-worker detection."""
+        now = self._clock.millis()
+        expired = []
+        with self._lock:
+            for host, ts in list(self._device_report_ms.items()):
+                if now - ts > self.device_report_ttl_ms:
+                    self._drop_device_host(host)
+                    expired.append(host)
+        return expired
+
+    def clear_device_blocks(self, host: str) -> None:
+        self.report_device_blocks(host, {})
+
+    def device_block_map(self) -> Dict[int, Dict[int, str]]:
+        """block id -> {mesh position: host} (introspection/report)."""
+        with self._lock:
+            return {bid: dict(m)
+                    for bid, m in self._device_locations.items()}
 
     def get_block_infos(self, block_ids: List[int]) -> List[BlockInfo]:
         out = []

@@ -38,6 +38,7 @@ Cold loads still ride the worker data plane (UFS -> worker tier -> host
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -70,7 +71,10 @@ class MeshBlockCache:
     """
 
     def __init__(self, mesh, *, axis: str = DATA_AXIS,
-                 block_bytes: int, dtype=np.uint8) -> None:
+                 block_bytes: int, dtype=np.uint8,
+                 client_host: str = "") -> None:
+        import socket
+
         import jax
 
         self._jax = jax
@@ -83,6 +87,16 @@ class MeshBlockCache:
                                     else axis)]))
         #: (path, block_index) in global order, set by load_global
         self.plan: List[Tuple[str, int]] = []
+        #: global block index -> master block id (for placement reports)
+        self.block_ids: List[int] = []
+        self.client_host = client_host or socket.gethostname()
+        self._block_client = None
+        #: path -> master block ids (filled from loaders; avoids a
+        #: get_status RPC per path on every resolve)
+        self._bids_by_path: Dict[str, List[int]] = {}
+        #: per_dev -> jitted batch assembler (jit caches by fn object;
+        #: rebuilding the closure per call would retrace every batch)
+        self._batch_fns: Dict[int, object] = {}
 
     # -- placement -----------------------------------------------------------
     def placement(self, n_blocks: int) -> Dict[int, int]:
@@ -92,15 +106,25 @@ class MeshBlockCache:
 
     # -- load (cold/host path; per-host locality) ----------------------------
     def load_global(self, fs, paths: Sequence[str], *,
-                    loader=None):
+                    loader=None, report: bool = True,
+                    io_threads: int = 8):
         """Materialize the warm set: every addressable device's shard is
         loaded from the host-local worker tier (short-circuit mmap ->
         one device_put per device), then assembled into one global sharded
-        array WITHOUT any host seeing the whole dataset.
+        array WITHOUT any host seeing the whole dataset. Per-device host
+        reads run in an IO thread pool and the device_puts are issued
+        as each shard completes, so transfer overlaps the next reads.
+
+        ``report=True`` registers this host's device placement with the
+        master block map (SURVEY §2.11 "block map keyed by device mesh
+        position") so the control plane can steer consumers at warm
+        copies one ICI hop away.
 
         ``loader``: an existing DeviceBlockLoader to reuse (tests); else
         one is built per call.
         """
+        from concurrent.futures import ThreadPoolExecutor
+
         import jax
 
         from alluxio_tpu.client.jax_io import DeviceBlockLoader
@@ -112,37 +136,105 @@ class MeshBlockCache:
                                        dtype=self.dtype)
         try:
             self.plan = list(loader.plan)
+            self._resolve_block_ids(fs, loader)
             n = len(self.plan)
             per_dev = -(-n // self.n_devices)
             elems = self.block_bytes // self.dtype.itemsize
             # mesh-position-major device order along the sharded axis
             mesh_devs = self.mesh.devices.reshape(-1)
             addressable = {d.id for d in jax.local_devices()}
-            shards = []
-            for d_pos in range(self.n_devices):
-                dev = mesh_devs[d_pos]
-                if dev.id not in addressable:
-                    continue  # another host loads this shard
+            my_positions = [p for p in range(self.n_devices)
+                            if mesh_devs[p].id in addressable]
+
+            def read_shard(d_pos: int):
                 rows = []
                 for k in range(per_dev):
                     g = d_pos * per_dev + k
-                    if g < n:
-                        host = loader.host_block(*self.plan[g])
-                    else:  # pad the ragged tail with zeros
-                        host = np.zeros(elems, self.dtype)
-                    if host.shape[0] != elems:
-                        padded = np.zeros(elems, self.dtype)
-                        padded[:host.shape[0]] = host
-                        host = padded
-                    rows.append(host)
-                local = np.stack(rows)  # (per_dev, elems)
-                shards.append(jax.device_put(local, dev))
+                    rows.append(self._host_row(loader, g, n, elems))
+                return d_pos, np.stack(rows)  # (per_dev, elems)
+
+            shards = {}
+            # host reads (mmap/stream) parallelize; device_put is issued
+            # the moment a shard's rows are ready (async transfer)
+            with ThreadPoolExecutor(max_workers=max(1, io_threads)) as ex:
+                for d_pos, local in ex.map(read_shard, my_positions):
+                    shards[d_pos] = jax.device_put(local, mesh_devs[d_pos])
             global_shape = (per_dev * self.n_devices, elems)
-            return jax.make_array_from_single_device_arrays(
-                global_shape, sharding, shards)
+            cached = jax.make_array_from_single_device_arrays(
+                global_shape, sharding,
+                [shards[p] for p in my_positions])
+            if report:
+                self.report_placement(fs, my_positions, per_dev, n)
+            return cached
         finally:
             if own_loader:
                 loader.close()
+
+    def _host_row(self, loader, g: int, n: int, elems: int):
+        if g >= n:  # pad the ragged tail with zeros
+            return np.zeros(elems, self.dtype)
+        host = loader.host_block(*self.plan[g])
+        if host.shape[0] != elems:
+            padded = np.zeros(elems, self.dtype)
+            padded[:host.shape[0]] = host
+            host = padded
+        return host
+
+    def _resolve_block_ids(self, fs, loader=None) -> None:
+        if loader is not None:  # loader already fetched every status
+            self._bids_by_path.update(
+                getattr(loader, "block_ids_by_path", {}))
+        self.block_ids = []
+        for path, idx in self.plan:
+            bids = self._bids_by_path.get(path)
+            if bids is None:
+                bids = self._bids_by_path[path] = \
+                    list(fs.get_status(path).block_ids)
+            self.block_ids.append(bids[idx] if idx < len(bids) else -1)
+
+    # -- control-plane placement reporting -----------------------------------
+    def report_placement(self, fs, my_positions: Sequence[int],
+                         per_dev: int, n: int) -> None:
+        """Tell the master which blocks are HBM-resident at which mesh
+        position (this host's shard of the warm set only — each host
+        reports its own; the master merges)."""
+        client = self._block_master_client(fs)
+        if client is None:
+            return
+        mesh_blocks = {}
+        for pos in my_positions:
+            bids = [self.block_ids[g]
+                    for g in range(pos * per_dev,
+                                   min((pos + 1) * per_dev, n))
+                    if self.block_ids[g] >= 0]
+            if bids:
+                mesh_blocks[pos] = bids
+        try:
+            client.report_device_blocks(self.client_host, mesh_blocks)
+        except Exception:  # noqa: BLE001 placement is advisory cache state
+            pass
+
+    def drop_placement(self, fs) -> None:
+        """Warm set released: clear this host's device block map entries
+        (pairs with eviction/close)."""
+        client = self._block_master_client(fs)
+        if client is not None:
+            try:
+                client.clear_device_blocks(self.client_host)
+            except Exception:  # noqa: BLE001 advisory
+                pass
+
+    def _block_master_client(self, fs):
+        if self._block_client is None:
+            store = getattr(fs, "store", None)
+            self._block_client = getattr(store, "block_master", None)
+            if self._block_client is None:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "no block-master client on %r: device placement "
+                    "reporting disabled", type(fs).__name__)
+        return self._block_client
 
     # -- warm collective reads (zero host traffic) ---------------------------
     def gather_all(self, cached):
@@ -188,20 +280,51 @@ class MeshBlockCache:
 
     def global_batch(self, cached, indices):
         """Assemble a batch of blocks by GLOBAL index regardless of which
-        device caches them: all-gather + gather fused into one jit (the
-        consumer composes this into its step so XLA overlaps the
-        collective with compute). ``indices``: 1-D array of block ids.
-        Output is replicated (each device gets the whole batch)."""
-        import jax
+        device caches them, moving O(batch) bytes over ICI — NOT the
+        whole warm set. Each device takes the requested rows it owns from
+        its local shard (others contribute zeros), then ONE psum merges
+        the batch: ICI traffic is the reduction of a (batch, elems)
+        buffer, independent of warm-set size. ``indices``: 1-D array of
+        global block ids. Output is replicated (every device gets the
+        whole batch); compose into the consumer's jit so XLA overlaps
+        the collective with compute."""
         import jax.numpy as jnp
 
-        gathered = self.gather_all(cached)
+        per_dev = cached.shape[0] // self.n_devices
+        return self.batch_fn(per_dev)(cached, jnp.asarray(indices))
+
+    def batch_fn(self, per_dev: int):
+        """The jitted O(batch) assembler, cached per ``per_dev`` (exposed
+        so consumers can fuse it into their step and tests can inspect
+        the lowering)."""
+        cached_fn = self._batch_fns.get(per_dev)
+        if cached_fn is not None:
+            return cached_fn
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
 
         @jax.jit
-        def _take(g, idx):
-            return jnp.take(g, idx, axis=0)
+        def _assemble(x, idx):
+            def f(local, idx_rep):
+                # local: (per_dev, elems); idx_rep: (B,) global indices
+                pos = jax.lax.axis_index(self.axis)
+                local_idx = idx_rep - pos * per_dev
+                mine = (local_idx >= 0) & (local_idx < per_dev)
+                rows = jnp.take(local,
+                                jnp.clip(local_idx, 0, per_dev - 1),
+                                axis=0)           # (B, elems)
+                rows = jnp.where(mine[:, None], rows,
+                                 jnp.zeros((), local.dtype))
+                # O(batch) collective: merge owners' contributions
+                return jax.lax.psum(rows, self.axis)
 
-        return _take(gathered, jnp.asarray(indices))
+            return _shard_map(
+                f, mesh=self.mesh, in_specs=(P(self.axis, None), P()),
+                out_specs=P())(x, idx)
+
+        self._batch_fns[per_dev] = _assemble
+        return _assemble
 
     def replicate(self, cached, block_index: int):
         """Fan a hot block out to EVERY device (the
@@ -220,6 +343,76 @@ class MeshBlockCache:
                 jnp.squeeze(row, axis=0), out_sharding)
 
         return _pick(cached)
+
+    # -- warm-set turnover (eviction/refresh) --------------------------------
+    def turnover(self, cached, fs, replacements: Dict[int, Tuple[str, int]],
+                 *, loader=None, report: bool = True):
+        """Replace warm-set rows in place: ``replacements`` maps a global
+        block index -> a new ``(path, block_index)`` source. Only hosts
+        owning a replaced row do IO, and each touched device gets ONE
+        donated in-place row update — O(changed blocks) host->device
+        traffic, untouched shards are reused as-is. The refreshed
+        placement is re-reported to the master block map.
+
+        This is the warm-set eviction/refresh story: evict = replace a
+        cold block with the next epoch's data; the HBM footprint never
+        grows (the old shard buffer is donated into the update).
+        """
+        import jax
+
+        from alluxio_tpu.client.jax_io import DeviceBlockLoader
+
+        if not replacements:
+            return cached
+        n = len(self.plan)
+        per_dev = cached.shape[0] // self.n_devices
+        elems = cached.shape[1]
+        sharding = named_sharding(self.mesh, self.axis)
+        mesh_devs = self.mesh.devices.reshape(-1)
+        addressable = {d.id for d in jax.local_devices()}
+        my_positions = [p for p in range(self.n_devices)
+                        if mesh_devs[p].id in addressable]
+        # validate EVERY index before mutating the plan: a bad key must
+        # not leave plan/device state describing different data
+        for g in replacements:
+            if not 0 <= g < n:
+                raise IndexError(f"global block index {g} out of range")
+        for g, src in replacements.items():
+            self.plan[g] = tuple(src)
+        self._resolve_block_ids(fs)
+
+        new_paths = sorted({p for p, _i in replacements.values()})
+        own_loader = loader is None
+        if own_loader:
+            loader = DeviceBlockLoader(fs, new_paths, hbm_bytes=0,
+                                       dtype=self.dtype)
+        try:
+            @partial(jax.jit, donate_argnums=0)
+            def _update(local, rows, data):
+                return local.at[rows].set(data)
+
+            shards = {s.device: s.data for s in cached.addressable_shards}
+            for pos in my_positions:
+                dev = mesh_devs[pos]
+                touched = sorted(g for g in replacements
+                                 if g // per_dev == pos)
+                if not touched:
+                    continue
+                data = np.stack([self._host_row(loader, g, n, elems)
+                                 for g in touched])
+                rows = np.asarray([g - pos * per_dev for g in touched])
+                shards[dev] = _update(shards[dev],
+                                      jax.device_put(rows, dev),
+                                      jax.device_put(data, dev))
+            cached = jax.make_array_from_single_device_arrays(
+                (per_dev * self.n_devices, elems), sharding,
+                [shards[mesh_devs[p]] for p in my_positions])
+            if report:
+                self.report_placement(fs, my_positions, per_dev, n)
+            return cached
+        finally:
+            if own_loader:
+                loader.close()
 
     # -- introspection -------------------------------------------------------
     def describe_placement(self, cached) -> Dict[int, List[int]]:

@@ -211,6 +211,23 @@ class BlockMasterClient(_BaseClient):
         return BlockInfo.from_wire(self._call("get_block_info",
                                               {"block_id": block_id}))
 
+    def report_device_blocks(self, host: str,
+                             mesh_blocks: "Dict[int, List[int]]") -> None:
+        """Report this client's HBM warm set (mesh pos -> block ids);
+        replaces the previous report from the same host."""
+        self._call("report_device_blocks", {
+            "host": host,
+            "mesh_blocks": {str(k): [int(b) for b in v]
+                            for k, v in mesh_blocks.items()}})
+
+    def clear_device_blocks(self, host: str) -> None:
+        self.report_device_blocks(host, {})
+
+    def device_block_map(self) -> "Dict[int, Dict[int, str]]":
+        resp = self._call("device_block_map", {})
+        return {int(bid): {int(p): h for p, h in m.items()}
+                for bid, m in resp["map"].items()}
+
     def get_block_infos(self, block_ids: List[int]) -> List[BlockInfo]:
         resp = self._call("get_block_infos", {"block_ids": block_ids})
         return [BlockInfo.from_wire(d) for d in resp["infos"]]

@@ -173,6 +173,11 @@ def block_master_service(bm: BlockMaster) -> ServiceDefinition:
     u("get_block_info", lambda r: bm.get_block_info(r["block_id"]).to_wire())
     u("get_block_infos", lambda r: {"infos": [
         b.to_wire() for b in bm.get_block_infos(r["block_ids"])]})
+    u("report_device_blocks", lambda r: (bm.report_device_blocks(
+        r["host"], {int(k): v for k, v in r["mesh_blocks"].items()}),
+        {})[-1])
+    u("device_block_map", lambda r: {"map": {
+        str(bid): m for bid, m in bm.device_block_map().items()}})
     u("get_worker_infos", lambda r: {"infos": [
         w.to_wire() for w in bm.get_worker_infos(
             include_lost=r.get("include_lost", False))]})

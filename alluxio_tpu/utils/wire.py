@@ -165,9 +165,15 @@ class BlockInfo:
     block_id: int = 0
     length: int = 0
     locations: List[BlockLocation] = field(default_factory=list)
+    #: HBM (device-mesh) residency reported by JAX clients — kept
+    #: SEPARATE from ``locations``: these are not worker-served replicas
+    #: (no data server behind them), so replication counting and the
+    #: worker read path must not see them
+    device_locations: List[BlockLocation] = field(default_factory=list)
 
 
 _NESTED[("BlockInfo", "locations")] = BlockLocation
+_NESTED[("BlockInfo", "device_locations")] = BlockLocation
 
 
 @_wire_dataclass

@@ -111,6 +111,107 @@ class TestMeshBlockCache:
         np.testing.assert_array_equal(np.asarray(hot), payloads[5])
         fs.close()
 
+    def test_placement_reported_to_block_map(self, cluster, mesh):
+        """Control-plane integration (round-2 verdict): the master's
+        block map learns which blocks are HBM-resident at which mesh
+        position, and a dropped warm set clears the report."""
+        fs = cluster.file_system()
+        _write_dataset(fs)
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK,
+                               client_host="jaxclient0")
+        cache.load_global(fs, [f"/ici/b{i}" for i in range(N_FILES)])
+        bc = cluster.block_client()
+        dev_map = bc.device_block_map()
+        assert len(dev_map) == N_FILES
+        # every mesh position holds 2 blocks; the map inverts to that
+        by_pos = {}
+        for bid, posmap in dev_map.items():
+            for pos, host in posmap.items():
+                assert host == "jaxclient0"
+                by_pos.setdefault(pos, []).append(bid)
+        assert len(by_pos) == 8
+        assert all(len(b) == 2 for b in by_pos.values())
+        # get_block_info surfaces HBM residency SEPARATELY from worker
+        # replicas (replication counting / read path must not see it)
+        some_bid = cache.block_ids[5]
+        info = bc.get_block_info(some_bid)
+        assert all(loc.tier_alias != "HBM" for loc in info.locations)
+        assert len(info.device_locations) == 1
+        assert info.device_locations[0].tier_alias == "HBM"
+        assert info.device_locations[0].address.tiered_identity.value(
+            "mesh") == "2"
+
+        cache.drop_placement(fs)
+        assert bc.device_block_map() == {}
+        fs.close()
+
+    def test_device_reports_age_out(self, cluster, mesh):
+        """A crashed JAX client's report expires after the TTL (pruned by
+        the lost-worker heartbeat) instead of steering readers forever."""
+        fs = cluster.file_system()
+        _write_dataset(fs)
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK,
+                               client_host="doomed")
+        cache.load_global(fs, [f"/ici/b{i}" for i in range(N_FILES)])
+        bm = cluster.master.block_master
+        assert bm.device_block_map()
+        bm.device_report_ttl_ms = 0  # everything is instantly stale
+        assert bm.prune_device_reports() == ["doomed"]
+        assert bm.device_block_map() == {}
+        fs.close()
+
+    def test_global_batch_moves_o_batch_not_dataset(self, cluster, mesh):
+        """The batch assembler must not all-gather the warm set: its
+        lowering contains no all-gather, and its only collective reduces
+        a (batch, elems) buffer."""
+        import jax.numpy as jnp
+
+        fs = cluster.file_system()
+        _write_dataset(fs)
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK)
+        cached = cache.load_global(fs, [f"/ici/b{i}"
+                                        for i in range(N_FILES)])
+        idx = jnp.asarray([3, 11, 6])
+        fn = cache.batch_fn(cached.shape[0] // cache.n_devices)
+        hlo = fn.lower(cached, idx).compile().as_text()
+        assert "all-gather" not in hlo, \
+            "batch assembly must not move the whole warm set"
+        # the collective present is an all-reduce over the batch buffer
+        assert "all-reduce" in hlo
+        fs.close()
+
+    def test_turnover_replaces_rows_and_rereports(self, cluster, mesh):
+        """Warm-set eviction/refresh: replaced rows get the new blocks,
+        untouched rows keep their data, placement report follows."""
+        fs = cluster.file_system()
+        payloads = _write_dataset(fs)
+        rng = np.random.default_rng(11)
+        fresh = []
+        for i in range(2):
+            data = rng.integers(0, 255, size=BLOCK,
+                                dtype=np.uint8).tobytes()
+            fs.write_all(f"/fresh/b{i}", data,
+                         write_type=WriteType.MUST_CACHE)
+            fresh.append(np.frombuffer(data, np.uint8))
+        cache = MeshBlockCache(mesh, block_bytes=BLOCK,
+                               client_host="jaxclient1")
+        cached = cache.load_global(fs, [f"/ici/b{i}"
+                                        for i in range(N_FILES)])
+        old_bid_3 = cache.block_ids[3]
+        cached2 = cache.turnover(cached, fs, {
+            3: ("/fresh/b0", 0), 12: ("/fresh/b1", 0)})
+        got = np.asarray(cached2)
+        np.testing.assert_array_equal(got[3], fresh[0])
+        np.testing.assert_array_equal(got[12], fresh[1])
+        for i in (2, 4, 11, 13, 0, 15):
+            np.testing.assert_array_equal(got[i], payloads[i])
+        # placement followed the turnover
+        dev_map = cluster.block_client().device_block_map()
+        assert old_bid_3 not in dev_map
+        assert cache.block_ids[3] in dev_map
+        assert dev_map[cache.block_ids[3]] == {1: "jaxclient1"}
+        fs.close()
+
     def test_ragged_tail_padded(self, cluster, mesh):
         """n_blocks not divisible by mesh size: tail blocks pad with
         zeros and real blocks stay addressable."""

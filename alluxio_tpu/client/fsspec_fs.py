@@ -1,0 +1,272 @@
+"""fsspec adapter: the namespace for pandas/pyarrow/torch/dask users.
+
+The non-JAX consumer surface (reference analogues: the HDFS-compat
+client ``core/client/hdfs/.../AbstractFileSystem.java:80`` exposing
+alluxio:// to Spark/Presto, and the S3 REST proxy
+``proxy/s3/S3RestServiceHandler.java:75``): any library speaking fsspec
+("atpu://path", or an ``AlluxioTpuFileSystem`` instance passed as
+``filesystem=``) reads and writes through the caching data plane —
+warm reads ride the short-circuit mmap path, writes honor the
+configured write type.
+
+Usage::
+
+    import fsspec
+    with fsspec.open("atpu:///data/f.parquet", master="host:port") as f:
+        ...
+    # or explicitly:
+    afs = AlluxioTpuFileSystem(master="host:port")
+    pq.read_table("/data/f.parquet", filesystem=afs)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from fsspec import AbstractFileSystem
+from fsspec.spec import AbstractBufferedFile
+
+import contextlib
+
+from alluxio_tpu.utils.exceptions import (
+    DirectoryNotEmptyError, FileAlreadyExistsError,
+    FileDoesNotExistError,
+)
+
+
+@contextlib.contextmanager
+def _os_errors():
+    """Translate framework errors into the OSError family fsspec
+    consumers handle (`except FileNotFoundError/FileExistsError`)."""
+    try:
+        yield
+    except FileDoesNotExistError as e:
+        raise FileNotFoundError(str(e)) from e
+    except FileAlreadyExistsError as e:
+        raise FileExistsError(str(e)) from e
+    except DirectoryNotEmptyError as e:
+        raise OSError(str(e)) from e
+
+
+def _entry(info) -> Dict[str, Any]:
+    return {
+        "name": info.path.lstrip("/"),
+        "size": info.length,
+        "type": "directory" if info.folder else "file",
+        "mtime": info.last_modification_time_ms / 1000.0,
+        "persisted": info.persisted,
+        "in_memory_percentage": info.in_memory_percentage,
+    }
+
+
+class AlluxioTpuFile(AbstractBufferedFile):
+    """Buffered file over FileInStream/FileOutStream."""
+
+    def __init__(self, fs, path, mode="rb", write_type=None, **kwargs):
+        self._write_type = write_type
+        self._stream = None
+        super().__init__(fs, path, mode, **kwargs)
+        if mode == "rb":
+            self._stream = fs._fs.open_file(path)
+
+    # -- reads ---------------------------------------------------------------
+    def _fetch_range(self, start: int, end: int) -> bytes:
+        n = max(0, end - start)
+        if n == 0:
+            return b""
+        return self._stream.pread(start, n)
+
+    # -- writes --------------------------------------------------------------
+    def _initiate_upload(self) -> None:
+        kw = {"write_type": self._write_type} if self._write_type else {}
+        try:
+            self._stream = self.fs._fs.create_file(self.path, **kw)
+        except FileAlreadyExistsError:
+            # fsspec 'wb' contract: overwrite (truncate) existing files
+            self.fs._fs.delete(self.path)
+            self._stream = self.fs._fs.create_file(self.path, **kw)
+
+    def _upload_chunk(self, final: bool = False) -> bool:
+        self.buffer.seek(0)
+        data = self.buffer.read()
+        if data:
+            self._stream.write(data)
+        if final:
+            self._stream.close()
+            self._stream = None
+        return True
+
+    def close(self) -> None:
+        super().close()
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+
+
+class AlluxioTpuFileSystem(AbstractFileSystem):
+    """``atpu://`` filesystem over the FileSystem client."""
+
+    protocol = ("atpu", "alluxio")
+    root_marker = "/"
+    #: fsspec's instance cache tokenizes constructor kwargs; two
+    #: different injected ``fs=`` client objects can collide, handing a
+    #: caller a filesystem bound to a dead cluster — disable caching,
+    #: the underlying client pools its own channels
+    cachable = False
+
+    def __init__(self, master: Optional[str] = None, *, fs=None,
+                 conf=None, write_type: Optional[str] = None,
+                 **kwargs) -> None:
+        super().__init__(**kwargs)
+        if fs is not None:
+            self._fs = fs
+            self._owns_fs = False
+        else:
+            from alluxio_tpu.client.file_system import FileSystem
+            from alluxio_tpu.conf import Configuration, Keys
+
+            conf = conf or Configuration()
+            if master is None:
+                master = (f"{conf.get(Keys.MASTER_HOSTNAME)}:"
+                          f"{conf.get_int(Keys.MASTER_RPC_PORT)}")
+            self._fs = FileSystem(master, conf=conf)
+            self._owns_fs = True
+            # fsspec never calls close() on registry-built instances
+            # and caching is off: close the owned client (channels,
+            # heartbeats) when the adapter is collected
+            import weakref
+
+            self._finalizer = weakref.finalize(self, self._fs.close)
+        self._write_type = write_type
+
+    @classmethod
+    def _strip_protocol(cls, path: str) -> str:
+        path = super()._strip_protocol(path)
+        return path.lstrip("/") or ""
+
+    def _norm(self, path: str) -> str:
+        return "/" + self._strip_protocol(path)
+
+    # -- metadata ------------------------------------------------------------
+    def info(self, path, **kwargs) -> Dict[str, Any]:
+        try:
+            return _entry(self._fs.get_status(self._norm(path)))
+        except FileDoesNotExistError as e:
+            raise FileNotFoundError(str(e)) from e
+
+    def ls(self, path, detail=True, **kwargs) -> List:
+        p = self._norm(path)
+        try:
+            st = self._fs.get_status(p)
+            if not st.folder:
+                entries = [_entry(st)]
+            else:
+                entries = [_entry(i) for i in self._fs.list_status(p)]
+        except FileDoesNotExistError as e:
+            raise FileNotFoundError(str(e)) from e
+        return entries if detail else [e["name"] for e in entries]
+
+    def exists(self, path, **kwargs) -> bool:
+        return self._fs.exists(self._norm(path))
+
+    def created(self, path):
+        import datetime
+
+        with _os_errors():
+            st = self._fs.get_status(self._norm(path))
+        return datetime.datetime.fromtimestamp(
+            st.creation_time_ms / 1000.0, tz=datetime.timezone.utc)
+
+    def modified(self, path):
+        import datetime
+
+        with _os_errors():
+            st = self._fs.get_status(self._norm(path))
+        return datetime.datetime.fromtimestamp(
+            st.last_modification_time_ms / 1000.0,
+            tz=datetime.timezone.utc)
+
+    # -- namespace ops -------------------------------------------------------
+    def mkdir(self, path, create_parents=True, **kwargs) -> None:
+        with _os_errors():
+            self._fs.create_directory(self._norm(path),
+                                      recursive=create_parents,
+                                      allow_exists=False)
+
+    def makedirs(self, path, exist_ok=False) -> None:
+        with _os_errors():
+            self._fs.create_directory(self._norm(path), recursive=True,
+                                      allow_exists=exist_ok)
+
+    def rmdir(self, path) -> None:
+        with _os_errors():
+            self._fs.delete(self._norm(path), recursive=False)
+
+    def _rm(self, path) -> None:
+        try:
+            self._fs.delete(self._norm(path), recursive=False)
+        except FileDoesNotExistError as e:
+            raise FileNotFoundError(str(e)) from e
+
+    def rm(self, path, recursive=False, maxdepth=None) -> None:
+        for p in [path] if isinstance(path, str) else path:
+            try:
+                self._fs.delete(self._norm(p), recursive=recursive)
+            except FileDoesNotExistError as e:
+                raise FileNotFoundError(str(e)) from e
+
+    def mv(self, path1, path2, **kwargs) -> None:
+        with _os_errors():
+            self._fs.rename(self._norm(path1), self._norm(path2))
+
+    # -- data ----------------------------------------------------------------
+    def _open(self, path, mode="rb", block_size=None, autocommit=True,
+              cache_options=None, **kwargs):
+        if mode not in ("rb", "wb"):
+            raise NotImplementedError(f"mode {mode!r} (rb/wb only)")
+        try:
+            return AlluxioTpuFile(self, self._norm(path), mode=mode,
+                                  write_type=kwargs.pop("write_type",
+                                                        self._write_type),
+                                  block_size=block_size,
+                                  cache_options=cache_options, **kwargs)
+        except FileDoesNotExistError as e:
+            raise FileNotFoundError(str(e)) from e
+
+    def cat_file(self, path, start=None, end=None, **kwargs) -> bytes:
+        p = self._norm(path)
+        with _os_errors():
+            if start is None and end is None:
+                return self._fs.read_all(p)
+            with self._fs.open_file(p) as f:
+                length = f.length
+                # fsspec contract: negative offsets are EOF-relative
+                s = 0 if start is None else \
+                    (start if start >= 0 else max(0, length + start))
+                e = length if end is None else \
+                    (end if end >= 0 else length + end)
+                e = min(e, length)
+                return f.pread(s, max(0, e - s))
+
+    def pipe_file(self, path, value, **kwargs) -> None:
+        wt = kwargs.pop("write_type", self._write_type)
+        kw = {"write_type": wt} if wt else {}
+        with _os_errors():
+            try:
+                self._fs.write_all(self._norm(path), value, **kw)
+            except FileAlreadyExistsError:
+                self._fs.delete(self._norm(path))
+                self._fs.write_all(self._norm(path), value, **kw)
+
+    def close(self) -> None:
+        if self._owns_fs:
+            self._finalizer()
+
+
+def register() -> None:
+    """Register ``atpu://`` / ``alluxio://`` with fsspec."""
+    import fsspec
+
+    for proto in AlluxioTpuFileSystem.protocol:
+        fsspec.register_implementation(proto, AlluxioTpuFileSystem,
+                                       clobber=True)

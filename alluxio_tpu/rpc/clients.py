@@ -325,8 +325,13 @@ class WorkerClient(_BaseClient):
                                                "block_id": block_id})
 
     def close_local_block(self, session_id: int, block_id: int) -> None:
-        self._call("close_local_block", {"session_id": session_id,
-                                         "block_id": block_id})
+        # advisory lease release: the worker's session cleanup expires it
+        # anyway, so NO retry and a short deadline — a GC-time close of a
+        # leaked stream against a dead cluster must not block for the
+        # full retry window (observed: 30s stalls on the caller's thread)
+        self._channel.call(self.service, "close_local_block",
+                           {"session_id": session_id,
+                            "block_id": block_id}, timeout=2.0)
 
     def create_local_block(self, session_id: int, block_id: int, *,
                            size_hint: int, tier: str = "") -> str:

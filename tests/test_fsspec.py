@@ -1,0 +1,127 @@
+"""fsspec adapter tests: the non-JAX consumer surface.
+
+Reference analogue: the HDFS-compat client contract tests
+(``tests/.../client/hadoop/contract``) — generic-filesystem semantics
+over the caching data plane, driven here by fsspec, pyarrow, and
+pandas exactly as an external user would.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from alluxio_tpu.client.fsspec_fs import AlluxioTpuFileSystem, register
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1) as c:
+        yield c
+
+
+@pytest.fixture()
+def afs(cluster):
+    fs = AlluxioTpuFileSystem(fs=cluster.file_system())
+    yield fs
+
+
+class TestBasics:
+    def test_write_read_roundtrip(self, afs):
+        with afs.open("/dir/a.bin", "wb") as f:
+            f.write(b"hello fsspec")
+        assert afs.cat_file("/dir/a.bin") == b"hello fsspec"
+        with afs.open("/dir/a.bin", "rb") as f:
+            assert f.read(5) == b"hello"
+            f.seek(6)
+            assert f.read() == b"fsspec"
+
+    def test_ls_info_exists(self, afs):
+        afs.pipe_file("/d/x", b"1")
+        afs.pipe_file("/d/y", b"22")
+        names = afs.ls("/d", detail=False)
+        assert sorted(names) == ["d/x", "d/y"]
+        info = afs.info("/d/y")
+        assert info["size"] == 2 and info["type"] == "file"
+        assert afs.info("/d")["type"] == "directory"
+        assert afs.exists("/d/x")
+        assert not afs.exists("/nope")
+        with pytest.raises(FileNotFoundError):
+            afs.info("/nope")
+
+    def test_mkdir_mv_rm(self, afs):
+        afs.makedirs("/a/b/c")
+        assert afs.info("/a/b/c")["type"] == "directory"
+        afs.pipe_file("/a/b/c/f", b"data")
+        afs.mv("/a/b/c/f", "/a/b/g")
+        assert afs.cat_file("/a/b/g") == b"data"
+        assert not afs.exists("/a/b/c/f")
+        afs.rm("/a", recursive=True)
+        assert not afs.exists("/a")
+
+    def test_ranged_read(self, afs):
+        afs.pipe_file("/r", bytes(range(100)))
+        assert afs.cat_file("/r", start=10, end=20) == bytes(range(10, 20))
+
+    def test_large_multiblock_file(self, cluster):
+        """Spans multiple 1 MiB blocks through buffered fsspec IO."""
+        afs = AlluxioTpuFileSystem(fs=cluster.file_system())
+        data = np.random.default_rng(0).integers(
+            0, 255, size=3 * (1 << 20) + 17, dtype=np.uint8).tobytes()
+        with afs.open("/big", "wb") as f:
+            f.write(data)
+        assert afs.info("/big")["size"] == len(data)
+        with afs.open("/big", "rb") as f:
+            assert f.read() == data
+
+
+class TestEcosystem:
+    def test_pyarrow_parquet_roundtrip(self, afs):
+        """VERDICT done-condition: pyarrow.parquet reads through the
+        adapter."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.table({"a": list(range(1000)),
+                          "b": [f"s{i}" for i in range(1000)]})
+        buf = io.BytesIO()
+        pq.write_table(table, buf)
+        afs.pipe_file("/warehouse/t.parquet", buf.getvalue())
+
+        got = pq.read_table("warehouse/t.parquet", filesystem=afs)
+        assert got.equals(table)
+        proj = pq.read_table("warehouse/t.parquet", filesystem=afs,
+                             columns=["a"])
+        assert proj.column_names == ["a"]
+        assert proj.num_rows == 1000
+
+    def test_pyarrow_write_through_adapter(self, afs):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.table({"x": [1.5, 2.5, 3.5]})
+        with afs.open("/out/w.parquet", "wb") as f:
+            pq.write_table(table, f)
+        got = pq.read_table("out/w.parquet", filesystem=afs)
+        assert got.equals(table)
+
+    def test_pandas_csv(self, afs):
+        import pandas as pd
+
+        afs.pipe_file("/csv/data.csv", b"a,b\n1,x\n2,y\n")
+        with afs.open("/csv/data.csv", "rb") as f:
+            df = pd.read_csv(f)
+        assert list(df["a"]) == [1, 2]
+
+    def test_registered_protocol_url(self, cluster):
+        """fsspec.open("atpu://...") resolves through the registry."""
+        import fsspec
+
+        register()
+        addr = cluster.master.address
+        host, _, port = addr.rpartition(":")
+        with fsspec.open(f"atpu:///u/f.txt", "wb", master=addr) as f:
+            f.write(b"via url")
+        with fsspec.open(f"atpu:///u/f.txt", "rb", master=addr) as f:
+            assert f.read() == b"via url"

@@ -79,12 +79,12 @@ class AlluxioTpuFile(AbstractBufferedFile):
     # -- writes --------------------------------------------------------------
     def _initiate_upload(self) -> None:
         kw = {"write_type": self._write_type} if self._write_type else {}
-        try:
-            self._stream = self.fs._fs.create_file(self.path, **kw)
-        except FileAlreadyExistsError:
-            # fsspec 'wb' contract: overwrite (truncate) existing files
-            self.fs._fs.delete(self.path)
-            self._stream = self.fs._fs.create_file(self.path, **kw)
+        # fsspec 'wb' contract: truncate existing files — the master
+        # replaces the inode atomically under one lock (no
+        # delete-then-create window losing data on a failed write)
+        with _os_errors():
+            self._stream = self.fs._fs.create_file(self.path,
+                                                   overwrite=True, **kw)
 
     def _upload_chunk(self, final: bool = False) -> bool:
         self.buffer.seek(0)
@@ -108,11 +108,6 @@ class AlluxioTpuFileSystem(AbstractFileSystem):
 
     protocol = ("atpu", "alluxio")
     root_marker = "/"
-    #: fsspec's instance cache tokenizes constructor kwargs; two
-    #: different injected ``fs=`` client objects can collide, handing a
-    #: caller a filesystem bound to a dead cluster — disable caching,
-    #: the underlying client pools its own channels
-    cachable = False
 
     def __init__(self, master: Optional[str] = None, *, fs=None,
                  conf=None, write_type: Optional[str] = None,
@@ -149,21 +144,17 @@ class AlluxioTpuFileSystem(AbstractFileSystem):
 
     # -- metadata ------------------------------------------------------------
     def info(self, path, **kwargs) -> Dict[str, Any]:
-        try:
+        with _os_errors():
             return _entry(self._fs.get_status(self._norm(path)))
-        except FileDoesNotExistError as e:
-            raise FileNotFoundError(str(e)) from e
 
     def ls(self, path, detail=True, **kwargs) -> List:
         p = self._norm(path)
-        try:
+        with _os_errors():
             st = self._fs.get_status(p)
             if not st.folder:
                 entries = [_entry(st)]
             else:
                 entries = [_entry(i) for i in self._fs.list_status(p)]
-        except FileDoesNotExistError as e:
-            raise FileNotFoundError(str(e)) from e
         return entries if detail else [e["name"] for e in entries]
 
     def exists(self, path, **kwargs) -> bool:
@@ -203,17 +194,24 @@ class AlluxioTpuFileSystem(AbstractFileSystem):
             self._fs.delete(self._norm(path), recursive=False)
 
     def _rm(self, path) -> None:
-        try:
+        with _os_errors():
             self._fs.delete(self._norm(path), recursive=False)
-        except FileDoesNotExistError as e:
-            raise FileNotFoundError(str(e)) from e
 
-    def rm(self, path, recursive=False, maxdepth=None) -> None:
-        for p in [path] if isinstance(path, str) else path:
-            try:
-                self._fs.delete(self._norm(p), recursive=recursive)
-            except FileDoesNotExistError as e:
-                raise FileNotFoundError(str(e)) from e
+    def rm(self, path, recursive=False, maxdepth=None):
+        # fast path: recursive delete of one real dir is ONE master
+        # RPC; glob/list inputs take the base implementation
+        # (expand_path + per-file _rm)
+        if isinstance(path, str) and recursive and maxdepth is None \
+                and not self.has_glob(path):
+            with _os_errors():
+                self._fs.delete(self._norm(path), recursive=True)
+            return
+        return super().rm(path, recursive=recursive,
+                          maxdepth=maxdepth)
+
+    @staticmethod
+    def has_glob(path: str) -> bool:
+        return any(ch in path for ch in "*?[")
 
     def mv(self, path1, path2, **kwargs) -> None:
         with _os_errors():
@@ -224,14 +222,12 @@ class AlluxioTpuFileSystem(AbstractFileSystem):
               cache_options=None, **kwargs):
         if mode not in ("rb", "wb"):
             raise NotImplementedError(f"mode {mode!r} (rb/wb only)")
-        try:
+        with _os_errors():
             return AlluxioTpuFile(self, self._norm(path), mode=mode,
                                   write_type=kwargs.pop("write_type",
                                                         self._write_type),
                                   block_size=block_size,
                                   cache_options=cache_options, **kwargs)
-        except FileDoesNotExistError as e:
-            raise FileNotFoundError(str(e)) from e
 
     def cat_file(self, path, start=None, end=None, **kwargs) -> bytes:
         p = self._norm(path)
@@ -252,11 +248,8 @@ class AlluxioTpuFileSystem(AbstractFileSystem):
         wt = kwargs.pop("write_type", self._write_type)
         kw = {"write_type": wt} if wt else {}
         with _os_errors():
-            try:
-                self._fs.write_all(self._norm(path), value, **kw)
-            except FileAlreadyExistsError:
-                self._fs.delete(self._norm(path))
-                self._fs.write_all(self._norm(path), value, **kw)
+            self._fs.write_all(self._norm(path), value,
+                               overwrite=True, **kw)
 
     def close(self) -> None:
         if self._owns_fs:

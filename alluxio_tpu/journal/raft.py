@@ -327,11 +327,17 @@ class RaftNode:
         self._election_timeout_ms = election_timeout_ms
         self._heartbeat_ms = heartbeat_interval_ms
         self._deadline = 0.0
+        #: when we last accepted a live leader's append (pre-vote gate)
+        self._last_leader_contact = time.monotonic()
         self._reset_election_deadline()
         self._stopped = False
         self._threads: List[threading.Thread] = []
         self._peer_wakeups: Dict[str, threading.Event] = {
             nid: threading.Event() for nid in self.peers}
+        #: injectable peer transport (tests install drop/partition
+        #: shims here; the MultiProcessCluster exercises real
+        #: network failures, this seam covers asymmetric partitions)
+        self.transport = _peer_call
         self._step_down_cbs: List = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -468,6 +474,14 @@ class RaftNode:
                 time.sleep(0.02)
 
     def _start_election(self) -> None:
+        if not self._pre_vote_wins():
+            # a live leader is still heartbeating a majority (we're the
+            # partitioned/rejoining one): do NOT bump the term — pre-vote
+            # (Raft §9.6) keeps a rejoining node from deposing a healthy
+            # leader and failing its in-flight commits
+            with self.lock:
+                self._reset_election_deadline()
+            return
         with self.lock:
             if self._stopped or self.state == LEADER:
                 return
@@ -486,7 +500,7 @@ class RaftNode:
 
         def ask(addr):
             try:
-                resp = _peer_call(addr, "request_vote", {
+                resp = self.transport(addr, "request_vote", {
                     "term": term, "candidate_id": self.node_id,
                     "last_log_index": last_idx, "last_log_term": last_term,
                 }, timeout=self._election_timeout_ms[0] / 1000.0)
@@ -512,6 +526,47 @@ class RaftNode:
             with self.lock:
                 self._become_leader()
         done.wait(timeout=self._election_timeout_ms[1] / 1000.0)
+
+    def _pre_vote_wins(self) -> bool:
+        """Pre-vote round (Raft §9.6): ask peers whether they would grant
+        a vote at term+1 WITHOUT bumping terms. A peer refuses while its
+        own election deadline is fresh (it hears a live leader). True
+        when a majority would vote — only then is a real (disruptive)
+        election worth starting."""
+        with self.lock:
+            if self._stopped or self.state == LEADER:
+                return False
+            term = self.log.term + 1
+            last_idx = self.log.last_index
+            last_term = self.log.term_at(
+                last_idx, snapshot_term=self.snapshot_term)
+        if not self.peers:
+            return True
+        votes = [1]
+        decided = threading.Event()
+
+        def ask(addr):
+            try:
+                resp = self.transport(addr, "request_vote", {
+                    "term": term, "candidate_id": self.node_id,
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                    "pre_vote": True,
+                }, timeout=self._election_timeout_ms[0] / 1000.0)
+            except Exception:  # noqa: BLE001 unreachable: no pre-vote
+                return
+            if resp.get("granted"):
+                with self.lock:
+                    votes[0] += 1
+                    if votes[0] >= self.quorum_size:
+                        decided.set()
+
+        threads = [threading.Thread(target=ask, args=(a,), daemon=True)
+                   for a in self.peers.values()]
+        for t in threads:
+            t.start()
+        decided.wait(timeout=self._election_timeout_ms[0] / 1000.0)
+        with self.lock:
+            return votes[0] >= self.quorum_size
 
     def _become_leader(self) -> None:
         """Caller holds the lock. Appends a no-op barrier record in the new
@@ -554,6 +609,8 @@ class RaftNode:
 
     # -- RPC handlers (peer-facing) ------------------------------------------
     def handle_request_vote(self, req: dict) -> dict:
+        if req.get("pre_vote"):
+            return self._handle_pre_vote(req)
         with self.lock:
             if req["term"] > self.log.term:
                 self._become_follower(req["term"], None)
@@ -572,12 +629,33 @@ class RaftNode:
                     self._reset_election_deadline()
             return {"term": self.log.term, "granted": granted}
 
+    def _handle_pre_vote(self, req: dict) -> dict:
+        """Pre-vote answer: NO state mutation (term, voted_for, deadline
+        all untouched). Granted only when (a) we ourselves have not heard
+        a leader within the MINIMUM election timeout (gating on the
+        randomized deadline would refuse the first legitimate candidate
+        after a leader death and chain refusal rounds) and (b) the
+        candidate's term+log could win."""
+        with self.lock:
+            lo_s = self._election_timeout_ms[0] / 1000.0
+            leader_fresh = self.state == LEADER or \
+                (time.monotonic() - self._last_leader_contact) < lo_s
+            if req["term"] < self.log.term or leader_fresh:
+                return {"term": self.log.term, "granted": False}
+            last_idx = self.log.last_index
+            last_term = self.log.term_at(
+                last_idx, snapshot_term=self.snapshot_term)
+            granted = (req["last_log_term"], req["last_log_index"]) >= \
+                (last_term, last_idx)
+            return {"term": self.log.term, "granted": granted}
+
     def handle_append_entries(self, req: dict) -> dict:
         with self.lock:
             if req["term"] < self.log.term:
                 return {"term": self.log.term, "success": False}
             self._become_follower(req["term"], req["leader_id"])
             self._reset_election_deadline()
+            self._last_leader_contact = time.monotonic()
             prev_i, prev_t = req["prev_index"], req["prev_term"]
             if prev_i >= self.log.start_index - 1 or prev_i == 0:
                 local_prev = self.log.term_at(
@@ -624,6 +702,7 @@ class RaftNode:
             if req["term"] < self.log.term:
                 return {"term": self.log.term, "ok": False}
             self._become_follower(req["term"], req["leader_id"])
+            self._last_leader_contact = time.monotonic()
             snap = req["snapshot"]
             if snap["index"] <= self.applied_index:
                 return {"term": self.log.term, "ok": True,
@@ -802,7 +881,7 @@ class RaftNode:
                         # take one, then retry with it available
                         self.take_snapshot()
                         continue
-                    resp = _peer_call(addr, "install_snapshot", {
+                    resp = self.transport(addr, "install_snapshot", {
                         "term": term, "leader_id": self.node_id,
                         "snapshot": payload}, timeout=10.0)
                     with self.lock:
@@ -813,7 +892,7 @@ class RaftNode:
                             self.match_index[nid] = payload["index"]
                             self.next_index[nid] = payload["index"] + 1
                     continue
-                resp = _peer_call(addr, "append_entries", {
+                resp = self.transport(addr, "append_entries", {
                     "term": term, "leader_id": self.node_id,
                     "prev_index": prev, "prev_term": prev_term,
                     "records": recs, "leader_commit": commit,

@@ -308,8 +308,13 @@ class FileSystemMaster:
                     owner: str = "", group: str = "",
                     replication_min: int = 0, replication_max: int = -1,
                     cacheable: bool = True,
-                    persist_on_complete: bool = False) -> FileInfo:
-        """Reference: ``DefaultFileSystemMaster.createFile:1463``."""
+                    persist_on_complete: bool = False,
+                    overwrite: bool = False) -> FileInfo:
+        """Reference: ``DefaultFileSystemMaster.createFile:1463``.
+        ``overwrite=True`` atomically replaces an existing FILE (delete +
+        create under one tree write lock — the POSIX/fsspec 'wb'
+        truncate contract, server-side so no client delete/create race);
+        an existing directory still raises."""
         uri = AlluxioURI(path)
         if uri.is_root():
             raise InvalidPathError("cannot create root")
@@ -317,6 +322,10 @@ class FileSystemMaster:
         block_size = block_size_bytes or self._default_block_size
         with self.inode_tree.lock.write_locked():
             lookup = self.inode_tree.lookup(uri)
+            if lookup.exists and overwrite and not \
+                    lookup.inode.is_directory:
+                self.delete(uri)  # reentrant write lock: atomic replace
+                lookup = self.inode_tree.lookup(uri)
             if lookup.exists:
                 raise FileAlreadyExistsError(f"{uri} already exists")
             self._check_parent_write(lookup)

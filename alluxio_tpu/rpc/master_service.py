@@ -98,7 +98,8 @@ def fs_master_service(fsm: FileSystemMaster,
         replication_min=r.get("replication_min", 0),
         replication_max=r.get("replication_max", -1),
         cacheable=r.get("cacheable", True),
-        persist_on_complete=r.get("persist_on_complete", False)).to_wire())
+        persist_on_complete=r.get("persist_on_complete", False),
+        overwrite=r.get("overwrite", False)).to_wire())
     u("create_directory", lambda r: fsm.create_directory(
         r["path"], recursive=r.get("recursive", True),
         allow_exists=r.get("allow_exists", False),

@@ -60,6 +60,23 @@ class TestBasics:
         afs.rm("/a", recursive=True)
         assert not afs.exists("/a")
 
+    def test_rm_glob(self, afs):
+        """Base-class glob expansion must keep working through _rm."""
+        afs.pipe_file("/g/a.tmp", b"1")
+        afs.pipe_file("/g/b.tmp", b"2")
+        afs.pipe_file("/g/keep.dat", b"3")
+        afs.rm("/g/*.tmp")
+        assert afs.ls("/g", detail=False) == ["g/keep.dat"]
+
+    def test_overwrite_wb(self, afs):
+        """fsspec 'wb' truncates existing files (server-side replace)."""
+        afs.pipe_file("/ow", b"old content")
+        with afs.open("/ow", "wb") as f:
+            f.write(b"new")
+        assert afs.cat_file("/ow") == b"new"
+        afs.pipe_file("/ow", b"newer")  # pipe_file overwrites too
+        assert afs.cat_file("/ow") == b"newer"
+
     def test_ranged_read(self, afs):
         afs.pipe_file("/r", bytes(range(100)))
         assert afs.cat_file("/r", start=10, end=20) == bytes(range(10, 20))

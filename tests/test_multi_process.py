@@ -108,6 +108,11 @@ class TestMultiProcess:
             from alluxio_tpu.rpc.clients import BlockMasterClient
 
             bc = BlockMasterClient(c.master_addresses)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if len(bc.get_worker_infos()) == 1:
+                    break
+                time.sleep(0.2)
             assert len(bc.get_worker_infos()) == 1
             c.workers[0].kill()
             deadline = time.monotonic() + 30

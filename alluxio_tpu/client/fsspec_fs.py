@@ -108,6 +108,12 @@ class AlluxioTpuFileSystem(AbstractFileSystem):
 
     protocol = ("atpu", "alluxio")
     root_marker = "/"
+    #: no instance caching: a cached instance outlives close() (strong
+    #: ref in the class cache -> callers get a closed filesystem back),
+    #: and injected ``fs=`` kwargs tokenize via str() where CPython id
+    #: reuse can collide across clusters. Construction cost is one
+    #: client; owned clients are closed by the weakref finalizer.
+    cachable = False
 
     def __init__(self, master: Optional[str] = None, *, fs=None,
                  conf=None, write_type: Optional[str] = None,

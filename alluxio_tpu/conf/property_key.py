@@ -307,6 +307,11 @@ class Keys:
         description="Run the master fault-tolerant: file-lock election on "
                     "the shared journal dir, standby tailing until primacy.")
     MASTER_WEB_PORT = _k("atpu.master.web.port", KeyType.INT, default=19999)
+    MASTER_WEB_ENABLED = _k(
+        "atpu.master.web.enabled", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="Serve the read-only HTTP/JSON state endpoint "
+                    "(reference: AlluxioMasterRestServiceHandler).")
     MASTER_JOURNAL_TYPE = _k("atpu.master.journal.type", KeyType.ENUM,
                              default="LOCAL", choices=("LOCAL", "UFS", "EMBEDDED", "NOOP"),
                              scope=Scope.MASTER)
@@ -545,6 +550,21 @@ class Keys:
                     "client start (reference: meta_master.proto:196-211).")
     USER_CONF_SYNC_INTERVAL = _k("atpu.user.conf.sync.interval", KeyType.DURATION,
                                  default="1min", scope=Scope.CLIENT)
+    METRICS_SINKS = _k(
+        "atpu.metrics.sinks", KeyType.STRING, default="",
+        scope=Scope.ALL,
+        description="Comma-separated metric sinks to start (console, "
+                    "csv, jsonl) — reference: metrics/sink/*Sink.java.")
+    METRICS_SINK_INTERVAL = _k(
+        "atpu.metrics.sink.interval", KeyType.DURATION, default="10s",
+        scope=Scope.ALL)
+    METRICS_SINK_CSV_DIR = _k(
+        "atpu.metrics.sink.csv.dir", KeyType.STRING,
+        default="/tmp/atpu-metrics", scope=Scope.ALL,
+        description="Directory for the CSV sink (one file per metric).")
+    METRICS_SINK_JSONL_PATH = _k(
+        "atpu.metrics.sink.jsonl.path", KeyType.STRING,
+        default="/tmp/atpu-metrics/metrics.jsonl", scope=Scope.ALL)
     USER_METRICS_COLLECTION_ENABLED = _k(
         "atpu.user.metrics.collection.enabled", KeyType.BOOL, default=False,
         scope=Scope.CLIENT,

@@ -27,6 +27,8 @@ class HeartbeatContext:
     MASTER_DAILY_BACKUP = "Master.DailyBackup"
     MASTER_JOURNAL_SPACE_MONITOR = "Master.JournalSpaceMonitor"
     MASTER_TABLE_TRANSFORM_MONITOR = "Master.TableTransformMonitor"
+    MASTER_METRICS_SINKS = "Master.MetricsSinks"
+    WORKER_METRICS_SINKS = "Worker.MetricsSinks"
     WORKER_BLOCK_SYNC = "Worker.BlockSync"
     WORKER_PIN_LIST_SYNC = "Worker.PinListSync"
     WORKER_STORAGE_HEALTH = "Worker.StorageHealth"

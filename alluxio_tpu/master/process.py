@@ -127,6 +127,8 @@ class MasterProcess:
         self._root_ufs_uri = root_ufs_uri or conf.get(Keys.HOME) + \
             "/underFSStorage"
         self.rpc_server: Optional[RpcServer] = None
+        self.web_server = None
+        self.web_port: Optional[int] = None
         self._threads: List[HeartbeatThread] = []
         self.cluster_id = str(uuid.uuid4())
         self.start_time_ms = 0
@@ -187,6 +189,12 @@ class MasterProcess:
             permission_checker=self.permission_checker,
             metrics_master=self.metrics_master))
         self.rpc_port = self.rpc_server.start()
+        if self._conf.get_bool(Keys.MASTER_WEB_ENABLED):
+            from alluxio_tpu.master.web import MasterWebServer
+
+            self.web_server = MasterWebServer(
+                self, port=self._conf.get_int(Keys.MASTER_WEB_PORT))
+            self.web_port = self.web_server.start()
         return self.rpc_port
 
     def _start_heartbeats(self) -> None:
@@ -223,6 +231,17 @@ class MasterProcess:
                 _Exec(self.ufs_cleaner.heartbeat),
                 conf.get_duration_s(Keys.MASTER_UFS_CLEANUP_INTERVAL)),
         ]
+        from alluxio_tpu.metrics import metrics as _metrics
+        from alluxio_tpu.metrics.sinks import SinkManager
+
+        self.sink_manager = SinkManager(conf, _metrics())
+        if self.sink_manager.sinks:
+            # the manager itself is the executor (heartbeat + close), so
+            # sinks are closed on thread shutdown — same shape as the
+            # worker side
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.MASTER_METRICS_SINKS, self.sink_manager,
+                conf.get_duration_s(Keys.METRICS_SINK_INTERVAL)))
         for t in self._threads:
             t.start()
 
@@ -266,6 +285,8 @@ class MasterProcess:
     def stop(self) -> None:
         for t in self._threads:
             t.stop()
+        if getattr(self, "web_server", None) is not None:
+            self.web_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if getattr(self, "audit_writer", None) is not None:

@@ -1,0 +1,131 @@
+"""Read-only HTTP/JSON state endpoint for the master.
+
+Re-design of ``core/server/master/src/main/java/alluxio/master/meta/
+AlluxioMasterRestServiceHandler.java`` (the web UI's backing REST API)
+as a stdlib HTTP server: everything ``fsadmin report`` prints, curl-able.
+
+Routes:
+  GET /api/v1/master/info      cluster id, uptime, safe mode, version
+  GET /api/v1/master/capacity  per-tier capacity/used + worker list
+  GET /api/v1/master/metrics   flat metrics snapshot (JSON)
+  GET /api/v1/master/mounts    mount table
+  GET /api/v1/master/catalog   table-service databases/tables
+  GET /metrics                 Prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+LOG = logging.getLogger(__name__)
+
+
+class MasterWebServer:
+    def __init__(self, master_process, port: int = 0,
+                 bind_host: str = "0.0.0.0") -> None:
+        self._mp = master_process
+        mp = master_process
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet: route to logger
+                LOG.debug("web: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                try:
+                    route = self.path.split("?", 1)[0].rstrip("/")
+                    if route == "/metrics":
+                        from alluxio_tpu.metrics import metrics
+
+                        body = metrics().to_prometheus().encode()
+                        self._send(200, body, "text/plain; version=0.0.4")
+                        return
+                    payload = self._route(route)
+                    if payload is None:
+                        self._send(404, json.dumps(
+                            {"error": f"no route {route}"}).encode(),
+                            "application/json")
+                        return
+                    self._send(200, json.dumps(
+                        payload, sort_keys=True, default=str).encode(),
+                        "application/json")
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    LOG.warning("web handler failed", exc_info=True)
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self, route: str):
+                if route == "/api/v1/master/info":
+                    import time as _time
+
+                    return {
+                        "cluster_id": mp.cluster_id,
+                        "start_time_ms": mp.start_time_ms,
+                        "uptime_ms": max(0, int(_time.time() * 1000)
+                                         - mp.start_time_ms),
+                        "safe_mode": mp.in_safe_mode(),
+                        "rpc_port": mp.rpc_port,
+                        "live_workers": len(
+                            mp.block_master.get_worker_infos()),
+                    }
+                if route == "/api/v1/master/capacity":
+                    workers = mp.block_master.get_worker_infos(
+                        include_lost=True)
+                    return {
+                        "capacity": mp.block_master.capacity_bytes_on_tiers(),
+                        "used": mp.block_master.used_bytes_on_tiers(),
+                        "workers": [{
+                            "id": w.id,
+                            "host": w.address.host,
+                            "state": w.state,
+                            "capacity": dict(w.capacity_bytes_on_tiers),
+                            "used": dict(w.used_bytes_on_tiers),
+                        } for w in workers],
+                    }
+                if route == "/api/v1/master/metrics":
+                    from alluxio_tpu.metrics import metrics
+
+                    snap = metrics().snapshot()
+                    mm = getattr(mp, "metrics_master", None)
+                    if mm is not None:
+                        snap = mm.merged_snapshot(snap)
+                    return {"metrics": snap}
+                if route == "/api/v1/master/mounts":
+                    return {"mounts": [
+                        {"path": m.alluxio_path, "ufs": m.ufs_uri,
+                         "read_only": m.read_only}
+                        for m in
+                        mp.fs_master.mount_table.mount_points()]}
+                if route == "/api/v1/master/catalog":
+                    tm = mp.table_master
+                    return {"databases": {
+                        db: tm.list_tables(db)
+                        for db in tm.list_databases()}}
+                return None
+
+        self._server = ThreadingHTTPServer((bind_host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="master-web", daemon=True)
+        self._thread.start()
+        LOG.info("master web endpoint on port %d", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

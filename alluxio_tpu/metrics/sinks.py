@@ -1,0 +1,141 @@
+"""Metrics sinks: periodic export of registry snapshots.
+
+Re-design of ``core/common/src/main/java/alluxio/metrics/sink/
+{Sink,ConsoleSink,CsvSink,Slf4jSink}.java`` (Graphite/JMX have no
+environment analogue here; the JSON-lines sink is the modern structured
+equivalent): a sink receives the flat snapshot each scheduler tick and
+writes it somewhere durable/visible. Sinks are configured by name
+(``atpu.metrics.sinks=csv,jsonl,console``) and driven by one heartbeat.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+LOG = logging.getLogger(__name__)
+
+
+class Sink:
+    """SPI (reference: ``metrics/sink/Sink.java``)."""
+
+    def report(self, snapshot: Dict[str, float]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ConsoleSink(Sink):
+    def __init__(self, stream=None) -> None:
+        self._stream = stream or sys.stderr
+
+    def report(self, snapshot: Dict[str, float]) -> None:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S")
+        print(f"-- metrics @ {ts} " + "-" * 40, file=self._stream)
+        for name, value in sorted(snapshot.items()):
+            print(f"{name} = {value}", file=self._stream)
+        self._stream.flush()
+
+
+class CsvSink(Sink):
+    """One CSV file per metric under ``directory``, appending
+    ``epoch_seconds,value`` rows (reference: CsvSink's per-metric file
+    layout, the format Graphite/pandas ingest directly)."""
+
+    def __init__(self, directory: str) -> None:
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def report(self, snapshot: Dict[str, float]) -> None:
+        now = int(time.time())
+        for name, value in snapshot.items():
+            safe = name.replace("/", "_")
+            path = os.path.join(self._dir, f"{safe}.csv")
+            is_new = not os.path.exists(path)
+            try:
+                with open(path, "a") as f:
+                    if is_new:
+                        f.write("t,value\n")
+                    f.write(f"{now},{value}\n")
+            except OSError:  # disk pressure: skip this tick
+                LOG.debug("csv sink write failed for %s", name,
+                          exc_info=True)
+
+
+class JsonLinesSink(Sink):
+    """One JSON object per tick appended to ``path`` — the structured
+    log shape every modern collector tails."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def report(self, snapshot: Dict[str, float]) -> None:
+        line = json.dumps({"ts": round(time.time(), 3),
+                           "metrics": snapshot}, sort_keys=True)
+        try:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            LOG.debug("jsonl sink write failed", exc_info=True)
+
+
+class SinkManager:
+    """Builds sinks from config and reports on a heartbeat tick
+    (reference: MetricsSystem's sink scheduling)."""
+
+    def __init__(self, conf, registry) -> None:
+        from alluxio_tpu.conf import Keys
+
+        self._registry = registry
+        self.sinks: List[Sink] = []
+        names = [s.strip() for s in
+                 (conf.get(Keys.METRICS_SINKS) or "").split(",")
+                 if s.strip()]
+        # the host-global DEFAULT paths get a per-process namespace:
+        # two processes appending the same file would interleave rows
+        # and race the CSV header; an EXPLICITLY configured path is the
+        # operator's call and is honored verbatim
+        me = f"{registry.instance.lower()}-{os.getpid()}"
+        for name in names:
+            if name == "console":
+                self.sinks.append(ConsoleSink())
+            elif name == "csv":
+                d = conf.get(Keys.METRICS_SINK_CSV_DIR)
+                if d == Keys.METRICS_SINK_CSV_DIR.default:
+                    d = os.path.join(d, me)
+                self.sinks.append(CsvSink(d))
+            elif name == "jsonl":
+                p = conf.get(Keys.METRICS_SINK_JSONL_PATH)
+                if p == Keys.METRICS_SINK_JSONL_PATH.default:
+                    root, ext = os.path.splitext(p)
+                    p = f"{root}.{me}{ext}"
+                self.sinks.append(JsonLinesSink(p))
+            else:
+                LOG.warning("unknown metrics sink %r (known: console, "
+                            "csv, jsonl)", name)
+
+    def heartbeat(self) -> None:
+        if not self.sinks:
+            return
+        snapshot = self._registry.snapshot()
+        for sink in self.sinks:
+            try:
+                sink.report(snapshot)
+            except Exception:  # noqa: BLE001 one sink must not kill others
+                LOG.warning("metrics sink %s failed",
+                            type(sink).__name__, exc_info=True)
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass

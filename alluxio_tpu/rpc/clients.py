@@ -256,6 +256,13 @@ class MetaMasterClient(_BaseClient):
     def get_metrics(self) -> Dict[str, float]:
         return self._call("get_metrics", {})["metrics"]
 
+    def set_log_level(self, level: str, logger: str = "") -> dict:
+        return self._call("set_log_level", {"logger": logger,
+                                            "level": level})
+
+    def get_log_level(self, logger: str = "") -> dict:
+        return self._call("get_log_level", {"logger": logger})
+
     def set_path_conf(self, path: str, properties: Dict[str, str]) -> None:
         self._call("set_path_conf", {"path": str(path),
                                      "properties": properties})

@@ -216,6 +216,35 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
     svc.unary("get_master_info", lambda r: {
         "cluster_id": cluster_id, "start_time_ms": start_time_ms,
         "safe_mode": bool(safe_mode_fn())})
+
+    def _set_log_level(r):
+        """Runtime log-level control (reference:
+        ``shell/src/main/java/alluxio/cli/LogLevel.java`` — the logLevel
+        CLI flips log4j levels over the web port at runtime)."""
+        import logging as _logging
+
+        _require_admin()
+        name = r.get("logger") or ""
+        level = r["level"].upper()
+        if level not in ("DEBUG", "INFO", "WARNING", "WARN", "ERROR",
+                         "CRITICAL", "NOTSET"):
+            from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+            raise InvalidArgumentError(f"unknown log level {level!r}")
+        level = "WARNING" if level == "WARN" else level
+        _logging.getLogger(name or None).setLevel(level)
+        return {"logger": name or "root", "level": level}
+
+    def _get_log_level(r):
+        import logging as _logging
+
+        logger = _logging.getLogger(r.get("logger") or None)
+        return {"logger": logger.name,
+                "level": _logging.getLevelName(
+                    logger.getEffectiveLevel())}
+
+    svc.unary("set_log_level", _set_log_level)
+    svc.unary("get_log_level", _get_log_level)
     def _get_metrics(r):
         snap = metrics().snapshot()
         if metrics_master is not None:

@@ -273,5 +273,29 @@ class MetricsCommand(Command):
         return 0
 
 
+@ADMIN_SHELL.register
+class LogLevelCommand(Command):
+    name = "logLevel"
+    description = ("Get or set the master's runtime log level "
+                   "(reference: cli/LogLevel.java).")
+
+    def configure(self, p):
+        p.add_argument("--logName", default="",
+                       help="logger name (default: root)")
+        p.add_argument("--level", default="",
+                       help="new level (DEBUG/INFO/WARNING/ERROR); "
+                            "omit to read the current level")
+
+    def run(self, args, ctx):
+        mc = ctx.meta_client()
+        if args.level:
+            resp = mc.set_log_level(args.level, logger=args.logName)
+            ctx.print(f"{resp['logger']} -> {resp['level']}")
+        else:
+            resp = mc.get_log_level(args.logName)
+            ctx.print(f"{resp['logger']} = {resp['level']}")
+        return 0
+
+
 def main(argv=None) -> int:
     return ADMIN_SHELL.run(sys.argv[1:] if argv is None else argv)

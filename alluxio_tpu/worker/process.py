@@ -189,6 +189,14 @@ class BlockWorker:
             self._threads.append(
                 HeartbeatThread(HeartbeatContext.WORKER_PIN_LIST_SYNC,
                                 self._pin_sync, hb_interval))
+        from alluxio_tpu.metrics import metrics as _metrics
+        from alluxio_tpu.metrics.sinks import SinkManager
+
+        self.sink_manager = SinkManager(self._conf, _metrics())
+        if self.sink_manager.sinks:
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.WORKER_METRICS_SINKS, self.sink_manager,
+                self._conf.get_duration_s(Keys.METRICS_SINK_INTERVAL)))
         for t in self._threads:
             t.start()
         self._started = True

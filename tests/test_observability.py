@@ -1,0 +1,162 @@
+"""Observability surface tests: metrics sinks, runtime log-level RPC,
+read-only HTTP state endpoint (reference: ``metrics/sink/*Sink.java``,
+``cli/LogLevel.java``, ``meta/AlluxioMasterRestServiceHandler.java``)."""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.metrics.registry import MetricsRegistry
+from alluxio_tpu.metrics.sinks import (
+    ConsoleSink, CsvSink, JsonLinesSink, SinkManager,
+)
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+
+@pytest.fixture()
+def registry():
+    r = MetricsRegistry("Master")
+    r.counter("Master.TestOps").inc(7)
+    r.register_gauge("Master.TestGauge", lambda: 3.5)
+    return r
+
+
+class TestSinks:
+    def test_csv_sink_one_file_per_metric(self, registry, tmp_path):
+        sink = CsvSink(str(tmp_path / "csv"))
+        sink.report(registry.snapshot())
+        sink.report(registry.snapshot())
+        f = tmp_path / "csv" / "Master.TestOps.csv"
+        assert f.exists()
+        lines = f.read_text().strip().splitlines()
+        assert lines[0] == "t,value"
+        assert len(lines) == 3  # header + 2 reports
+        assert lines[1].split(",")[1] == "7"
+
+    def test_jsonl_sink(self, registry, tmp_path):
+        path = tmp_path / "m.jsonl"
+        sink = JsonLinesSink(str(path))
+        sink.report(registry.snapshot())
+        rec = json.loads(path.read_text().strip())
+        assert rec["metrics"]["Master.TestOps"] == 7
+        assert rec["metrics"]["Master.TestGauge"] == 3.5
+        assert rec["ts"] > 0
+
+    def test_console_sink(self, registry):
+        import io
+
+        buf = io.StringIO()
+        ConsoleSink(stream=buf).report(registry.snapshot())
+        assert "Master.TestOps = 7" in buf.getvalue()
+
+    def test_manager_from_conf(self, registry, tmp_path, conf):
+        conf.set(Keys.METRICS_SINKS, "csv,jsonl,bogus")
+        conf.set(Keys.METRICS_SINK_CSV_DIR, str(tmp_path / "csv"))
+        conf.set(Keys.METRICS_SINK_JSONL_PATH, str(tmp_path / "m.jsonl"))
+        mgr = SinkManager(conf, registry)
+        assert len(mgr.sinks) == 2  # bogus skipped with a warning
+        mgr.heartbeat()
+        assert (tmp_path / "csv" / "Master.TestOps.csv").exists()
+        assert (tmp_path / "m.jsonl").exists()
+
+    def test_failing_sink_does_not_kill_others(self, registry, tmp_path):
+        class Boom(ConsoleSink):
+            def report(self, snapshot):
+                raise RuntimeError("boom")
+
+        mgr = SinkManager.__new__(SinkManager)
+        mgr._registry = registry
+        path = tmp_path / "ok.jsonl"
+        mgr.sinks = [Boom(), JsonLinesSink(str(path))]
+        mgr.heartbeat()
+        assert path.exists()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1,
+                      conf_overrides={Keys.MASTER_WEB_ENABLED: True,
+                                      Keys.MASTER_WEB_PORT: 0}) as c:
+        yield c
+
+
+def _get(cluster, route):
+    url = f"http://127.0.0.1:{cluster.master.web_port}{route}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+class TestWebEndpoint:
+    def test_master_info(self, cluster):
+        code, body = _get(cluster, "/api/v1/master/info")
+        assert code == 200
+        info = json.loads(body)
+        assert info["cluster_id"]
+        assert info["live_workers"] == 1
+        assert info["rpc_port"] == cluster.master.rpc_port
+
+    def test_capacity_and_mounts(self, cluster):
+        code, body = _get(cluster, "/api/v1/master/capacity")
+        cap = json.loads(body)
+        assert code == 200
+        assert cap["capacity"].get("MEM", 0) > 0
+        assert len(cap["workers"]) == 1
+        code, body = _get(cluster, "/api/v1/master/mounts")
+        mounts = json.loads(body)["mounts"]
+        assert any(m["path"] == "/" for m in mounts)
+
+    def test_metrics_json_and_prometheus(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/obs", b"x" * 100)
+        code, body = _get(cluster, "/api/v1/master/metrics")
+        assert code == 200
+        assert json.loads(body)["metrics"]
+        code, body = _get(cluster, "/metrics")
+        assert code == 200
+        assert b" " in body  # prometheus text lines "name value"
+
+    def test_catalog_route_and_404(self, cluster):
+        code, body = _get(cluster, "/api/v1/master/catalog")
+        assert code == 200
+        assert json.loads(body)["databases"] == {}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(cluster, "/api/v1/nope")
+        assert ei.value.code == 404
+
+
+class TestLogLevel:
+    def test_get_and_set_roundtrip(self, cluster):
+        mc = cluster.meta_client()
+        target = "alluxio_tpu.test.obs"
+        resp = mc.set_log_level("DEBUG", logger=target)
+        assert resp == {"logger": target, "level": "DEBUG"}
+        assert logging.getLogger(target).level == logging.DEBUG
+        assert mc.get_log_level(target)["level"] == "DEBUG"
+        mc.set_log_level("WARN", logger=target)
+        assert logging.getLogger(target).level == logging.WARNING
+
+    def test_bad_level_rejected(self, cluster):
+        from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+        with pytest.raises(InvalidArgumentError):
+            cluster.meta_client().set_log_level("LOUD")
+
+    def test_shell_command(self, cluster):
+        import io
+
+        from alluxio_tpu.shell.command import ShellContext
+        from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+
+        conf = cluster.conf.copy()
+        conf.set(Keys.MASTER_HOSTNAME, "localhost")
+        conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+        out = io.StringIO()
+        code = ADMIN_SHELL.run(
+            ["logLevel", "--logName", "atpu.shell.test",
+             "--level", "ERROR"], ShellContext(conf, out=out))
+        assert code == 0
+        assert "atpu.shell.test -> ERROR" in out.getvalue()
+        assert logging.getLogger("atpu.shell.test").level == logging.ERROR

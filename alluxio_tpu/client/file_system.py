@@ -264,8 +264,12 @@ class FileSystem:
 
     # ----------------------------------------------------------------- data
     def open_file(self, path: "str | AlluxioURI", *,
-                  cache: Optional[bool] = None) -> FileInStream:
-        info = self.get_status(path)
+                  cache: Optional[bool] = None,
+                  info: Optional[FileInfo] = None) -> FileInStream:
+        """``info``: a FileInfo the caller already holds (skips the
+        get_status round-trip — the loader's first-batch path)."""
+        if info is None:
+            info = self.get_status(path)
         if info.folder:
             from alluxio_tpu.utils.exceptions import InvalidArgumentError
 

@@ -550,6 +550,46 @@ class Keys:
                     "client start (reference: meta_master.proto:196-211).")
     USER_CONF_SYNC_INTERVAL = _k("atpu.user.conf.sync.interval", KeyType.DURATION,
                                  default="1min", scope=Scope.CLIENT)
+    PROXY_WEB_PORT = _k(
+        "atpu.proxy.web.port", KeyType.INT, default=39999,
+        scope=Scope.SERVER,
+        description="Port for the REST/S3 proxy process (reference: "
+                    "proxy/AlluxioProxy.java).")
+    LOGSERVER_PORT = _k(
+        "atpu.logserver.port", KeyType.INT, default=45600,
+        scope=Scope.ALL,
+        description="Port of the centralized log server (reference: "
+                    "logserver/AlluxioLogServer.java).")
+    LOGSERVER_HOSTNAME = _k(
+        "atpu.logserver.hostname", KeyType.STRING, default="",
+        scope=Scope.ALL,
+        description="When set, processes ship their log records to this "
+                    "log server.")
+    LOGSERVER_LOGS_DIR = _k(
+        "atpu.logserver.logs.dir", KeyType.STRING,
+        default="/var/log/alluxio-tpu", scope=Scope.SERVER)
+    LOGSERVER_BIND_HOST = _k(
+        "atpu.logserver.bind.host", KeyType.STRING, default="127.0.0.1",
+        scope=Scope.SERVER,
+        description="Bind address for the log server; the record stream "
+                    "carries no authentication, so the default is "
+                    "loopback.")
+    MASTER_WEB_BIND_HOST = _k(
+        "atpu.master.web.bind.host", KeyType.STRING, default="0.0.0.0",
+        scope=Scope.MASTER,
+        description="Bind address for the read-only master web/REST "
+                    "endpoint.")
+    PROXY_BIND_HOST = _k(
+        "atpu.proxy.bind.host", KeyType.STRING, default="127.0.0.1",
+        scope=Scope.SERVER,
+        description="Bind address for the S3 proxy. The S3 dialect "
+                    "carries no authentication, so the default is "
+                    "loopback; set 0.0.0.0 only behind a trusted "
+                    "network boundary.")
+    PROXY_S3_ROOT = _k(
+        "atpu.proxy.s3.root", KeyType.STRING, default="/s3",
+        scope=Scope.SERVER,
+        description="Namespace directory whose children are S3 buckets.")
     METRICS_SINKS = _k(
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,

@@ -11,9 +11,10 @@ def register_builtin_plans(registry) -> None:
     from alluxio_tpu.job.plans.replicate import (
         EvictDefinition, MoveDefinition, ReplicateDefinition,
     )
+    from alluxio_tpu.job.plans.stressbench import StressBenchDefinition
     from alluxio_tpu.job.plans.transform import TransformDefinition
 
     for plan in (LoadDefinition(), MigrateDefinition(), PersistDefinition(),
                  ReplicateDefinition(), EvictDefinition(), MoveDefinition(),
-                 TransformDefinition()):
+                 TransformDefinition(), StressBenchDefinition()):
         registry.register(plan)

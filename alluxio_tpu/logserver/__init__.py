@@ -1,0 +1,7 @@
+"""Centralized log aggregation (reference: ``logserver/``)."""
+
+from alluxio_tpu.logserver.process import (
+    LogServerProcess, enable_remote_logging,
+)
+
+__all__ = ["LogServerProcess", "enable_remote_logging"]

@@ -193,7 +193,8 @@ class MasterProcess:
             from alluxio_tpu.master.web import MasterWebServer
 
             self.web_server = MasterWebServer(
-                self, port=self._conf.get_int(Keys.MASTER_WEB_PORT))
+                self, port=self._conf.get_int(Keys.MASTER_WEB_PORT),
+                bind_host=self._conf.get(Keys.MASTER_WEB_BIND_HOST))
             self.web_port = self.web_server.start()
         return self.rpc_port
 

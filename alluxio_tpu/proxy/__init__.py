@@ -1,0 +1,5 @@
+"""REST/S3 proxy: S3-compatible HTTP access to the namespace."""
+
+from alluxio_tpu.proxy.process import ProxyProcess
+
+__all__ = ["ProxyProcess"]

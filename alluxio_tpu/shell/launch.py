@@ -123,12 +123,34 @@ def launch_proxy(conf: Configuration) -> int:
         proc.stop, f"alluxio-tpu proxy serving on port {port}")
 
 
+def launch_logserver(conf: Configuration) -> int:
+    from alluxio_tpu.logserver import LogServerProcess
+
+    proc = LogServerProcess(conf.get(Keys.LOGSERVER_LOGS_DIR),
+                            port=conf.get_int(Keys.LOGSERVER_PORT),
+                            bind_host=conf.get(Keys.LOGSERVER_BIND_HOST))
+    port = proc.start()
+    return _serve_until_signal(
+        proc.stop, f"alluxio-tpu log server on port {port}")
+
+
+def maybe_enable_remote_logging(conf: Configuration) -> None:
+    """Every role calls this: ships records to the log server when
+    atpu.logserver.hostname is configured."""
+    host = conf.get(Keys.LOGSERVER_HOSTNAME)
+    if host:
+        from alluxio_tpu.logserver import enable_remote_logging
+
+        enable_remote_logging(host, conf.get_int(Keys.LOGSERVER_PORT))
+
+
 _LAUNCHERS = {
     "master": launch_master,
     "worker": launch_worker,
     "job-master": launch_job_master,
     "job-worker": launch_job_worker,
     "proxy": launch_proxy,
+    "logserver": launch_logserver,
 }
 
 
@@ -136,4 +158,6 @@ def launch_process(role: str, conf: Configuration) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    if role != "logserver":
+        maybe_enable_remote_logging(conf)
     return _LAUNCHERS[role](conf)

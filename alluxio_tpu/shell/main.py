@@ -23,12 +23,14 @@ Commands:
   job        job service shell (ls/stat/cancel)
   table      table/catalog shell (attachdb/ls/sync/transform)
   stress     stress benchmark suite (worker/master/prefetch/table/write)
+  validateConf  sanity-check the effective configuration
   format     format master journal / worker storage
   master     run a master process
   worker     run a worker process
   job-master run a job master process
   job-worker run a job worker process
   proxy      run the REST/S3 proxy process
+  logserver  run the centralized log aggregation server
   version    print the version
 
 Generic options:
@@ -114,6 +116,10 @@ def main(argv=None) -> int:
         from alluxio_tpu.stress.__main__ import main as stress_main
 
         return stress_main(rest)
+    if cmd == "validateConf":
+        from alluxio_tpu.shell.validate import main as validate_main
+
+        return validate_main(rest, conf=conf)
     if cmd == "format":
         from alluxio_tpu.shell.format import main as format_main
 
@@ -123,7 +129,8 @@ def main(argv=None) -> int:
 
         print(getattr(alluxio_tpu, "__version__", "0.1.0"))
         return 0
-    if cmd in ("master", "worker", "job-master", "job-worker", "proxy"):
+    if cmd in ("master", "worker", "job-master", "job-worker", "proxy",
+               "logserver"):
         from alluxio_tpu.shell.launch import launch_process
 
         return launch_process(cmd, conf)

@@ -100,7 +100,13 @@ def run(*, op: str = "CreateFile", master: Optional[str] = None,
             errors=res.errors, duration_s=res.wall_s)
 
     if _reuse_fs is not None:
-        return _run(_reuse_fs)
+        try:  # live cluster: bench fixtures must not outlive the run
+            return _run(_reuse_fs)
+        finally:
+            try:
+                _reuse_fs.delete(base_path, recursive=True)
+            except Exception:  # noqa: BLE001 cleanup is best-effort
+                pass
     # metadata-only: tiny worker, tiny blocks (zero-byte files need no data)
     with bench_cluster(master, block_size=1 << 20,
                        worker_mem_bytes=64 << 20) as (fs, _cluster):

@@ -50,57 +50,84 @@ def run(*, mode: str = "random", master: Optional[str] = None,
         threads: int = 8, duration_s: float = 10.0,
         shard_bytes: int = 64 << 20, num_shards: int = 4,
         read_bytes: int = 4 << 10, base_path: str = "/stress-worker",
-        ) -> BenchResult:
-    from alluxio_tpu.client.streams import WriteType
-
-    rng = np.random.default_rng(0)
+        _reuse_fs=None) -> BenchResult:
+    """``_reuse_fs``: run against an existing cluster through this
+    FileSystem client (the distributed stressbench job plan's mode)."""
+    if _reuse_fs is not None:
+        # live-cluster mode: overwrite stale shards from a previous run
+        # and remove them afterwards — bench data must not occupy the
+        # production cache or fail the next run with AlreadyExists
+        try:
+            return _run_against(_reuse_fs, mode=mode, master=master,
+                                threads=threads, duration_s=duration_s,
+                                shard_bytes=shard_bytes,
+                                num_shards=num_shards,
+                                read_bytes=read_bytes,
+                                base_path=base_path)
+        finally:
+            try:
+                _reuse_fs.delete(base_path, recursive=True)
+            except Exception:  # noqa: BLE001 cleanup is best-effort
+                pass
     with bench_cluster(master, block_size=min(shard_bytes, 32 << 20),
                        worker_mem_bytes=shard_bytes * num_shards + (256 << 20)
                        ) as (fs, _cluster):
-        paths: List[str] = []
-        for i in range(num_shards):
-            p = f"{base_path}/shard-{i:05d}.tfrecord"
-            fs.write_all(p, make_tfrecord_shard(rng, shard_bytes),
-                         write_type=WriteType.MUST_CACHE)
-            paths.append(p)
+        return _run_against(fs, mode=mode, master=master,
+                            threads=threads, duration_s=duration_s,
+                            shard_bytes=shard_bytes,
+                            num_shards=num_shards, read_bytes=read_bytes,
+                            base_path=base_path)
 
-        n_offsets = shard_bytes // read_bytes
-        # per-thread streams: FileInStream is not thread-safe
-        ctxs = [([fs.open_file(p) for p in paths],
-                 np.random.default_rng(t)) for t in range(threads)]
 
-        if mode == "random":
-            def op(t: int, i: int) -> int:
-                streams, trng = ctxs[t]
-                s = streams[int(trng.integers(len(streams)))]
-                off = int(trng.integers(n_offsets)) * read_bytes
-                data = s.pread(off, read_bytes)
-                return len(data)
-        elif mode == "sequential":
-            chunk = 4 << 20
+def _run_against(fs, *, mode, master, threads, duration_s, shard_bytes,
+                 num_shards, read_bytes, base_path) -> BenchResult:
+    from alluxio_tpu.client.streams import WriteType
 
-            def op(t: int, i: int) -> int:
-                streams, _trng = ctxs[t]
-                s = streams[(t + i) % len(streams)]
-                pos = (i * chunk) % shard_bytes
-                data = s.pread(pos, chunk)
-                return len(data)
-        else:
-            raise ValueError(f"unknown mode {mode!r}")
+    rng = np.random.default_rng(0)
+    paths: List[str] = []
+    for i in range(num_shards):
+        p = f"{base_path}/shard-{i:05d}.tfrecord"
+        fs.write_all(p, make_tfrecord_shard(rng, shard_bytes),
+                     write_type=WriteType.MUST_CACHE, overwrite=True)
+        paths.append(p)
 
-        try:
-            res = drive(threads, op, duration_s=duration_s)
-        finally:
-            for streams, _trng in ctxs:
-                for s in streams:
-                    s.close()
-        return BenchResult(
-            bench=f"worker-{mode}",
-            params={"threads": threads, "duration_s": duration_s,
-                    "shard_bytes": shard_bytes, "num_shards": num_shards,
-                    "read_bytes": read_bytes if mode == "random" else 4 << 20,
-                    "master": master or "in-process"},
-            metrics={"ops_per_s": round(res.ops_per_s, 1),
-                     "mb_per_s": round(res.mb_per_s, 2),
-                     **percentiles(res.latencies_s)},
-            errors=res.errors, duration_s=res.wall_s)
+    n_offsets = shard_bytes // read_bytes
+    # per-thread streams: FileInStream is not thread-safe
+    ctxs = [([fs.open_file(p) for p in paths],
+             np.random.default_rng(t)) for t in range(threads)]
+
+    if mode == "random":
+        def op(t: int, i: int) -> int:
+            streams, trng = ctxs[t]
+            s = streams[int(trng.integers(len(streams)))]
+            off = int(trng.integers(n_offsets)) * read_bytes
+            data = s.pread(off, read_bytes)
+            return len(data)
+    elif mode == "sequential":
+        chunk = 4 << 20
+
+        def op(t: int, i: int) -> int:
+            streams, _trng = ctxs[t]
+            s = streams[(t + i) % len(streams)]
+            pos = (i * chunk) % shard_bytes
+            data = s.pread(pos, chunk)
+            return len(data)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    try:
+        res = drive(threads, op, duration_s=duration_s)
+    finally:
+        for streams, _trng in ctxs:
+            for s in streams:
+                s.close()
+    return BenchResult(
+        bench=f"worker-{mode}",
+        params={"threads": threads, "duration_s": duration_s,
+                "shard_bytes": shard_bytes, "num_shards": num_shards,
+                "read_bytes": read_bytes if mode == "random" else 4 << 20,
+                "master": master or "in-process"},
+        metrics={"ops_per_s": round(res.ops_per_s, 1),
+                 "mb_per_s": round(res.mb_per_s, 2),
+                 **percentiles(res.latencies_s)},
+        errors=res.errors, duration_s=res.wall_s)

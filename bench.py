@@ -75,26 +75,41 @@ def main() -> None:
                           worker_mem_bytes=total_bytes + (256 << 20)) as cluster:
             fs = cluster.file_system()
             rng = np.random.default_rng(0)
-            payload = rng.integers(0, 255, size=BLOCK_BYTES,
-                                   dtype=np.uint8).tobytes()
+            # DISTINCT content per shard: the tunnel dedupes repeated
+            # buffers, so identical shards would make every transfer
+            # after the first a cache hit and inflate h2d several-fold
+            payloads = [rng.integers(0, 255, size=BLOCK_BYTES,
+                                     dtype=np.uint8).tobytes()
+                        for _ in range(NUM_BLOCKS)]
+            payload = payloads[0]
             t0 = time.monotonic()
             for i in range(NUM_BLOCKS):
-                fs.write_all(f"/bench/shard-{i}", payload,
+                fs.write_all(f"/bench/shard-{i}", payloads[i],
                              write_type=WriteType.MUST_CACHE)
             log(f"cold write: {total_bytes / (time.monotonic() - t0) / 1e9:.2f} GB/s")
 
             # -- raw tunnel h2d ceiling (environment baseline) -------------
+            # DISTINCT source arrays per put: re-putting one buffer can
+            # be served from a transfer cache, inflating the "ceiling"
+            # the loader is judged against (observed 3x inflation)
             probe = np.frombuffer(payload, dtype=np.int32)
+            prng = np.random.default_rng(99)
+
+            def fresh_probe():
+                return prng.integers(0, 1 << 30, size=BLOCK_BYTES // 4,
+                                     dtype=np.int32)
+
+            probes = [fresh_probe() for _ in range(4)]
             jax.device_put(probe, device).block_until_ready()  # warm path
             t0 = time.monotonic()
-            raw_burst = jax.device_put(probe, device)
+            raw_burst = jax.device_put(probes[0], device)
             raw_burst.block_until_ready()
             burst_gbps = BLOCK_BYTES / (time.monotonic() - t0) / 1e9
             t0 = time.monotonic()
-            raws = [jax.device_put(probe, device) for _ in range(4)]
+            raws = [jax.device_put(p, device) for p in probes]
             jax.block_until_ready(raws)
             sustained_gbps = 4 * BLOCK_BYTES / (time.monotonic() - t0) / 1e9
-            del raw_burst, raws
+            del raw_burst, raws, probes
             log(f"raw device_put ceiling: burst {burst_gbps:.2f} GB/s, "
                 f"sustained {sustained_gbps:.2f} GB/s "
                 f"(environment h2d cap — tunnel-limited, not the loader)")
@@ -106,25 +121,60 @@ def main() -> None:
 
             # p50 first-batch latency from warm host tier
             lat = []
-            for _ in range(5):
-                l2 = DeviceBlockLoader(fs, paths[:1], device=device,
+            for s in range(4):  # shards 0-3; 4.. stay untransferred
+                l2 = DeviceBlockLoader(fs, paths[s:s + 1], device=device,
                                        hbm_bytes=0)
                 t0 = time.monotonic()
                 jax.block_until_ready(l2.load_block(0))
                 lat.append(1000 * (time.monotonic() - t0))
                 l2.close()
             raw_ms = 1000 * BLOCK_BYTES / (burst_gbps * 1e9)
-            log(f"p50 first-batch: {sorted(lat)[len(lat)//2]:.1f} ms "
-                f"(raw {BLOCK_BYTES >> 20}MB device_put floor: {raw_ms:.1f} ms)")
+            p50_ms = sorted(lat)[len(lat) // 2]
+            p50_vs_floor = p50_ms / raw_ms if raw_ms > 0 else 0.0
+            log(f"p50 first-batch: {p50_ms:.1f} ms "
+                f"(raw {BLOCK_BYTES >> 20}MB device_put floor: {raw_ms:.1f} ms, "
+                f"{p50_vs_floor:.2f}x)")
 
-            # epoch 1: host tier -> HBM through the loader
-            t0 = time.monotonic()
+            # h2d ratio: the tunnel's speed drifts minute to minute, so
+            # judging the loader against a ceiling probed earlier is
+            # noise — interleave ADJACENT ceiling/loader pairs over a
+            # subset and take the median ratio
+            sub_bytes = 4 * BLOCK_BYTES
+            pair_ratios = []
+            h2d = 0.0
+            for _rep in range(3):
+                # a shard subset this process has NOT transferred yet
+                # (first-batch used 0-3; reps take 4-7, 8-11, 12-15)
+                lo_i = min(4 + 4 * _rep, max(0, NUM_BLOCKS - 4))
+                sub = paths[lo_i:lo_i + 4]
+                ps = [fresh_probe() for _ in range(4)]
+                t0 = time.monotonic()
+                raws = [jax.device_put(p, device) for p in ps]
+                jax.block_until_ready(raws)
+                ceil = sub_bytes / (time.monotonic() - t0) / 1e9
+                del raws, ps
+                l3 = DeviceBlockLoader(fs, sub, device=device,
+                                       hbm_bytes=0, prefetch=2,
+                                       dtype=np.int32)
+                t0 = time.monotonic()
+                bl = [b for b in l3.epoch()]
+                jax.block_until_ready(bl)
+                h2d = sub_bytes / (time.monotonic() - t0) / 1e9
+                del bl
+                l3.close()
+                pair_ratios.append(h2d / max(ceil, 1e-9))
+                log(f"  h2d pair: ceiling {ceil:.3f} GB/s, "
+                    f"loader {h2d:.3f} GB/s, ratio {pair_ratios[-1]:.2f}")
+            h2d_vs_ceiling = sorted(pair_ratios)[len(pair_ratios) // 2]
+            log(f"h2d vs adjacent device_put ceiling: median "
+                f"{h2d_vs_ceiling:.2f}x over {len(pair_ratios)} pairs"
+                + (" (>1: the ceiling probe itself was tunnel-throttled "
+                   "below the loader's achieved rate — the loader is "
+                   "not the bottleneck)" if h2d_vs_ceiling > 1 else ""))
+
+            # warm the retained loader's HBM set (untimed)
             blocks = [b for b in loader.epoch()]
             jax.block_until_ready(blocks)
-            h2d = total_bytes / (time.monotonic() - t0) / 1e9
-            log(f"h2d (host warm -> HBM): {h2d:.2f} GB/s "
-                f"({h2d / max(sustained_gbps, 1e-9):.2f}x of the raw "
-                f"sustained device_put ceiling)")
 
             # warm HBM epochs: a serialized on-device loop where every
             # iteration re-reads every cached block, scaled by a value that
@@ -179,6 +229,10 @@ def main() -> None:
             "value": round(value, 2),
             "unit": "GB/s",
             "vs_baseline": round(value / TARGET_GBPS, 3),
+            # data-plane honesty metrics (round-2 verdict #4): the
+            # loader judged against THIS environment's own ceilings
+            "h2d_vs_device_put_ceiling": round(h2d_vs_ceiling, 3),
+            "p50_first_batch_vs_raw_floor": round(p50_vs_floor, 3),
         }), flush=True)
     finally:
         shutil.rmtree(base, ignore_errors=True)

@@ -127,6 +127,41 @@ class TestWebEndpoint:
         assert ei.value.code == 404
 
 
+class TestLogServer:
+    def test_records_aggregate_per_source(self, tmp_path):
+        import time
+
+        from alluxio_tpu.logserver import (
+            LogServerProcess, enable_remote_logging,
+        )
+
+        srv = LogServerProcess(str(tmp_path / "logs"))
+        port = srv.start()
+        try:
+            handler = enable_remote_logging(
+                "127.0.0.1", port, logger_name="atpu.remote.test")
+            lg = logging.getLogger("atpu.remote.test")
+            lg.setLevel(logging.INFO)
+            lg.propagate = False
+            lg.info("hello from afar %d", 42)
+            lg.warning("watch out")
+            deadline = time.monotonic() + 10
+            log_file = tmp_path / "logs" / "127.0.0.1.log"
+            while time.monotonic() < deadline:
+                if log_file.exists() and \
+                        "watch out" in log_file.read_text():
+                    break
+                time.sleep(0.05)
+            text = log_file.read_text()
+            assert "hello from afar 42" in text
+            assert "WARNING" in text and "watch out" in text
+            assert "atpu.remote.test" in text
+            lg.removeHandler(handler)
+            handler.close()
+        finally:
+            srv.stop()
+
+
 class TestLogLevel:
     def test_get_and_set_roundtrip(self, cluster):
         mc = cluster.meta_client()

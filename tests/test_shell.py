@@ -38,6 +38,46 @@ def run_shell(shell, cluster, argv):
     return code, out.getvalue(), err.getvalue()
 
 
+class TestValidateConf:
+    def test_clean_default_conf(self, conf, capsys):
+        from alluxio_tpu.shell.validate import main as vmain
+
+        assert vmain([], conf=conf) == 0
+        assert "0 error(s)" in capsys.readouterr().out
+
+    def test_site_file_catches_typos_and_bad_values(self, conf, tmp_path,
+                                                    capsys):
+        """The boot path silently skips unknown site keys — validateConf
+        is where a misspelled key becomes visible."""
+        from alluxio_tpu.shell.validate import main as vmain
+
+        site = tmp_path / "site.properties"
+        site.write_text(
+            "# comment\n"
+            "atpu.worker.tieredstroe.levels=2\n"          # typo: error
+            "atpu.worker.tieredstore.levels=many\n"       # bad int: error
+            "atpu.worker.tieredstore.level1.alias=SSD\n"  # template: ok
+            "some.external.prop=1\n"                      # warn only
+            "not a key value line\n")                     # warn only
+        rc = vmain(["--site", str(site)], conf=conf)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "tieredstroe" in out and "unknown property" in out
+        assert "many" in out
+        assert out.count("ERROR") == 2
+        assert out.count("WARN") == 2
+
+    def test_semantic_cross_checks(self, conf):
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.shell.validate import validate
+
+        conf.set(Keys.MASTER_EMBEDDED_JOURNAL_ELECTION_TIMEOUT_MIN, "1s")
+        conf.set(Keys.MASTER_EMBEDDED_JOURNAL_ELECTION_TIMEOUT_MAX,
+                 "500ms")
+        errors, _ = validate(conf)
+        assert any("election timeout" in e for e in errors)
+
+
 class TestFsShell:
     def test_mkdir_ls_rm(self, cluster):
         code, out, _ = run_shell(FS_SHELL, cluster, ["mkdir", "/a/b"])

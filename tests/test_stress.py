@@ -136,6 +136,56 @@ class TestWriteBench:
         assert used.get("SSD", 0) > 0
 
 
+class TestDistributedStressBench:
+    def test_fan_out_over_job_workers(self, tmp_path):
+        """The stressbench plan runs the bench on every job worker
+        against the LIVE cluster and aggregates (reference:
+        StressBenchDefinition + Benchmark --cluster mode)."""
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.job.wire import Status
+        from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+        with LocalCluster(str(tmp_path), num_workers=2,
+                          start_job_service=True,
+                          start_worker_heartbeats=True,
+                          conf_overrides={
+                              Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL:
+                                  "50ms"}) as cluster:
+            jc = cluster.job_client()
+            job_id = jc.run({
+                "type": "stressbench", "bench": "worker",
+                "options": {"mode": "random", "threads": 2,
+                            "duration_s": 1.0,
+                            "shard_bytes": 2 << 20, "num_shards": 1}})
+            info = jc.wait_for_job(job_id, timeout_s=120.0)
+            assert info.status == Status.COMPLETED, info.error_message
+            agg = info.result
+            assert agg["tasks"] == 2
+            assert agg["errors"] == 0
+            assert agg["metrics"]["ops_per_s"] > 0
+            assert agg["metrics"]["mb_per_s"] > 0
+
+    def test_master_bench_fan_out(self, tmp_path):
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.job.wire import Status
+        from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+        with LocalCluster(str(tmp_path), num_workers=1,
+                          start_job_service=True,
+                          start_worker_heartbeats=True,
+                          conf_overrides={
+                              Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL:
+                                  "50ms"}) as cluster:
+            jc = cluster.job_client()
+            job_id = jc.run({
+                "type": "stressbench", "bench": "master",
+                "options": {"op": "GetStatus", "threads": 2,
+                            "duration_s": 0.5, "fixed_count": 20}})
+            info = jc.wait_for_job(job_id, timeout_s=120.0)
+            assert info.status == Status.COMPLETED, info.error_message
+            assert info.result["metrics"]["ops_per_s"] > 0
+
+
 class TestCli:
     def test_cli_worker_json_line(self, capsys):
         from alluxio_tpu.stress.__main__ import main

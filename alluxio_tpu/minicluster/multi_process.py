@@ -39,10 +39,14 @@ class ManagedProcess:
 
     def start(self) -> None:
         log = open(self.log_path, "ab")
+        env = {**os.environ, **self.env, "JAX_PLATFORMS": "cpu"}
+        # control-plane roles never touch the accelerator: drop the TPU
+        # tunnel's site hook trigger so each subprocess skips its multi-
+        # second jax/PJRT init (dominates boot latency on small boxes)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "alluxio_tpu.shell.main", self.role],
-            env={**os.environ, **self.env, "JAX_PLATFORMS": "cpu"},
-            stdout=log, stderr=subprocess.STDOUT)
+            env=env, stdout=log, stderr=subprocess.STDOUT)
 
     def kill(self, sig: int = signal.SIGKILL) -> None:
         """Hard-kill (crash simulation)."""

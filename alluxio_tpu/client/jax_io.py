@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from concurrent.futures import CancelledError
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -72,6 +73,12 @@ class DeviceBlockLoader:
         # per epoch would miss the thread-local stream cache and reopen
         # every stream each epoch (fd/mmap leak over a training run)
         self._producer_pool = None
+        # at most one live epoch: starting a new one (or close()) cancels
+        # the previous producer, else an abandoned-but-referenced
+        # generator parks the single producer thread forever and
+        # close()/the next epoch() deadlock behind it
+        self._epoch_lock = threading.Lock()
+        self._current_stop: Optional[threading.Event] = None
 
     def __len__(self) -> int:
         return len(self._plan)
@@ -170,16 +177,30 @@ class DeviceBlockLoader:
             finally:
                 self._put(q, stop, SENTINEL)
 
-        if self._producer_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        with self._epoch_lock:
+            if self._current_stop is not None:
+                self._current_stop.set()
+            self._current_stop = stop
+            if self._producer_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            self._producer_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="loader-host-prefetch")
-        fut = self._producer_pool.submit(producer)
+                self._producer_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="loader-host-prefetch")
+            fut = self._producer_pool.submit(producer)
         inflight: deque = deque()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=0.5)
+                except _q.Empty:
+                    if stop.is_set():
+                        # cancelled by close()/a newer epoch(): fail
+                        # loudly — a silently-truncated epoch looks
+                        # complete downstream
+                        raise RuntimeError(
+                            "epoch cancelled: the loader was closed or "
+                            "a newer epoch() superseded this iterator")
+                    continue
                 if item is SENTINEL:
                     break
                 if item[0] == "__error__":
@@ -203,7 +224,10 @@ class DeviceBlockLoader:
                     q.get_nowait()
                 except _q.Empty:
                     break
-            fut.result(timeout=5)
+            try:
+                fut.result(timeout=5)
+            except CancelledError:  # close() shut the pool first
+                pass
 
     @staticmethod
     def _put(q, stop, item) -> None:
@@ -221,6 +245,10 @@ class DeviceBlockLoader:
                 "hbm_pages": self._hbm.page_count}
 
     def close(self) -> None:
+        with self._epoch_lock:
+            if self._current_stop is not None:
+                self._current_stop.set()  # unblock a parked producer
+                self._current_stop = None
         if self._producer_pool is not None:
             self._producer_pool.shutdown(wait=True)
             self._producer_pool = None

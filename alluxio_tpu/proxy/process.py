@@ -134,9 +134,19 @@ class _S3Handler(BaseHTTPRequestHandler):
     def _send(self, code: int, body: bytes = b"",
               headers: Optional[Dict[str, str]] = None,
               ctype: str = "application/xml") -> None:
+        drop = getattr(self, "_unread", 0) > 0
+        if drop:
+            # responding before the request body was consumed (error
+            # path): the unread bytes would desync the next request on
+            # a keep-alive connection — close it instead of buffering,
+            # and ADVERTISE the close so the client doesn't reuse a
+            # dead socket
+            self.close_connection = True
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if drop:
+            self.send_header("Connection", "close")
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -147,6 +157,8 @@ class _S3Handler(BaseHTTPRequestHandler):
         self._send(code, _error(s3code, msg, self.path))
 
     def _parse(self):
+        # request-body accounting for the keep-alive guard in _send
+        self._unread = int(self.headers.get("Content-Length") or 0)
         parts = urlsplit(self.path)
         segs = [unquote(s) for s in parts.path.split("/") if s]
         q = {k: v[0] for k, v in parse_qs(parts.query,
@@ -163,7 +175,24 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length") or 0)
-        return self.rfile.read(n) if n else b""
+        data = self.rfile.read(n) if n else b""
+        self._unread = 0
+        return data
+
+    def _stream_request_body(self, write, md5=None) -> int:
+        """Chunk-copy the request body into ``write`` without buffering
+        it whole (parts/objects can be GBs)."""
+        total = 0
+        while self._unread > 0:
+            chunk = self.rfile.read(min(self._CHUNK, self._unread))
+            if not chunk:
+                break
+            self._unread -= len(chunk)
+            if md5 is not None:
+                md5.update(chunk)
+            write(chunk)
+            total += len(chunk)
+        return total
 
     # -- verbs ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802
@@ -210,22 +239,18 @@ class _S3Handler(BaseHTTPRequestHandler):
             if "partNumber" in q and "uploadId" in q:
                 return self._upload_part(q["uploadId"],
                                          int(q["partNumber"]))
+            if not self.s3.fs.exists(self._bpath(bucket)):
+                # create_file would recursively materialize the missing
+                # bucket as a plain directory — a typo'd bucket must 404
+                return self._fail(404, "NoSuchBucket", bucket)
             src = self.headers.get("x-amz-copy-source")
             if src:
                 return self._copy_object(bucket, key, unquote(src))
-            n = int(self.headers.get("Content-Length") or 0)
             md5 = hashlib.md5()
             out = self.s3.fs.create_file(self._kpath(bucket, key),
                                          overwrite=True)
             with out:
-                remaining = n
-                while remaining > 0:
-                    chunk = self.rfile.read(min(self._CHUNK, remaining))
-                    if not chunk:
-                        break
-                    md5.update(chunk)
-                    out.write(chunk)
-                    remaining -= len(chunk)
+                self._stream_request_body(out.write, md5)
             self._send(200, b"", {"ETag": f'"{md5.hexdigest()}"'})
         except FileDoesNotExistError as e:
             self._fail(404, "NoSuchBucket", str(e))
@@ -310,6 +335,7 @@ class _S3Handler(BaseHTTPRequestHandler):
         contents, common = [], []
         seen_prefixes = set()
         more_after = False
+        last_emitted = ""
         for k, i in keys:
             if start_after and k <= start_after:
                 continue
@@ -318,23 +344,34 @@ class _S3Handler(BaseHTTPRequestHandler):
                 d = rest.find(delimiter)
                 if d >= 0:
                     p = prefix + rest[:d + len(delimiter)]
-                    if p not in seen_prefixes:
-                        seen_prefixes.add(p)
-                        common.append(p)
+                    # a prefix <= the token was fully emitted on an
+                    # earlier page (the token IS that prefix string)
+                    if start_after and p <= start_after:
+                        continue
+                    if p in seen_prefixes:
+                        continue
+                    # prefixes count against MaxKeys like real S3
+                    if len(contents) + len(common) >= max_keys:
+                        more_after = True
+                        break
+                    seen_prefixes.add(p)
+                    common.append(p)
+                    last_emitted = p
                     continue
-            if len(contents) >= max_keys:
+            if len(contents) + len(common) >= max_keys:
                 more_after = True  # something actually remains
                 break
             contents.append((k, i))
+            last_emitted = k
         truncated = "true" if more_after else "false"
         body = (f"<ListBucketResult><Name>{escape(bucket)}</Name>"
                 f"<Prefix>{escape(prefix)}</Prefix>"
-                f"<KeyCount>{len(contents)}</KeyCount>"
+                f"<KeyCount>{len(contents) + len(common)}</KeyCount>"
                 f"<MaxKeys>{max_keys}</MaxKeys>"
                 f"<IsTruncated>{truncated}</IsTruncated>")
-        if more_after and contents:
+        if more_after and last_emitted:
             body += (f"<NextContinuationToken>"
-                     f"{escape(contents[-1][0])}"
+                     f"{escape(last_emitted)}"
                      f"</NextContinuationToken>")
         for k, i in contents:
             body += (f"<Contents><Key>{escape(k)}</Key>"
@@ -400,22 +437,28 @@ class _S3Handler(BaseHTTPRequestHandler):
             pos += len(chunk)
             remaining -= len(chunk)
 
+    def _copy_stream(self, fin, write) -> "hashlib._Hash":
+        """Chunked pread -> write with an md5 running alongside (objects
+        and parts can be GBs; never buffer them whole)."""
+        md5 = hashlib.md5()
+        pos = 0
+        while True:
+            chunk = fin.pread(pos, self._CHUNK)
+            if not chunk:
+                break
+            md5.update(chunk)
+            write(chunk)
+            pos += len(chunk)
+        return md5
+
     def _copy_object(self, bucket: str, key: str, src: str) -> None:
         segs = [s for s in src.split("/") if s]
         src_path = self._kpath(segs[0], "/".join(segs[1:]))
-        md5 = hashlib.md5()
         with self.s3.fs.open_file(src_path) as fin:
             out = self.s3.fs.create_file(self._kpath(bucket, key),
                                          overwrite=True)
             with out:
-                pos = 0
-                while True:
-                    chunk = fin.pread(pos, self._CHUNK)
-                    if not chunk:
-                        break
-                    md5.update(chunk)
-                    out.write(chunk)
-                    pos += len(chunk)
+                md5 = self._copy_stream(fin, out.write)
         etag = md5.hexdigest()
         self._send(200, _xml(
             f"<CopyObjectResult><ETag>\"{etag}\"</ETag>"
@@ -424,6 +467,8 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     # -- multipart -----------------------------------------------------------
     def _initiate_multipart(self, bucket: str, key: str) -> None:
+        if not self.s3.fs.exists(self._bpath(bucket)):
+            return self._fail(404, "NoSuchBucket", bucket)
         upload_id = uuid.uuid4().hex
         with self.s3.lock:
             self.s3.uploads[upload_id] = (bucket, key)
@@ -440,18 +485,22 @@ class _S3Handler(BaseHTTPRequestHandler):
         with self.s3.lock:
             if upload_id not in self.s3.uploads:
                 return self._fail(404, "NoSuchUpload", upload_id)
-        data = self._body()
-        self.s3.fs.write_all(
+        md5 = hashlib.md5()
+        out = self.s3.fs.create_file(
             f"{self.s3.root}/{_MULTIPART_DIR}/{upload_id}/{part:05d}",
-            data, overwrite=True)
-        self._send(200, b"", {
-            "ETag": f'"{hashlib.md5(data).hexdigest()}"'})
+            overwrite=True)
+        with out:
+            self._stream_request_body(out.write, md5)
+        self._send(200, b"", {"ETag": f'"{md5.hexdigest()}"'})
 
     def _complete_multipart(self, bucket: str, key: str,
                             upload_id: str) -> None:
         with self.s3.lock:
             if upload_id not in self.s3.uploads:
                 return self._fail(404, "NoSuchUpload", upload_id)
+        if not self.s3.fs.exists(self._bpath(bucket)):
+            # bucket deleted mid-upload: must not be re-materialized
+            return self._fail(404, "NoSuchBucket", bucket)
         d = f"{self.s3.root}/{_MULTIPART_DIR}/{upload_id}"
         # the client's manifest (CompleteMultipartUpload XML) is the
         # source of truth: assemble exactly the declared parts, in the
@@ -470,9 +519,8 @@ class _S3Handler(BaseHTTPRequestHandler):
                     out.cancel()
                     return self._fail(400, "InvalidPart",
                                       f"part {part} was not uploaded")
-                data = self.s3.fs.read_all(p)
-                etags.append(hashlib.md5(data).digest())
-                out.write(data)
+                with self.s3.fs.open_file(p) as fin:
+                    etags.append(self._copy_stream(fin, out.write).digest())
         self.s3.fs.delete(d, recursive=True)
         with self.s3.lock:
             self.s3.uploads.pop(upload_id, None)

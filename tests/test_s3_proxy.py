@@ -156,6 +156,42 @@ class TestProtocolDetails:
         assert root.findtext("IsTruncated") == "false"
         assert root.findtext("NextContinuationToken") is None
 
+    def test_put_to_missing_bucket_is_404(self, proxy):
+        # must NOT silently materialize a phantom bucket
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(proxy, "PUT", "/typo-bucket/key", data=b"x")
+        assert ei.value.code == 404
+        assert b"NoSuchBucket" in ei.value.read()
+        _, body, _ = _req(proxy, "GET", "/")
+        assert not list(ET.fromstring(body).iter("Bucket"))
+
+    def test_common_prefixes_count_toward_max_keys(self, proxy):
+        _req(proxy, "PUT", "/b")
+        for d in range(4):
+            _req(proxy, "PUT", f"/b/dir{d}/f", data=b"x")
+        _req(proxy, "PUT", "/b/top.txt", data=b"x")
+        # page 1: 3 slots -> dir0/ dir1/ dir2/, truncated
+        _, body, _ = _req(
+            proxy, "GET", "/b?list-type=2&delimiter=/&max-keys=3")
+        root = ET.fromstring(body)
+        prefixes = [p.findtext("Prefix")
+                    for p in root.iter("CommonPrefixes")]
+        assert prefixes == ["dir0/", "dir1/", "dir2/"]
+        assert root.findtext("KeyCount") == "3"
+        assert root.findtext("IsTruncated") == "true"
+        token = root.findtext("NextContinuationToken")
+        assert token == "dir2/"
+        # page 2 resumes WITHOUT re-emitting earlier prefixes
+        _, body, _ = _req(
+            proxy, "GET", "/b?list-type=2&delimiter=/&max-keys=3"
+                          f"&continuation-token={token}")
+        root = ET.fromstring(body)
+        prefixes = [p.findtext("Prefix")
+                    for p in root.iter("CommonPrefixes")]
+        keys = [c.findtext("Key") for c in root.iter("Contents")]
+        assert prefixes == ["dir3/"] and keys == ["top.txt"]
+        assert root.findtext("IsTruncated") == "false"
+
 
 class TestMultipart:
     def test_multipart_roundtrip(self, proxy):
@@ -180,6 +216,20 @@ class TestMultipart:
         keys = [c.findtext("Key")
                 for c in ET.fromstring(body).iter("Contents")]
         assert keys == ["big.bin"]
+
+    def test_complete_after_bucket_delete_is_404(self, proxy):
+        _req(proxy, "PUT", "/b")
+        _, body, _ = _req(proxy, "POST", "/b/x?uploads")
+        upload_id = ET.fromstring(body).findtext("UploadId")
+        _req(proxy, "PUT", f"/b/x?partNumber=1&uploadId={upload_id}",
+             data=b"zzz")
+        _req(proxy, "DELETE", "/b")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(proxy, "POST", f"/b/x?uploadId={upload_id}")
+        assert ei.value.code == 404
+        # the phantom bucket must not have been re-materialized
+        _, body, _ = _req(proxy, "GET", "/")
+        assert not list(ET.fromstring(body).iter("Bucket"))
 
     def test_abort_multipart(self, proxy):
         _req(proxy, "PUT", "/b")

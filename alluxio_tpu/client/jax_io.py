@@ -79,6 +79,7 @@ class DeviceBlockLoader:
         # close()/the next epoch() deadlock behind it
         self._epoch_lock = threading.Lock()
         self._current_stop: Optional[threading.Event] = None
+        self._closed = False
 
     def __len__(self) -> int:
         return len(self._plan)
@@ -117,6 +118,8 @@ class DeviceBlockLoader:
 
     def load_block(self, plan_index: int):
         """One block as a device uint8 array (HBM-cached across epochs)."""
+        if self._closed:
+            raise RuntimeError("loader is closed")
         path, index, pid = self._plan[plan_index]
         if self._hbm is not None:
             lease = self._hbm.get(pid)
@@ -178,6 +181,10 @@ class DeviceBlockLoader:
                 self._put(q, stop, SENTINEL)
 
         with self._epoch_lock:
+            if self._closed:
+                # a pre-close generator first iterated after close()
+                # must not silently resurrect the pool/streams
+                raise RuntimeError("loader is closed")
             if self._current_stop is not None:
                 self._current_stop.set()
             self._current_stop = stop
@@ -218,6 +225,9 @@ class DeviceBlockLoader:
             while inflight:
                 yield inflight.popleft()
         finally:
+            with self._epoch_lock:
+                # superseded by a newer epoch() or close()?
+                cancelled = self._current_stop is not stop
             stop.set()
             while True:  # drain so a blocked producer can exit
                 try:
@@ -228,6 +238,11 @@ class DeviceBlockLoader:
                 fut.result(timeout=5)
             except CancelledError:  # close() shut the pool first
                 pass
+            except TimeoutError:
+                if not cancelled:
+                    # a live epoch's producer is wedged (e.g. hung
+                    # worker RPC): surface it, don't mask the hang
+                    raise
 
     @staticmethod
     def _put(q, stop, item) -> None:
@@ -246,6 +261,7 @@ class DeviceBlockLoader:
 
     def close(self) -> None:
         with self._epoch_lock:
+            self._closed = True
             if self._current_stop is not None:
                 self._current_stop.set()  # unblock a parked producer
                 self._current_stop = None

@@ -443,6 +443,14 @@ class Keys:
     WORKER_HOSTNAME = _k("atpu.worker.hostname", default="localhost")
     WORKER_RPC_PORT = _k("atpu.worker.rpc.port", KeyType.INT, default=29999)
     WORKER_WEB_PORT = _k("atpu.worker.web.port", KeyType.INT, default=30000)
+    WORKER_WEB_ENABLED = _k(
+        "atpu.worker.web.enabled", KeyType.BOOL, default=False,
+        scope=Scope.WORKER,
+        description="Serve the worker's read-only HTTP/JSON state "
+                    "endpoint (reference: AlluxioWorkerRestServiceHandler).")
+    WORKER_WEB_BIND_HOST = _k(
+        "atpu.worker.web.bind.host", default="0.0.0.0",
+        scope=Scope.WORKER)
     WORKER_DATA_FOLDER = _k("atpu.worker.data.folder", default="/tmp/alluxio_tpu/worker")
     WORKER_RAMDISK_SIZE = _k("atpu.worker.ramdisk.size", KeyType.BYTES, default="1GB")
     WORKER_TIERED_STORE_LEVELS = _k("atpu.worker.tieredstore.levels", KeyType.INT,

@@ -84,6 +84,9 @@ class LocalCluster:
         wconf.set(Keys.WORKER_SHM_DIR, os.path.join(wdir, "shm"))
         wconf.set(Keys.WORKER_RAMDISK_SIZE, self._worker_mem)
         wconf.set(Keys.WORKER_HOSTNAME, "localhost")
+        # ephemeral per-worker web port: a shared fixed default would
+        # EADDRINUSE the second worker when the endpoint is enabled
+        wconf.set(Keys.WORKER_WEB_PORT, 0)
         bm_client = BlockMasterClient(self.master.address)
         fs_client = FsMasterClient(self.master.address)
         # distinct locality hosts so policies can tell workers apart
@@ -108,6 +111,7 @@ class LocalCluster:
             worker.start()
         else:
             worker._master_sync.register_with_master()
+            worker.maybe_start_web()
         handle = _WorkerHandle(worker, server, port)
         self.workers.append(handle)
         return handle

@@ -53,48 +53,36 @@ register_factory("file", LocalUnderFileSystem)
 register_factory("mem", MemUnderFileSystem)
 
 
+#: optional connectors: (module, class name, schemes). ``schemes=None``
+#: uses the class's own ``schemes`` attribute. hdfs needs a working
+#: libhdfs (HADOOP_HOME) and probes at registration time.
+_OPTIONAL_CONNECTORS = (
+    ("alluxio_tpu.underfs.web", "WebUnderFileSystem", ("http", "https")),
+    ("alluxio_tpu.underfs.s3", "S3UnderFileSystem", ("s3", "s3a")),
+    ("alluxio_tpu.underfs.gcs", "GcsUnderFileSystem", ("gs", "gcs")),
+    ("alluxio_tpu.underfs.s3_compat", "OssUnderFileSystem", None),
+    ("alluxio_tpu.underfs.s3_compat", "CosUnderFileSystem", None),
+    ("alluxio_tpu.underfs.s3_compat", "KodoUnderFileSystem", None),
+    ("alluxio_tpu.underfs.s3_compat", "SwiftUnderFileSystem", None),
+    ("alluxio_tpu.underfs.s3_compat", "ObsUnderFileSystem", None),
+    ("alluxio_tpu.underfs.azure", "WasbUnderFileSystem", None),
+    ("alluxio_tpu.underfs.azure", "AdlsUnderFileSystem", None),
+    ("alluxio_tpu.underfs.ozone", "OzoneUnderFileSystem", None),
+    ("alluxio_tpu.underfs.hdfs", "HdfsUnderFileSystem", ("hdfs",)),
+)
+
+
 def _register_optional() -> None:
     """Connectors with extra deps register lazily and tolerate absence."""
-    try:
-        from alluxio_tpu.underfs.web import WebUnderFileSystem
+    import importlib
 
-        register_factory("http", WebUnderFileSystem)
-        register_factory("https", WebUnderFileSystem)
-    except Exception:  # noqa: BLE001
-        pass
-    try:
-        from alluxio_tpu.underfs.s3 import S3UnderFileSystem
-
-        register_factory("s3", S3UnderFileSystem)
-        register_factory("s3a", S3UnderFileSystem)
-    except Exception:  # noqa: BLE001
-        pass
-    try:
-        from alluxio_tpu.underfs.gcs import GcsUnderFileSystem
-
-        register_factory("gs", GcsUnderFileSystem)
-        register_factory("gcs", GcsUnderFileSystem)
-    except Exception:  # noqa: BLE001
-        pass
-    try:
-        from alluxio_tpu.underfs import s3_compat
-
-        for cls in (s3_compat.OssUnderFileSystem,
-                    s3_compat.CosUnderFileSystem,
-                    s3_compat.KodoUnderFileSystem,
-                    s3_compat.SwiftUnderFileSystem,
-                    s3_compat.ObsUnderFileSystem):
-            for scheme in cls.schemes:
+    for module, cls_name, schemes in _OPTIONAL_CONNECTORS:
+        try:
+            cls = getattr(importlib.import_module(module), cls_name)
+            for scheme in (schemes or cls.schemes):
                 register_factory(scheme, cls)
-    except Exception:  # noqa: BLE001
-        pass
-    try:
-        # needs a working libhdfs (HADOOP_HOME); probe at registration time
-        from alluxio_tpu.underfs.hdfs import HdfsUnderFileSystem
-
-        register_factory("hdfs", HdfsUnderFileSystem)
-    except Exception:  # noqa: BLE001
-        pass
+        except Exception:  # noqa: BLE001 - dep absent: skip connector
+            pass
 
 
 _register_optional()

@@ -144,6 +144,8 @@ class BlockWorker:
             quota_percent=conf.get_int(
                 Keys.WORKER_MANAGEMENT_PROMOTE_QUOTA_PERCENT))
         self._ufs_reader = UfsBlockReader(self.store)
+        self.web_server = None
+        self.web_port: Optional[int] = None
         self.async_cache = AsyncCacheManager(
             self.store, lambda mount_id: self.ufs_manager.get(mount_id))
         self._threads: List[HeartbeatThread] = []
@@ -197,13 +199,29 @@ class BlockWorker:
             self._threads.append(HeartbeatThread(
                 HeartbeatContext.WORKER_METRICS_SINKS, self.sink_manager,
                 self._conf.get_duration_s(Keys.METRICS_SINK_INTERVAL)))
+        self.maybe_start_web()
         for t in self._threads:
             t.start()
         self._started = True
 
+    def maybe_start_web(self) -> None:
+        """Start the read-only web endpoint when enabled (safe to call
+        without the heartbeat machinery: serves live store state)."""
+        if self.web_server is None and \
+                self._conf.get_bool(Keys.WORKER_WEB_ENABLED):
+            from alluxio_tpu.worker.web import WorkerWebServer
+
+            self.web_server = WorkerWebServer(
+                self, port=self._conf.get_int(Keys.WORKER_WEB_PORT),
+                bind_host=self._conf.get(Keys.WORKER_WEB_BIND_HOST))
+            self.web_port = self.web_server.start()
+
     def stop(self) -> None:
         for t in self._threads:
             t.stop()
+        if self.web_server is not None:
+            self.web_server.stop()
+            self.web_server = None
         self.async_cache.close()
 
     # -- data-plane API (called by the data server / local clients) --------

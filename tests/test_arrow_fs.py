@@ -1,0 +1,96 @@
+"""pyarrow FileSystem adapter tests: parquet + dataset consumers address
+the namespace through ``pyarrow.fs`` (the HDFS-compat-client analogue;
+reference ``hadoop/AbstractFileSystem.java:80`` contract surface)."""
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+import pytest
+
+from alluxio_tpu.client.arrow_fs import arrow_file_system
+from alluxio_tpu.minicluster import LocalCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1) as c:
+        yield c
+
+
+@pytest.fixture()
+def afs(cluster):
+    return arrow_file_system(fs=cluster.file_system())
+
+
+def _table():
+    return pa.table({"x": list(range(100)),
+                     "y": [f"row-{i}" for i in range(100)]})
+
+
+class TestArrowFs:
+    def test_parquet_round_trip(self, afs):
+        t = _table()
+        afs.create_dir("/warehouse")
+        pq.write_table(t, "/warehouse/t.parquet", filesystem=afs)
+        got = pq.read_table("/warehouse/t.parquet", filesystem=afs)
+        assert got.equals(t)
+
+    def test_column_projection_uses_random_access(self, afs):
+        pq.write_table(_table(), "/w/t.parquet", filesystem=afs)
+        got = pq.read_table("/w/t.parquet", filesystem=afs,
+                            columns=["x"])
+        assert got.column_names == ["x"] and got.num_rows == 100
+
+    def test_dataset_discovery(self, afs):
+        for part in ("a", "b"):
+            pq.write_table(_table(), f"/ds/{part}/part-0.parquet",
+                           filesystem=afs)
+        ds = pads.dataset("/ds", filesystem=afs)
+        assert ds.to_table().num_rows == 200
+
+    def test_file_info_types(self, afs):
+        afs.create_dir("/d")
+        with afs.open_output_stream("/d/f.bin") as f:
+            f.write(b"abc")
+        infos = afs.get_file_info(["/d", "/d/f.bin", "/missing"])
+        assert infos[0].type == pafs.FileType.Directory
+        assert infos[1].type == pafs.FileType.File
+        assert infos[1].size == 3
+        assert infos[2].type == pafs.FileType.NotFound
+
+    def test_selector_recursive(self, afs):
+        with afs.open_output_stream("/sel/sub/f1") as f:
+            f.write(b"1")
+        with afs.open_output_stream("/sel/f2") as f:
+            f.write(b"2")
+        flat = afs.get_file_info(pafs.FileSelector("/sel"))
+        assert {i.base_name for i in flat} == {"sub", "f2"}
+        deep = afs.get_file_info(
+            pafs.FileSelector("/sel", recursive=True))
+        assert {i.base_name for i in deep} == {"sub", "f1", "f2"}
+        missing = afs.get_file_info(
+            pafs.FileSelector("/nope", allow_not_found=True))
+        assert missing == []
+
+    def test_move_copy_delete(self, afs):
+        with afs.open_output_stream("/m/a") as f:
+            f.write(b"payload")
+        afs.move("/m/a", "/m/b")
+        afs.copy_file("/m/b", "/m/c")
+        with afs.open_input_stream("/m/c") as f:
+            assert f.read() == b"payload"
+        afs.delete_file("/m/b")
+        assert afs.get_file_info(["/m/b"])[0].type == \
+            pafs.FileType.NotFound
+        with pytest.raises(FileNotFoundError):
+            afs.delete_file("/m/b")
+
+    def test_open_missing_raises(self, afs):
+        with pytest.raises(FileNotFoundError):
+            afs.open_input_file("/nope.bin")
+
+    def test_scheme_normalization(self, afs):
+        with afs.open_output_stream("atpu://host:1/n/x") as f:
+            f.write(b"q")
+        assert afs.get_file_info(["/n/x"])[0].type == pafs.FileType.File

@@ -74,6 +74,18 @@ class TestLifecycle:
         next(it)  # producer is now parked on the full bounded queue
         loader.close()  # must return, not hang on pool shutdown
 
+    def test_use_after_close_raises(self, cluster):
+        """Regression: a generator created pre-close but first iterated
+        post-close must not resurrect the producer pool."""
+        loader, _ = _make_loader(cluster)
+        stale = loader.epoch()  # generator body not started yet
+        loader.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            next(stale)
+        with pytest.raises(RuntimeError, match="closed"):
+            loader.load_block(0)
+        assert loader._producer_pool is None  # nothing resurrected
+
     def test_new_epoch_cancels_stale_generator(self, cluster):
         """Regression: a second epoch() must not queue forever behind a
         producer whose abandoned-but-referenced generator never ran its

@@ -79,7 +79,9 @@ class TestSinks:
 def cluster(tmp_path):
     with LocalCluster(str(tmp_path), num_workers=1,
                       conf_overrides={Keys.MASTER_WEB_ENABLED: True,
-                                      Keys.MASTER_WEB_PORT: 0}) as c:
+                                      Keys.MASTER_WEB_PORT: 0,
+                                      Keys.WORKER_WEB_ENABLED: True,
+                                      Keys.WORKER_WEB_PORT: 0}) as c:
         yield c
 
 
@@ -124,6 +126,45 @@ class TestWebEndpoint:
         assert json.loads(body)["databases"] == {}
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(cluster, "/api/v1/nope")
+        assert ei.value.code == 404
+
+
+def _wget(cluster, route):
+    port = cluster.workers[0].worker.web_port
+    url = f"http://127.0.0.1:{port}{route}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+class TestWorkerWebEndpoint:
+    def test_worker_info_and_capacity(self, cluster):
+        code, body = _wget(cluster, "/api/v1/worker/info")
+        assert code == 200
+        info = json.loads(body)
+        assert info["worker_id"] == cluster.workers[0].worker.worker_id
+        assert info["tiers"]
+        code, body = _wget(cluster, "/api/v1/worker/capacity")
+        cap = json.loads(body)["tiers"]
+        assert cap and all("dirs" in t for t in cap)
+
+    def test_worker_blocks_reflect_writes(self, cluster):
+        fs = cluster.file_system()
+        fs.write_all("/web/block-vis", b"z" * 4096)
+        code, body = _wget(cluster, "/api/v1/worker/blocks")
+        assert code == 200
+        blocks = json.loads(body)["blocks"]
+        assert sum(t["count"] for t in blocks.values()) >= 1
+        sampled = [b for t in blocks.values() for b in t["sample"]]
+        st = fs.get_status("/web/block-vis")
+        assert set(st.block_ids) & set(sampled)
+
+    def test_worker_metrics_and_404(self, cluster):
+        code, body = _wget(cluster, "/api/v1/worker/metrics")
+        assert code == 200 and json.loads(body)["metrics"]
+        code, body = _wget(cluster, "/metrics")
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _wget(cluster, "/api/v1/worker/nope")
         assert ei.value.code == 404
 
 

@@ -1,0 +1,199 @@
+"""In-process fake Azure storage server speaking BOTH dialects the
+connector uses: the Blob service REST (wasb) and the ADLS Gen2 "DFS"
+paths API (abfs). One store backs both, like a real HNS account."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+from urllib.parse import parse_qs, unquote, urlsplit
+from xml.sax.saxutils import escape
+
+
+class _State:
+    def __init__(self) -> None:
+        #: "container/key" -> bytes (committed)
+        self.blobs: Dict[str, bytes] = {}
+        #: uncommitted DFS appends: "container/key" -> bytearray
+        self.staging: Dict[str, bytearray] = {}
+        self.lock = threading.Lock()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _parse(self):
+        parts = urlsplit(self.path)
+        # preserve trailing slashes: "/" -suffixed keys are directory
+        # breadcrumbs in the object-store mapping
+        pieces = parts.path.lstrip("/").split("/", 1)
+        container = unquote(pieces[0])
+        key = unquote(pieces[1]) if len(pieces) > 1 else ""
+        q = {k: v[0] for k, v in parse_qs(parts.query,
+                                          keep_blank_values=True).items()}
+        return container, key, q
+
+    def _send(self, code: int, body: bytes = b"",
+              headers: Dict[str, str] = None) -> None:
+        self.send_response(code)
+        if "Content-Length" not in (headers or {}):
+            self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    # -- verbs ---------------------------------------------------------------
+    def do_PUT(self):  # noqa: N802
+        c, key, q = self._parse()
+        st = self.state
+        full = f"{c}/{key}"
+        body = self._body()
+        rename_src = self.headers.get("x-ms-rename-source")
+        copy_src = self.headers.get("x-ms-copy-source")
+        with st.lock:
+            if rename_src:  # DFS rename
+                src = rename_src.lstrip("/")
+                if src not in st.blobs:
+                    return self._send(404)
+                st.blobs[full] = st.blobs.pop(src)
+                return self._send(201)
+            if copy_src:  # Blob copy (sync)
+                src_key = unquote(urlsplit(copy_src).path).lstrip("/")
+                if src_key not in st.blobs:
+                    return self._send(404)
+                st.blobs[full] = st.blobs[src_key]
+                return self._send(202, headers={
+                    "x-ms-copy-status": "success"})
+            if q.get("resource") == "file":  # DFS create
+                st.staging[full] = bytearray()
+                st.blobs.setdefault(full, b"")
+                return self._send(201)
+            # Blob put
+            st.blobs[full] = body
+            return self._send(201)
+
+    def do_PATCH(self):  # noqa: N802
+        c, key, q = self._parse()
+        st = self.state
+        full = f"{c}/{key}"
+        body = self._body()
+        with st.lock:
+            if q.get("action") == "append":
+                buf = st.staging.setdefault(full, bytearray())
+                pos = int(q.get("position", "0"))
+                del buf[pos:]
+                buf.extend(body)
+                return self._send(202)
+            if q.get("action") == "flush":
+                pos = int(q.get("position", "0"))
+                buf = st.staging.pop(full, bytearray())
+                st.blobs[full] = bytes(buf[:pos])
+                return self._send(200)
+        self._send(400)
+
+    def do_GET(self):  # noqa: N802
+        c, key, q = self._parse()
+        st = self.state
+        if "comp" in q and q.get("comp") == "list":
+            return self._blob_list(c, q)
+        if q.get("resource") == "filesystem":
+            return self._dfs_list(c, q)
+        full = f"{c}/{key}"
+        with st.lock:
+            data = st.blobs.get(full)
+        if data is None:
+            return self._send(404)
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            a, _, b = rng[len("bytes="):].partition("-")
+            start = int(a) if a else 0
+            end = int(b) + 1 if b else len(data)
+            if start >= len(data) and data:
+                return self._send(416)
+            return self._send(206, data[start:end])
+        self._send(200, data)
+
+    def do_HEAD(self):  # noqa: N802
+        c, key, _ = self._parse()
+        with self.state.lock:
+            data = self.state.blobs.get(f"{c}/{key}")
+        if data is None:
+            return self._send(404)
+        self._send(200, headers={
+            "Content-Length": str(len(data)),
+            "Last-Modified": formatdate(time.time(), usegmt=True),
+            "ETag": f'"{hash(data) & 0xffffffff:x}"'})
+
+    def do_DELETE(self):  # noqa: N802
+        c, key, _ = self._parse()
+        full = f"{c}/{key}"
+        with self.state.lock:
+            if full not in self.state.blobs:
+                return self._send(404)
+            del self.state.blobs[full]
+        self._send(202)
+
+    # -- listings ------------------------------------------------------------
+    def _blob_list(self, container: str, q: Dict[str, str]) -> None:
+        prefix = q.get("prefix", "")
+        with self.state.lock:
+            names = sorted(
+                k[len(container) + 1:] for k in self.state.blobs
+                if k.startswith(f"{container}/") and
+                k[len(container) + 1:].startswith(prefix))
+        blobs = "".join(
+            f"<Blob><Name>{escape(n)}</Name></Blob>" for n in names)
+        body = (f'<?xml version="1.0"?><EnumerationResults>'
+                f"<Blobs>{blobs}</Blobs><NextMarker/>"
+                f"</EnumerationResults>").encode()
+        self._send(200, body)
+
+    def _dfs_list(self, container: str, q: Dict[str, str]) -> None:
+        directory = q.get("directory", "")
+        with self.state.lock:
+            names = sorted(
+                k[len(container) + 1:] for k in self.state.blobs
+                if k.startswith(f"{container}/") and
+                k[len(container) + 1:].startswith(directory))
+        paths = [{"name": n, "isDirectory": False,
+                  "contentLength": len(self.state.blobs[f"{container}/{n}"])}
+                 for n in names]
+        self._send(200, json.dumps({"paths": paths}).encode())
+
+
+class FakeAzureServer:
+    """``with FakeAzureServer() as srv: srv.endpoint``."""
+
+    def __init__(self) -> None:
+        self.state = _State()
+
+        class H(_Handler):
+            state = self.state
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self._httpd.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def __enter__(self) -> "FakeAzureServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        return False

@@ -36,18 +36,10 @@ def _require_pyarrow():
 
 
 def _norm(path: str) -> str:
-    path = path.strip()
-    for scheme in ("atpu://", "alluxio://"):
-        if path.startswith(scheme):
-            path = path[len(scheme):]
-            # drop an authority component (host:port) if present
-            if "/" in path:
-                path = path[path.index("/"):]
-            else:
-                path = "/"
-    if not path.startswith("/"):
-        path = "/" + path
-    return path.rstrip("/") or "/"
+    from alluxio_tpu.utils.uri import AlluxioURI
+
+    # AlluxioURI strips scheme+authority and normalizes ('..', '//')
+    return AlluxioURI(path.strip()).path
 
 
 class _InputFile:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 from concurrent.futures import CancelledError
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -238,7 +239,8 @@ class DeviceBlockLoader:
                 fut.result(timeout=5)
             except CancelledError:  # close() shut the pool first
                 pass
-            except TimeoutError:
+            except (TimeoutError, FuturesTimeoutError):
+                # (both spellings: distinct classes before python 3.11)
                 if not cancelled:
                     # a live epoch's producer is wedged (e.g. hung
                     # worker RPC): surface it, don't mask the hang

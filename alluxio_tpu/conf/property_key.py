@@ -598,6 +598,19 @@ class Keys:
         "atpu.proxy.s3.root", KeyType.STRING, default="/s3",
         scope=Scope.SERVER,
         description="Namespace directory whose children are S3 buckets.")
+    FUSE_MOUNT_POINT = _k(
+        "atpu.fuse.mount.point", KeyType.STRING,
+        default="/mnt/alluxio-tpu", scope=Scope.CLIENT,
+        description="Local path where the FUSE adapter mounts the "
+                    "namespace (reference: fuse/AlluxioFuse.java).")
+    FUSE_FS_ROOT = _k(
+        "atpu.fuse.fs.root", KeyType.STRING, default="/",
+        scope=Scope.CLIENT,
+        description="Namespace subtree exposed at the mount point.")
+    FUSE_MOUNT_OPTIONS = _k(
+        "atpu.fuse.mount.options", KeyType.STRING, default="",
+        scope=Scope.CLIENT,
+        description="Extra -o mount options (e.g. allow_other).")
     METRICS_SINKS = _k(
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,

@@ -1,0 +1,6 @@
+"""FUSE adapter: mount the namespace as a local POSIX filesystem
+(re-design of ``integration/fuse``; see ``process.py``)."""
+
+from alluxio_tpu.fuse.fs import FuseFs  # noqa: F401
+
+__all__ = ["FuseFs"]

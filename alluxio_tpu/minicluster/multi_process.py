@@ -171,7 +171,7 @@ class MultiProcessCluster:
         return p
 
     # -- readiness -----------------------------------------------------------
-    def wait_for_primary(self, timeout_s: float = 60.0) -> str:
+    def wait_for_primary(self, timeout_s: float = 180.0) -> str:
         """Block until some master serves RPCs; returns its address."""
         deadline = time.monotonic() + timeout_s
         last_err: Optional[Exception] = None

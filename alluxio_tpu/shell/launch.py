@@ -134,6 +134,28 @@ def launch_logserver(conf: Configuration) -> int:
         proc.stop, f"alluxio-tpu log server on port {port}")
 
 
+def launch_fuse(conf: Configuration) -> int:
+    from alluxio_tpu.client.file_system import FileSystem
+    from alluxio_tpu.fuse.process import AlluxioFuseMount, fuse_available
+
+    if not fuse_available():
+        print("FUSE is unavailable (libfuse.so.2 or /dev/fuse missing)")
+        return 1
+    fs = FileSystem(_master_address(conf), conf=conf)
+    mount = AlluxioFuseMount(
+        fs, conf.get(Keys.FUSE_MOUNT_POINT),
+        root=conf.get(Keys.FUSE_FS_ROOT),
+        options=conf.get(Keys.FUSE_MOUNT_OPTIONS))
+    mount.mount()
+
+    def stop() -> None:
+        mount.unmount()
+        fs.close()
+
+    return _serve_until_signal(
+        stop, f"alluxio-tpu fuse mounted at {mount.mountpoint}")
+
+
 def maybe_enable_remote_logging(conf: Configuration) -> None:
     """Every role calls this: ships records to the log server when
     atpu.logserver.hostname is configured."""
@@ -151,6 +173,7 @@ _LAUNCHERS = {
     "job-worker": launch_job_worker,
     "proxy": launch_proxy,
     "logserver": launch_logserver,
+    "fuse": launch_fuse,
 }
 
 

@@ -130,7 +130,7 @@ def main(argv=None) -> int:
         print(getattr(alluxio_tpu, "__version__", "0.1.0"))
         return 0
     if cmd in ("master", "worker", "job-master", "job-worker", "proxy",
-               "logserver"):
+               "logserver", "fuse"):
         from alluxio_tpu.shell.launch import launch_process
 
         return launch_process(cmd, conf)

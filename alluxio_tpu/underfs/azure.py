@@ -147,15 +147,7 @@ class _AzureRestBase(ObjectStoreClient):
         return self._session.request(method, url, data=data,
                                      headers=hdrs, timeout=60)
 
-
-class AzureBlobClient(_AzureRestBase):
-    """Blob service dialect (wasb)."""
-
-    def put(self, key: str, data: bytes) -> None:
-        r = self._request("PUT", self._url(key), data=data,
-                          headers={"x-ms-blob-type": "BlockBlob"})
-        r.raise_for_status()
-
+    # shared across both dialects: ranged read and delete are identical
     def get(self, key: str, offset: int = 0,
             length: Optional[int] = None) -> Optional[bytes]:
         headers = {}
@@ -170,6 +162,19 @@ class AzureBlobClient(_AzureRestBase):
         r.raise_for_status()
         return r.content
 
+    def delete(self, key: str) -> bool:
+        r = self._request("DELETE", self._url(key))
+        return r.status_code in (200, 202, 204)
+
+
+class AzureBlobClient(_AzureRestBase):
+    """Blob service dialect (wasb)."""
+
+    def put(self, key: str, data: bytes) -> None:
+        r = self._request("PUT", self._url(key), data=data,
+                          headers={"x-ms-blob-type": "BlockBlob"})
+        r.raise_for_status()
+
     def head(self, key: str) -> Optional[Tuple[int, int, str]]:
         r = self._request("HEAD", self._url(key))
         if r.status_code == 404:
@@ -178,10 +183,6 @@ class AzureBlobClient(_AzureRestBase):
         return (int(r.headers.get("Content-Length", 0)),
                 _http_date_ms(r.headers.get("Last-Modified", "")),
                 r.headers.get("ETag", ""))
-
-    def delete(self, key: str) -> bool:
-        r = self._request("DELETE", self._url(key))
-        return r.status_code in (200, 202, 204)
 
     def copy(self, src_key: str, dst_key: str) -> bool:
         r = self._request(
@@ -237,20 +238,6 @@ class AdlsGen2Client(_AzureRestBase):
             "PATCH", self._url(key, f"action=flush&position={len(data)}"))
         r.raise_for_status()
 
-    def get(self, key: str, offset: int = 0,
-            length: Optional[int] = None) -> Optional[bytes]:
-        headers = {}
-        if offset or length is not None:
-            end = "" if length is None else str(offset + length - 1)
-            headers["Range"] = f"bytes={offset}-{end}"
-        r = self._request("GET", self._url(key), headers=headers)
-        if r.status_code == 404:
-            return None
-        if r.status_code == 416:
-            return b""
-        r.raise_for_status()
-        return r.content
-
     def head(self, key: str) -> Optional[Tuple[int, int, str]]:
         r = self._request("HEAD", self._url(key))
         if r.status_code == 404:
@@ -261,10 +248,6 @@ class AdlsGen2Client(_AzureRestBase):
         return (int(r.headers.get("Content-Length", 0)),
                 _http_date_ms(r.headers.get("Last-Modified", "")),
                 r.headers.get("ETag", ""))
-
-    def delete(self, key: str) -> bool:
-        r = self._request("DELETE", self._url(key))
-        return r.status_code in (200, 202, 204)
 
     def copy(self, src_key: str, dst_key: str) -> bool:
         # the DFS dialect has rename but no server-side copy: stream

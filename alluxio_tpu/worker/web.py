@@ -100,12 +100,14 @@ class WorkerWebServer:
                 if route == "/api/v1/worker/blocks":
                     out = {}
                     for t in meta.tiers:
-                        ids = [b for d in t.dirs
-                               for b in d.block_ids()]
-                        out[t.alias] = {
-                            "count": len(ids),
-                            "sample": ids[:_BLOCK_LIST_CAP],
-                        }
+                        count, sample = 0, []
+                        for d in t.dirs:
+                            for b in d.block_ids():
+                                count += 1
+                                if len(sample) < _BLOCK_LIST_CAP:
+                                    sample.append(b)
+                        out[t.alias] = {"count": count,
+                                        "sample": sample}
                     return {"blocks": out}
                 if route == "/api/v1/worker/metrics":
                     from alluxio_tpu.metrics import metrics

@@ -33,7 +33,7 @@ class TestMultiProcess:
             # hard-kill the current primary (master 0 wins the lock first)
             c.masters[0].kill()
             # the standby must take the lock, replay, and serve
-            deadline = time.monotonic() + 60
+            deadline = time.monotonic() + 180
             ok = False
             while time.monotonic() < deadline:
                 try:
@@ -62,7 +62,7 @@ class TestMultiProcess:
         with MultiProcessCluster(str(tmp_path), num_masters=3,
                                  num_workers=0,
                                  journal_type="EMBEDDED") as c:
-            def primary_index(timeout_s=60.0):
+            def primary_index(timeout_s=180.0):
                 deadline = time.monotonic() + timeout_s
                 while time.monotonic() < deadline:
                     for i, port in enumerate(c.master_ports):

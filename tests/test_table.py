@@ -190,7 +190,7 @@ class TestTransform:
         job_id = tc.transform_table("warehouse", "sales")
         # wait for the JOB to finish, then restart the master before
         # (possibly) any monitor tick applied the layout
-        cluster.job_client().wait_for_job(job_id, timeout_s=60.0)
+        cluster.job_client().wait_for_job(job_id, timeout_s=180.0)
         cluster.master.stop()
         from alluxio_tpu.master.process import MasterProcess
 
@@ -199,7 +199,7 @@ class TestTransform:
         m2.start()
         cluster.master = m2
         tc2 = TableMasterClient(m2.address)
-        deadline = time.monotonic() + 60.0
+        deadline = time.monotonic() + 180.0
         while True:
             st = tc2.transform_status(job_id)
             if st.get("applied"):

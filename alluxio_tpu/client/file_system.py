@@ -70,6 +70,10 @@ class FileSystem:
     def __init__(self, master_address: str,
                  conf: Optional[Configuration] = None) -> None:
         self._conf = conf or Configuration()
+        if self._conf.get_bool(Keys.TRACE_ENABLED):
+            from alluxio_tpu.utils.tracing import set_tracing_enabled
+
+            set_tracing_enabled(True)
         from alluxio_tpu.security.authentication import client_metadata
 
         md = tuple(client_metadata(self._conf))

@@ -33,6 +33,7 @@ from alluxio_tpu.client.cache.hbm_store import HbmPageStore
 from alluxio_tpu.client.cache.meta import PageId
 from alluxio_tpu.client.file_system import FileSystem
 from alluxio_tpu.metrics import metrics
+from alluxio_tpu.utils.tracing import annotate
 
 
 class DeviceBlockLoader:
@@ -170,9 +171,10 @@ class DeviceBlockLoader:
                             lease.close()
                             self._put(q, stop, (pid, arr, True))
                             continue
-                    host = self._host_bytes(path, index)
-                    if host.size:  # pre-fault mmap pages off the
-                        host[::4096].max()  # transfer thread's clock
+                    with annotate("atpu.loader.host_read"):
+                        host = self._host_bytes(path, index)
+                        if host.size:  # pre-fault mmap pages off the
+                            host[::4096].max()  # transfer thread's clock
                     self._put(q, stop, (pid, host, False))
             except BaseException as e:  # noqa: BLE001 re-raised in consumer
                 # a read failure must FAIL the epoch, not silently end
@@ -217,7 +219,8 @@ class DeviceBlockLoader:
                 if on_device:
                     arr = data
                 else:
-                    arr = self._jax.device_put(data, self._device)
+                    with annotate("atpu.loader.h2d"):
+                        arr = self._jax.device_put(data, self._device)
                     if self._hbm is not None:
                         self._hbm.adopt(pid, arr)  # no second transfer
                 inflight.append(arr)

@@ -611,6 +611,11 @@ class Keys:
         "atpu.fuse.mount.options", KeyType.STRING, default="",
         scope=Scope.CLIENT,
         description="Extra -o mount options (e.g. allow_other).")
+    TRACE_ENABLED = _k(
+        "atpu.trace.enabled", KeyType.BOOL, default=False,
+        scope=Scope.ALL,
+        description="Record RPC/operation spans into the in-process "
+                    "trace ring (served at /api/v1/master/trace).")
     METRICS_SINKS = _k(
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,

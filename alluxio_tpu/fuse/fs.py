@@ -45,11 +45,15 @@ def _neg_errno(e: Exception) -> int:
 
 
 class _OpenFile:
-    """One open handle: a read stream OR a sequential write stream."""
+    """One open handle: a read stream, a sequential write stream, or a
+    deferred write (``lazy_path``: an existing file opened writable
+    without O_TRUNC — content is preserved unless a write arrives)."""
 
-    def __init__(self, reader=None, writer=None) -> None:
+    def __init__(self, reader=None, writer=None,
+                 lazy_path: Optional[str] = None) -> None:
         self.reader = reader
         self.writer = writer
+        self.lazy_path = lazy_path
         self.write_pos = 0
         self.lock = threading.Lock()
 
@@ -156,8 +160,23 @@ class FuseFs:
         full = self._path(path)
         try:
             if write:
+                try:
+                    st = self._fs.get_status(full)
+                except FileDoesNotExistError:
+                    # O_CREAT on a fresh path (kernels with a create
+                    # callback normally route here only for existing
+                    # files, but be safe)
+                    return self._add(_OpenFile(
+                        writer=self._fs.create_file(full)))
+                if st.folder:
+                    return -errno.EISDIR
+                # EXISTING file, no O_TRUNC (the kernel truncates via a
+                # separate truncate() call): POSIX demands the content
+                # survive until something actually writes — `touch` and
+                # read-only r+ opens must not wipe the file
                 return self._add(_OpenFile(
-                    writer=self._fs.create_file(full, overwrite=True)))
+                    reader=self._fs.open_file(full, info=st),
+                    lazy_path=full))
             st = self._fs.get_status(full)
             if st.folder:
                 return -errno.EISDIR
@@ -186,7 +205,24 @@ class FuseFs:
     def write(self, fh: int, data: bytes, offset: int) -> int:
         """Sequential-only, like the reference FUSE adapter."""
         of = self._get(fh)
-        if of is None or of.writer is None:
+        if of is None:
+            return -errno.EBADF
+        if of.writer is None and of.lazy_path is not None:
+            # first write through a deferred handle: a full rewrite
+            # from offset 0 is the one pattern blocks support
+            with of.lock:
+                if of.writer is None:
+                    if offset != 0:
+                        return -errno.EOPNOTSUPP
+                    try:
+                        if of.reader is not None:
+                            of.reader.close()
+                            of.reader = None
+                        of.writer = self._fs.create_file(
+                            of.lazy_path, overwrite=True)
+                    except Exception as e:  # noqa: BLE001
+                        return _neg_errno(e)
+        if of.writer is None:
             return -errno.EBADF
         with of.lock:
             if offset != of.write_pos:

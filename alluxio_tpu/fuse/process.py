@@ -83,7 +83,11 @@ class AlluxioFuseMount:
             if isinstance(r, int):
                 return r
             for name in [".", ".."] + r:
-                if filler(buf, name.encode(), None, 0):
+                # surrogateescape round-trips non-UTF-8 names that
+                # _dec() admitted; strict encode would EIO the whole
+                # directory listing over one bad name
+                if filler(buf, name.encode("utf-8", "surrogateescape"),
+                          None, 0):
                     break
             return 0
 

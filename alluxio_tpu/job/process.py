@@ -44,6 +44,9 @@ class JobMasterProcess:
         self._threads = []
 
     def start(self) -> int:
+        from alluxio_tpu.utils.tracing import set_tracing_enabled
+
+        set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
         self.rpc_server = RpcServer(
             bind_host="0.0.0.0",
             port=self._conf.get_int(Keys.JOB_MASTER_RPC_PORT))

@@ -94,6 +94,7 @@ class BlockMaster(Journaled):
         # soft state
         self._workers: Dict[int, MasterWorkerInfo] = {}
         self._lost_workers: Dict[int, MasterWorkerInfo] = {}
+        self._top_tiers: "frozenset[str]" = frozenset()
         self._address_to_id: Dict[str, int] = {}
         #: block id -> {worker id -> tier alias}
         self._locations: Dict[int, Dict[int, str]] = {}
@@ -130,6 +131,7 @@ class BlockMaster(Journaled):
                 lost = self._lost_workers.pop(existing, None)
                 if lost is not None:
                     self._workers[existing] = lost
+                    self._refresh_top_tiers()
                 return existing
             wid = ids.create_worker_id(address.host, address.rpc_port)
             info = MasterWorkerInfo(id=wid, address=address,
@@ -170,6 +172,7 @@ class BlockMaster(Journaled):
             info.used_bytes_on_tiers = dict(used_bytes_on_tiers)
             info.last_contact_ms = self._clock.millis()
             info.registered = True
+            self._refresh_top_tiers()
             for tier, bids in blocks_on_tiers.items():
                 for bid in bids:
                     if bid in self._blocks:
@@ -234,6 +237,7 @@ class BlockMaster(Journaled):
                     del self._workers[wid]
                     self._lost_workers[wid] = info
                     info.registered = False
+                    self._refresh_top_tiers()
                     for bid in list(info.blocks):
                         self._remove_location(bid, wid)
                     info.blocks.clear()
@@ -255,6 +259,7 @@ class BlockMaster(Journaled):
                 return
             self._lost_workers[worker_id] = info
             info.registered = False
+            self._refresh_top_tiers()
             for bid in list(info.blocks):
                 self._remove_location(bid, worker_id)
             info.blocks.clear()
@@ -439,6 +444,25 @@ class BlockMaster(Journaled):
                 for tier, n in w.used_bytes_on_tiers.items():
                     out[tier] = out.get(tier, 0) + n
         return out
+
+    def top_tiers(self) -> "frozenset[str]":
+        """Aliases of each live worker's FASTEST tier, from registered
+        topology (workers register tiers top-down; dict order carries
+        the ordinal). Replaces hardcoded device-tier name lists —
+        tier semantics belong to worker metadata (reference:
+        ``worker/block/meta/StorageTier.java:48``). Cached: recomputed
+        on membership changes, read lock-free (it sits on every
+        ``_file_info`` call in a ``list_status`` loop)."""
+        return self._top_tiers
+
+    def _refresh_top_tiers(self) -> None:
+        """Caller holds ``self._lock``."""
+        out = set()
+        for w in self._workers.values():
+            for tier in w.capacity_bytes_on_tiers:
+                out.add(tier)
+                break  # first registered = top tier
+        self._top_tiers = frozenset(out)
 
     # ---------------------------------------------------- journal contract
     def process_entry(self, entry: JournalEntry) -> bool:

@@ -49,7 +49,9 @@ from alluxio_tpu.utils.wire import (
 LOG = logging.getLogger(__name__)
 
 ROOT_MOUNT_ID = 1
-_DEVICE_TIERS = ("HBM", "MEM")
+#: fallback for "fast tier" classification before any worker registers
+#: its topology (the live answer comes from BlockMaster.top_tiers())
+_DEFAULT_DEVICE_TIERS = frozenset(("HBM", "MEM"))
 
 
 class FileSystemMaster:
@@ -266,9 +268,11 @@ class FileSystemMaster:
         fbi: List[FileBlockInfo] = []
         if not inode.is_directory and inode.block_ids:
             fbi = self._file_block_infos(inode)
+            fast = self._block_master.top_tiers() or \
+                _DEFAULT_DEVICE_TIERS
             mem_bytes = 0
             for f in fbi:
-                if any(loc.tier_alias in _DEVICE_TIERS
+                if any(loc.tier_alias in fast
                        for loc in f.block_info.locations):
                     mem_bytes += f.block_info.length
             in_mem = int(100 * mem_bytes / inode.length) if inode.length else (

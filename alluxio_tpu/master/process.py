@@ -142,6 +142,9 @@ class MasterProcess:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
         """Boot straight to primary; returns the bound RPC port."""
+        from alluxio_tpu.utils.tracing import set_tracing_enabled
+
+        set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
         self.journal.start()
         backup = self._conf.get(Keys.MASTER_JOURNAL_INIT_FROM_BACKUP)
         if backup and hasattr(self.journal, "init_from_backup"):

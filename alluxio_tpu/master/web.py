@@ -112,6 +112,11 @@ class MasterWebServer:
                     return {"databases": {
                         db: tm.list_tables(db)
                         for db in tm.list_databases()}}
+                if route == "/api/v1/master/trace":
+                    from alluxio_tpu.utils.tracing import tracer
+
+                    return {"enabled": tracer().enabled,
+                            "spans": tracer().snapshot()}
                 return None
 
         self._server = ThreadingHTTPServer((bind_host, port), Handler)

@@ -76,12 +76,16 @@ def _unbind_user(token) -> None:
         reset_authenticated_user(token)
 
 
-def _wrap_unary(fn: Callable[[dict], Any], authenticator=None) -> Callable:
+def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
+                span_name: str = "") -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
+        from alluxio_tpu.utils.tracing import tracer
+
         token = None
         try:
-            token = _bind_user(context, authenticator)
-            return fn(request or {})
+            with tracer().span(span_name or "rpc.unary"):
+                token = _bind_user(context, authenticator)
+                return fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
             context.abort(_CODE_TO_GRPC.get(e.code, grpc.StatusCode.INTERNAL),
@@ -96,12 +100,15 @@ def _wrap_unary(fn: Callable[[dict], Any], authenticator=None) -> Callable:
 
 
 def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
-                     authenticator=None) -> Callable:
+                     authenticator=None, span_name: str = "") -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
+        from alluxio_tpu.utils.tracing import tracer
+
         token = None
         try:
-            token = _bind_user(context, authenticator)
-            yield from fn(request or {})
+            with tracer().span(span_name or "rpc.stream_out"):
+                token = _bind_user(context, authenticator)
+                yield from fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
             context.abort(_CODE_TO_GRPC.get(e.code, grpc.StatusCode.INTERNAL),
@@ -116,12 +123,15 @@ def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
 
 
 def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any],
-                    authenticator=None) -> Callable:
+                    authenticator=None, span_name: str = "") -> Callable:
     def handler(request_iterator, context: grpc.ServicerContext):
+        from alluxio_tpu.utils.tracing import tracer
+
         token = None
         try:
-            token = _bind_user(context, authenticator)
-            return fn(request_iterator)
+            with tracer().span(span_name or "rpc.stream_in"):
+                token = _bind_user(context, authenticator)
+                return fn(request_iterator)
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
             context.abort(_CODE_TO_GRPC.get(e.code, grpc.StatusCode.INTERNAL),
@@ -169,17 +179,19 @@ class _GenericHandler(grpc.GenericRpcHandler):
         if entry is None:
             return None
         fn, kind = entry
+        span = f"{service_name}.{method}"
         if kind == "unary":
             return grpc.unary_unary_rpc_method_handler(
-                _wrap_unary(fn, self._auth), request_deserializer=unpack,
+                _wrap_unary(fn, self._auth, span),
+                request_deserializer=unpack,
                 response_serializer=pack)
         if kind == "stream_out":
             return grpc.unary_stream_rpc_method_handler(
-                _wrap_stream_out(fn, self._auth),
+                _wrap_stream_out(fn, self._auth, span),
                 request_deserializer=unpack, response_serializer=pack)
         if kind == "stream_in":
             return grpc.stream_unary_rpc_method_handler(
-                _wrap_stream_in(fn, self._auth),
+                _wrap_stream_in(fn, self._auth, span),
                 request_deserializer=unpack, response_serializer=pack)
         return None
 

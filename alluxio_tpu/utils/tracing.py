@@ -1,0 +1,193 @@
+"""Lightweight span tracing + device-profiler bridge.
+
+Re-design of the reference's tracing/profiling surface (SURVEY §5.1:
+opentelemetry-style server spans + worker-side profiling hooks): a
+process-local ring of recent spans with nesting via contextvars, cheap
+enough to leave compiled in — recording is O(1) deque appends gated on
+one bool — plus the TPU side: ``device_trace`` wraps
+``jax.profiler.start_trace`` (xprof capture: MXU occupancy, HBM reads,
+ICI traffic) and ``annotate`` threads host-span names onto the device
+timeline so loader stages line up with XLA ops in the trace viewer.
+
+Spans surface at ``/api/v1/master/trace`` (master web) and via
+``Tracer.snapshot()`` anywhere else.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "atpu_span", default=None)
+
+_RING_CAP = 4096
+
+
+class Span:
+    __slots__ = ("name", "start_ms", "duration_ms", "parent", "span_id",
+                 "tags", "thread", "error")
+
+    def __init__(self, name: str, span_id: int,
+                 parent: Optional[int]) -> None:
+        self.name = name
+        self.span_id = span_id
+        self.parent = parent
+        self.start_ms = time.time() * 1000.0
+        self.duration_ms: Optional[float] = None
+        self.tags: Dict[str, str] = {}
+        self.thread = threading.current_thread().name
+        self.error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "span_id": self.span_id,
+            "parent": self.parent, "start_ms": round(self.start_ms, 3),
+            "duration_ms": None if self.duration_ms is None
+            else round(self.duration_ms, 3),
+            "thread": self.thread, "tags": self.tags,
+            "error": self.error,
+        }
+
+
+class Tracer:
+    """Process tracer: bounded ring of completed spans."""
+
+    def __init__(self, capacity: int = _RING_CAP) -> None:
+        self.enabled = False
+        self._ring: deque = deque(maxlen=capacity)
+        self._next_id = 1
+        self._lock = threading.Lock()
+
+    def _new_id(self) -> int:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            return sid
+
+    def span(self, name: str, **tags: str):
+        """Context manager recording one span (no-op when disabled)."""
+        return _SpanCtx(self, name, tags)
+
+    def record(self, span: Span) -> None:
+        self._ring.append(span)
+
+    def snapshot(self, limit: int = 500,
+                 prefix: str = "") -> List[dict]:
+        """Most-recent-first dump of completed spans."""
+        out = []
+        for s in reversed(self._ring):
+            if prefix and not s.name.startswith(prefix):
+                continue
+            out.append(s.to_dict())
+            if len(out) >= limit:
+                break
+        return out
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_name", "_tags", "_span", "_token", "_t0")
+
+    def __init__(self, tracer: Tracer, name: str,
+                 tags: Dict[str, str]) -> None:
+        self._tracer = tracer
+        self._name = name
+        self._tags = tags
+        self._span: Optional[Span] = None
+        self._token = None
+
+    def __enter__(self) -> Optional[Span]:
+        if not self._tracer.enabled:
+            return None
+        parent = _current_span.get()
+        self._span = Span(self._name, self._tracer._new_id(),
+                          parent.span_id if parent else None)
+        if self._tags:
+            self._span.tags.update(
+                {k: str(v) for k, v in self._tags.items()})
+        self._token = _current_span.set(self._span)
+        self._t0 = time.perf_counter()
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._span is not None:
+            self._span.duration_ms = \
+                (time.perf_counter() - self._t0) * 1000.0
+            if exc is not None:
+                self._span.error = f"{type(exc).__name__}: {exc}"
+            _current_span.reset(self._token)
+            self._tracer.record(self._span)
+        return False
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    return _TRACER
+
+
+def set_tracing_enabled(on: bool) -> None:
+    _TRACER.enabled = bool(on)
+
+
+# -- device-side (TPU) bridge ------------------------------------------------
+class device_trace:
+    """Capture an xprof/TensorBoard trace of everything the device does
+    inside the block (compiled op timeline, HBM traffic). Usage::
+
+        with device_trace("/tmp/xprof"):
+            train_step(...)
+            jax.block_until_ready(loss)
+    """
+
+    def __init__(self, log_dir: str) -> None:
+        self._dir = log_dir
+
+    def __enter__(self) -> "device_trace":
+        import jax
+
+        jax.profiler.start_trace(self._dir)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        import jax
+
+        jax.profiler.stop_trace()
+        return False
+
+
+_TA = None  # resolved TraceAnnotation class (False = jax unavailable)
+
+
+def annotate(name: str):
+    """Host-span name on the DEVICE timeline (shows up in xprof around
+    whatever the annotated host code dispatches). Also records a host
+    span when tracing is enabled, so host and device views correlate.
+    The jax lookup is resolved once; per-call cost is one class
+    construction (a no-op C object outside an active capture)."""
+    import contextlib
+
+    global _TA
+    if _TA is None:
+        try:
+            import jax
+
+            _TA = jax.profiler.TraceAnnotation
+        except Exception:  # noqa: BLE001 - no jax in control-plane procs
+            _TA = False
+    dev = _TA(name) if _TA else contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def both() -> Iterator[None]:
+        with _TRACER.span(name):
+            with dev:
+                yield
+
+    return both()

@@ -31,6 +31,20 @@ def _register(bm, addr=None, blocks=None):
     return wid
 
 
+class TestTopTiers:
+    def test_top_tiers_follow_registered_topology(self, bm):
+        assert bm.top_tiers() == frozenset()
+        w1 = bm.get_worker_id(_addr("h1"))
+        bm.worker_register(w1, {"HBM": 100, "MEM": 1000},
+                           {"HBM": 0, "MEM": 0}, {})
+        assert bm.top_tiers() == {"HBM"}
+        # a second worker with a different topology unions in
+        w2 = bm.get_worker_id(_addr("h2"))
+        bm.worker_register(w2, {"MEM": 1000, "SSD": 5000},
+                           {"MEM": 0, "SSD": 0}, {})
+        assert bm.top_tiers() == {"HBM", "MEM"}
+
+
 class TestWorkerProtocol:
     def test_register_and_report(self, bm):
         wid = _register(bm)

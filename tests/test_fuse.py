@@ -78,16 +78,36 @@ class TestFuseFsCallbacks:
         assert impl.truncate("/t2.bin", 3) == -errno.EOPNOTSUPP
         assert impl.truncate("/nope", 0) == -errno.ENOENT
 
+    def test_writable_open_without_trunc_preserves_content(self, cluster,
+                                                           impl):
+        """Regression: O_WRONLY/O_RDWR without O_TRUNC (touch, r+) must
+        NOT wipe an existing file — only an actual write rewrites it."""
+        fs = cluster.file_system()
+        fs.write_all("/keep.bin", b"precious")
+        fh = impl.open("/keep.bin", write=True)
+        assert fh > 0
+        # touch-like: open + close, no writes -> content survives
+        assert impl.flush(fh) == 0
+        assert impl.release(fh) == 0
+        assert fs.read_all("/keep.bin") == b"precious"
+        # r+-like rewrite from offset 0 replaces content
+        fh = impl.open("/keep.bin", write=True)
+        assert impl.read(fh, 4, 0) == b"prec"  # readable until a write
+        assert impl.write(fh, b"newdata", 0) == 7
+        assert impl.flush(fh) == 0 and impl.release(fh) == 0
+        assert fs.read_all("/keep.bin") == b"newdata"
+        # mid-file writes through a deferred handle are unsupported
+        fh = impl.open("/keep.bin", write=True)
+        import errno as _e
+
+        assert impl.write(fh, b"x", 3) == -_e.EOPNOTSUPP
+        impl.release(fh)
+        assert fs.read_all("/keep.bin") == b"newdata"
+
     def test_bad_handles(self, impl):
         assert impl.read(999, 1, 0) == -errno.EBADF
         assert impl.write(999, b"x", 0) == -errno.EBADF
         assert impl.release(999) == 0  # idempotent
-
-
-def _can_mount(tmp_path) -> bool:
-    from alluxio_tpu.fuse.process import fuse_available
-
-    return fuse_available()
 
 
 class TestKernelMount:

@@ -1,0 +1,176 @@
+"""Race-detection tooling tests + real-subsystem lock-order audits
+(the sanitizer-CI analogue; SURVEY §5.2)."""
+
+import threading
+import time
+
+import pytest
+
+from alluxio_tpu.utils.race import LockOrderAuditor, Watchdog
+from alluxio_tpu.utils.tracing import (
+    set_tracing_enabled, tracer,
+)
+
+
+class TestLockOrderAuditor:
+    def test_detects_ab_ba_inversion_without_deadlocking(self):
+        aud = LockOrderAuditor()
+        a = aud.wrap(threading.Lock(), "A")
+        b = aud.wrap(threading.Lock(), "B")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        # run sequentially: the auditor must flag the ORDER, not need
+        # an actual deadlock schedule
+        t1()
+        t2()
+        assert aud.inversions() == [("A", "B")]
+        with pytest.raises(AssertionError, match="inversion"):
+            aud.assert_clean()
+        assert "A held while acquiring B" in aud.report()
+
+    def test_consistent_order_is_clean(self):
+        aud = LockOrderAuditor()
+        a = aud.wrap(threading.Lock(), "A")
+        b = aud.wrap(threading.Lock(), "B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        aud.assert_clean()
+
+    def test_reentrant_acquire_not_flagged(self):
+        aud = LockOrderAuditor()
+        r = aud.wrap(threading.RLock(), "R")
+        with r:
+            with r:
+                pass
+        aud.assert_clean()
+
+    def test_instrument_attr_in_place(self):
+        class Holder:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        h = Holder()
+        aud = LockOrderAuditor()
+        aud.instrument_attr(h, "_lock", "holder")
+        with h._lock:
+            pass
+        assert not aud.inversions()
+
+
+class TestWatchdog:
+    def test_fires_and_raises(self):
+        import io
+
+        buf = io.StringIO()
+        with pytest.raises(TimeoutError, match="watchdog"):
+            with Watchdog(0.2, stream=buf):
+                time.sleep(0.6)
+        assert "thread dump" in buf.getvalue()
+
+    def test_quiet_when_fast(self):
+        with Watchdog(5.0):
+            pass
+
+
+class TestInodeTreeLockOrder:
+    def test_concurrent_namespace_ops_have_no_inversions(self, tmp_path):
+        """Audit the REAL master lock stack under a concurrent
+        create/list/delete workload: inode-tree RWLock vs metastore and
+        block-master locks must be acquired in one global order."""
+        from alluxio_tpu.minicluster import LocalCluster
+
+        with LocalCluster(str(tmp_path), num_workers=1) as cluster:
+            aud = LockOrderAuditor()
+            fm = cluster.master.fs_master
+            aud.instrument_attr(fm.inode_tree, "lock", "inode_tree")
+            aud.instrument_attr(cluster.master.block_master, "_lock",
+                                "block_master")
+            fs = cluster.file_system()
+
+            errors = []
+
+            def worker(n):
+                try:
+                    for i in range(8):
+                        fs.write_all(f"/race/{n}/f{i}", b"x" * 64)
+                    fs.list_status("/race", recursive=True)
+                    for i in range(8):
+                        fs.delete(f"/race/{n}/f{i}")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            with Watchdog(120):
+                threads = [threading.Thread(target=worker, args=(n,))
+                           for n in range(4)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            assert not errors, errors
+            aud.assert_clean()
+
+
+class TestTracing:
+    def test_span_nesting_and_snapshot(self):
+        set_tracing_enabled(True)
+        try:
+            tracer().clear()
+            with tracer().span("outer", user="t"):
+                with tracer().span("inner"):
+                    pass
+            spans = tracer().snapshot()
+            by_name = {s["name"]: s for s in spans}
+            assert by_name["inner"]["parent"] == \
+                by_name["outer"]["span_id"]
+            assert by_name["outer"]["tags"] == {"user": "t"}
+            assert by_name["inner"]["duration_ms"] is not None
+        finally:
+            set_tracing_enabled(False)
+
+    def test_disabled_records_nothing(self):
+        tracer().clear()
+        with tracer().span("ghost"):
+            pass
+        assert tracer().snapshot() == []
+
+    def test_error_recorded(self):
+        set_tracing_enabled(True)
+        try:
+            tracer().clear()
+            with pytest.raises(ValueError):
+                with tracer().span("boom"):
+                    raise ValueError("nope")
+            (span,) = tracer().snapshot()
+            assert "ValueError" in span["error"]
+        finally:
+            set_tracing_enabled(False)
+
+    def test_rpc_spans_recorded_end_to_end(self, tmp_path):
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.minicluster import LocalCluster
+
+        with LocalCluster(str(tmp_path), num_workers=1,
+                          conf_overrides={Keys.TRACE_ENABLED: True}) as c:
+            tracer().clear()
+            fs = c.file_system()
+            fs.write_all("/traced.bin", b"x")
+            names = {s["name"] for s in tracer().snapshot(limit=2000)}
+            assert any(n.endswith(".create_file") for n in names), names
+        set_tracing_enabled(False)
+
+    def test_annotate_without_device_session(self):
+        from alluxio_tpu.utils.tracing import annotate
+
+        with annotate("host.only"):
+            pass  # must not require an active profiler

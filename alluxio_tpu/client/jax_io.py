@@ -173,8 +173,13 @@ class DeviceBlockLoader:
                             continue
                     with annotate("atpu.loader.host_read"):
                         host = self._host_bytes(path, index)
-                        if host.size:  # pre-fault mmap pages off the
-                            host[::4096].max()  # transfer thread's clock
+                        if host.size:
+                            # pre-fault mmap pages off the transfer
+                            # thread's clock (native: GIL-free touch)
+                            from alluxio_tpu import native
+
+                            if not native.prefault(host):
+                                host[::4096].max()
                     self._put(q, stop, (pid, host, False))
             except BaseException as e:  # noqa: BLE001 re-raised in consumer
                 # a read failure must FAIL the epoch, not silently end

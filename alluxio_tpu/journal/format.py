@@ -22,6 +22,30 @@ import msgpack
 _HEADER = struct.Struct("<II")  # length, crc32
 
 
+def iter_frames(data: bytes) -> "Iterator[tuple]":
+    """Yield ``(body_offset, body_length)`` for each valid
+    ``[u32 len][u32 crc32][body]`` frame in ``data``; stops cleanly at
+    the torn tail (short header/body, zero-length zero-padding guard,
+    or CRC mismatch). The ONE framing scanner for every log in the
+    system (journal segments and the Raft log share the layout) —
+    native (``alluxio_tpu.native``, zero-copy, no per-frame
+    allocations) when built, Python fallback otherwise."""
+    from alluxio_tpu import native
+
+    scan = native.scan_frames(data)
+    if scan is not None:
+        yield from scan[0]
+        return
+    pos, n = 0, len(data)
+    while pos + _HEADER.size <= n:
+        length, crc = _HEADER.unpack_from(data, pos)
+        body = data[pos + _HEADER.size:pos + _HEADER.size + length]
+        if length == 0 or len(body) < length or zlib.crc32(body) != crc:
+            return  # torn tail — replay stops at last durable frame
+        yield pos + _HEADER.size, length
+        pos += _HEADER.size + length
+
+
 class EntryType:
     """Catalog of journal entry types (union members in the reference's
     ``journal.proto``). String-typed for forward compatibility."""
@@ -76,15 +100,10 @@ class JournalEntry:
     @staticmethod
     def decode_stream(f: BinaryIO) -> Iterator["JournalEntry"]:
         """Yield entries until EOF or a torn/corrupt record (clean stop)."""
-        while True:
-            header = f.read(_HEADER.size)
-            if len(header) < _HEADER.size:
-                return
-            length, crc = _HEADER.unpack(header)
-            body = f.read(length)
-            if len(body) < length or zlib.crc32(body) != crc:
-                return  # torn tail — replay stops at last durable entry
-            seq, etype, payload = msgpack.unpackb(body, raw=False)
+        data = f.read()
+        for off, length in iter_frames(data):
+            seq, etype, payload = msgpack.unpackb(
+                data[off:off + length], raw=False)
             yield JournalEntry(seq, etype, payload)
 
 

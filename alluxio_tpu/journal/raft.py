@@ -121,24 +121,18 @@ class RaftLog:
         if os.path.exists(self._log_path):
             off = 0
             with open(self._log_path, "rb") as f:
-                while True:
-                    hdr = f.read(_FRAME.size)
-                    if len(hdr) < _FRAME.size:
-                        break
-                    length, crc = _FRAME.unpack(hdr)
-                    if length == 0:
-                        break  # zero padding: crc32(b'')==0 would "pass"
-                    body = f.read(length)
-                    if len(body) < length or zlib.crc32(body) != crc:
-                        break  # torn tail
-                    try:
-                        rec = RaftRecord.from_wire(
-                            msgpack.unpackb(body, raw=False))
-                    except Exception:  # noqa: BLE001 crc-coincident garbage
-                        break  # treat as torn tail, same as format.py
-                    self.records.append(rec)
-                    self._offsets.append(off)
-                    off += _FRAME.size + length
+                data = f.read()
+            from alluxio_tpu.journal.format import iter_frames
+
+            for body_off, length in iter_frames(data):
+                try:
+                    rec = RaftRecord.from_wire(msgpack.unpackb(
+                        data[body_off:body_off + length], raw=False))
+                except Exception:  # noqa: BLE001 crc-coincident garbage
+                    break  # treat as torn tail, same as format.py
+                self.records.append(rec)
+                self._offsets.append(body_off - _FRAME.size)
+                off = body_off + length
             # a torn tail MUST be truncated away before appending: 'ab'
             # positions past the garbage, and records written after it
             # would be unreadable on the next restart (scan stops at the

@@ -1,0 +1,160 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded via ctypes.
+
+``lib()`` returns the loaded library or ``None`` (no g++ / build
+failure) — callers keep their pure-Python path as the fallback, so the
+native layer is an accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "framing.cpp")
+_SO = os.path.join(_DIR, "_libatpu_native.so")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None | bool" = None  # None=untried, False=failed
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library when missing or stale."""
+    try:
+        if os.path.exists(_SO) and \
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+        # build into a temp file then rename: concurrent processes
+        # (minicluster roles) must never dlopen a half-written .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp, _SRC]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            LOG.warning("native build failed: %s", r.stderr.decode()[:500])
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, _SO)
+        return _SO
+    except Exception:  # noqa: BLE001 - no toolchain: python fallback
+        LOG.debug("native build unavailable", exc_info=True)
+        return None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib or None
+    with _lock:
+        if _lib is not None:
+            return _lib or None
+        so = _build()
+        if so is None:
+            _lib = False
+            return None
+        try:
+            handle = ctypes.CDLL(so)
+        except OSError:
+            _lib = False
+            return None
+        handle.atpu_crc32.restype = ctypes.c_uint32
+        handle.atpu_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_uint32]
+        handle.atpu_scan_frames.restype = ctypes.c_size_t
+        handle.atpu_scan_frames.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
+        handle.atpu_prefault.restype = ctypes.c_uint64
+        handle.atpu_prefault.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                         ctypes.c_size_t]
+        _lib = handle
+        return handle
+
+
+def _buffer_address(view) -> "Tuple[int, int, object] | None":
+    """(address, nbytes, keepalive) of a buffer WITHOUT copying,
+    readonly or not — hold ``keepalive`` for the duration of the native
+    call. None when no zero-copy address is obtainable."""
+    # numpy arrays expose the address directly regardless of flags
+    data_attr = getattr(view, "ctypes", None)
+    if data_attr is not None and hasattr(data_attr, "data"):
+        return data_attr.data, view.nbytes, view
+    if isinstance(view, bytes):
+        # ctypes.cast of a bytes object points at its internal buffer
+        return (ctypes.cast(view, ctypes.c_void_p).value or 0,
+                len(view), view)
+    mv = memoryview(view)
+    if not mv.readonly:
+        buf = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+        return ctypes.addressof(buf), mv.nbytes, buf
+    return None
+
+
+_SCAN_CHUNK = 65536  # frames per native call: bounds the offset arrays
+
+
+def scan_frames(view) -> "Tuple[List[Tuple[int, int]], int] | None":
+    """Scan ``[u32 len][u32 crc][body]`` frames over a buffer
+    (bytes/bytearray/ndarray/mmap) with NO copy of the data. Returns
+    ``([(body_off, body_len), ...], end_off)`` — ``end_off`` is the
+    truncation point after the last valid frame — or ``None`` when the
+    native library (or a zero-copy address) is unavailable. The scan
+    runs in bounded chunks so offset arrays stay small regardless of
+    journal size."""
+    handle = lib()
+    if handle is None:
+        return None
+    loc = _buffer_address(view)
+    if loc is None:
+        return None
+    addr, n, keepalive = loc
+    if n == 0:
+        return [], 0
+    offs = (ctypes.c_uint64 * _SCAN_CHUNK)()
+    lens = (ctypes.c_uint32 * _SCAN_CHUNK)()
+    end = ctypes.c_uint64(0)
+    frames: List[Tuple[int, int]] = []
+    start = 0
+    while True:
+        got = handle.atpu_scan_frames(addr, n, start, offs, lens,
+                                      _SCAN_CHUNK, ctypes.byref(end))
+        frames.extend((offs[i], lens[i]) for i in range(got))
+        start = end.value
+        if got < _SCAN_CHUNK:
+            break
+    del keepalive
+    return frames, end.value
+
+
+def crc32(data: bytes, seed: int = 0) -> Optional[int]:
+    handle = lib()
+    if handle is None:
+        return None
+    return handle.atpu_crc32(data, len(data), seed)
+
+
+def prefault(view, stride: int = 4096) -> bool:
+    """Touch one byte per page, GIL-free, readonly-safe and zero-copy.
+    True when the native path ran (False -> caller falls back)."""
+    handle = lib()
+    if handle is None:
+        return False
+    loc = _buffer_address(view)
+    if loc is None:
+        return False
+    addr, n, keepalive = loc
+    if n:
+        handle.atpu_prefault(addr, n, stride)
+    del keepalive
+    return True

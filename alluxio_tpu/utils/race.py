@@ -121,7 +121,15 @@ class LockOrderAuditor:
         return getattr(self._held, "stack", None) or []
 
     def _before_acquire(self, name: str) -> None:
-        for held in self._stack():
+        pass  # edges record on SUCCESS only (see _acquired)
+
+    def _acquired(self, name: str) -> None:
+        # record order edges only for acquisitions that SUCCEEDED: a
+        # failed try-lock (the standard hold-A-trylock-B-backoff
+        # pattern) cannot deadlock and must not count as an edge —
+        # TSAN exempts try-lock edges for the same reason
+        stack = self._stack()
+        for held in stack:
             if held == name:
                 continue  # reentrant
             key = (held, name)
@@ -129,9 +137,6 @@ class LockOrderAuditor:
                 with self._edges_lock:
                     self.edges.setdefault(
                         key, "".join(traceback.format_stack(limit=12)))
-
-    def _acquired(self, name: str) -> None:
-        stack = self._stack()
         stack.append(name)
         self._held.stack = stack
 

@@ -79,7 +79,9 @@ class Tracer:
                  prefix: str = "") -> List[dict]:
         """Most-recent-first dump of completed spans."""
         out = []
-        for s in reversed(self._ring):
+        # atomic copy first: iterating the live deque races concurrent
+        # record() appends ("deque mutated during iteration")
+        for s in reversed(list(self._ring)):
             if prefix and not s.name.startswith(prefix):
                 continue
             out.append(s.to_dict())

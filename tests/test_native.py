@@ -1,0 +1,123 @@
+"""Native (C++) frame scanner tests: zlib/Python parity, torn-tail
+semantics, and the journal integration paths."""
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from alluxio_tpu import native
+
+H = struct.Struct("<II")
+
+
+def _frame(body: bytes) -> bytes:
+    return H.pack(len(body), zlib.crc32(body)) + body
+
+
+@pytest.fixture(scope="module")
+def lib():
+    handle = native.lib()
+    if handle is None:
+        pytest.skip("no native toolchain")
+    return handle
+
+
+class TestNativeScanner:
+    def test_crc32_matches_zlib(self, lib):
+        for payload in (b"", b"x", b"abc" * 1000, os.urandom(65536)):
+            assert native.crc32(payload) == zlib.crc32(payload)
+
+    def test_scan_parity_and_offsets(self, lib):
+        bodies = [os.urandom(1 + i % 50) for i in range(200)]
+        buf = b"".join(_frame(b) for b in bodies)
+        frames, end = native.scan_frames(buf)
+        assert len(frames) == 200 and end == len(buf)
+        for (off, ln), body in zip(frames, bodies):
+            assert buf[off:off + ln] == body
+
+    def test_torn_tail_stops_scan(self, lib):
+        good = _frame(b"alpha") + _frame(b"beta")
+        torn = good + H.pack(100, 999) + b"tiny"
+        frames, end = native.scan_frames(torn)
+        assert len(frames) == 2 and end == len(good)
+
+    def test_zero_padding_guard(self, lib):
+        good = _frame(b"alpha")
+        frames, end = native.scan_frames(good + b"\x00" * 32)
+        assert len(frames) == 1 and end == len(good)
+
+    def test_crc_mismatch_stops_scan(self, lib):
+        buf = bytearray(_frame(b"alpha") + _frame(b"beta"))
+        buf[len(_frame(b"alpha")) + 8] ^= 0xFF  # corrupt beta's body
+        frames, _ = native.scan_frames(bytes(buf))
+        assert len(frames) == 1
+
+    def test_empty_and_header_only(self, lib):
+        assert native.scan_frames(b"") == ([], 0)
+        frames, end = native.scan_frames(b"\x01\x02\x03")  # short header
+        assert frames == [] and end == 0
+
+    def test_chunked_scan_crosses_chunk_boundary(self, lib):
+        from alluxio_tpu.native import _SCAN_CHUNK
+
+        count = _SCAN_CHUNK + 17
+        body = b"ab"
+        buf = _frame(body) * count
+        frames, end = native.scan_frames(buf)
+        assert len(frames) == count and end == len(buf)
+
+    def test_scan_is_zero_copy_on_bytes(self, lib):
+        # bytes input must use the internal buffer directly (no
+        # from_buffer_copy path) — verify via a large buffer round trip
+        buf = _frame(os.urandom(100)) * 500
+        frames, end = native.scan_frames(buf)
+        assert len(frames) == 500 and end == len(buf)
+
+    def test_prefault_readonly_numpy_view(self, lib):
+        import numpy as np
+
+        raw = os.urandom(1 << 16)
+        arr = np.frombuffer(raw, dtype=np.uint8)  # readonly view
+        assert not arr.flags.writeable
+        assert native.prefault(arr) is True
+
+    def test_prefault_runs(self, lib):
+        import numpy as np
+
+        arr = np.frombuffer(os.urandom(1 << 16), dtype=np.uint8).copy()
+        assert native.prefault(arr) is True
+
+
+class TestJournalIntegration:
+    def test_decode_stream_uses_validated_frames(self, tmp_path, lib):
+        from alluxio_tpu.journal.format import JournalEntry
+
+        p = tmp_path / "journal.bin"
+        entries = [JournalEntry(i, "inode_create", {"i": i})
+                   for i in range(50)]
+        blob = b"".join(e.encode() for e in entries)
+        p.write_bytes(blob + b"\x00" * 16)  # zero-padded tail
+        with open(p, "rb") as f:
+            got = list(JournalEntry.decode_stream(f))
+        assert [e.sequence for e in got] == list(range(50))
+
+    def test_raft_log_open_native_scan(self, tmp_path, lib):
+        from alluxio_tpu.journal.format import JournalEntry
+        from alluxio_tpu.journal.raft import RaftLog, RaftRecord
+
+        log = RaftLog(str(tmp_path / "raft"))
+        log.open()
+        for i in range(1, 21):
+            log.append(RaftRecord(
+                1, i, [JournalEntry(i, "inode_create", {"i": i})]))
+        log.close()
+        # torn tail: append garbage after valid frames
+        with open(log._log_path, "ab") as f:
+            f.write(H.pack(1000, 42) + b"torn")
+        log2 = RaftLog(str(tmp_path / "raft"))
+        log2.open()
+        assert log2.last_index == 20
+        assert [r.index for r in log2.records] == list(range(1, 21))
+        log2.close()

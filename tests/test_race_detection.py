@@ -47,6 +47,23 @@ class TestLockOrderAuditor:
                     pass
         aud.assert_clean()
 
+    def test_failed_trylock_records_no_edge(self):
+        """hold-A-trylock-B-backoff cannot deadlock: a FAILED
+        non-blocking acquire must not create an order edge (TSAN
+        exempts try-lock edges for the same reason)."""
+        aud = LockOrderAuditor()
+        inner_b = threading.Lock()
+        a = aud.wrap(threading.Lock(), "A")
+        b = aud.wrap(inner_b, "B")
+        inner_b.acquire()  # someone else holds B
+        with a:
+            assert b.acquire(blocking=False) is False  # backs off
+        inner_b.release()
+        with b:
+            with a:  # B->A elsewhere is fine: A->B never succeeded
+                pass
+        aud.assert_clean()
+
     def test_reentrant_acquire_not_flagged(self):
         aud = LockOrderAuditor()
         r = aud.wrap(threading.RLock(), "R")

@@ -1,0 +1,27 @@
+# Developer entry points (packaging analogue of the reference's
+# build/ + assembly tooling).
+
+PY ?= python
+
+.PHONY: test test-fast native bench sdist clean lint
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:  ## skip multi-process (subprocess-spawning) tests
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+native:  ## force-rebuild the C++ layer
+	rm -f alluxio_tpu/native/_libatpu_native.so
+	$(PY) -c "import alluxio_tpu.native as n; assert n.lib() is not None"
+
+bench:
+	$(PY) bench.py
+
+sdist:
+	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	rm -f alluxio_tpu/native/_libatpu_native.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

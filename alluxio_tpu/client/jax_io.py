@@ -82,6 +82,11 @@ class DeviceBlockLoader:
         self._epoch_lock = threading.Lock()
         self._current_stop: Optional[threading.Event] = None
         self._closed = False
+        # warm the native layer at construction: its first use may g++
+        # -compile the .so, which must not land on the epoch hot path
+        from alluxio_tpu import native as _native
+
+        _native.lib()
 
     def __len__(self) -> int:
         return len(self._plan)

@@ -22,6 +22,18 @@ import msgpack
 _HEADER = struct.Struct("<II")  # length, crc32
 
 
+def map_or_read(f: BinaryIO):
+    """A contiguous view of a log file: mmap when possible (zero heap
+    copy on multi-GB recovery), ``f.read()`` fallback (pipes, empty
+    files — mmapping zero bytes raises)."""
+    import mmap
+
+    try:
+        return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    except (ValueError, OSError):
+        return f.read()
+
+
 def iter_frames(data: bytes) -> "Iterator[tuple]":
     """Yield ``(body_offset, body_length)`` for each valid
     ``[u32 len][u32 crc32][body]`` frame in ``data``; stops cleanly at
@@ -100,11 +112,15 @@ class JournalEntry:
     @staticmethod
     def decode_stream(f: BinaryIO) -> Iterator["JournalEntry"]:
         """Yield entries until EOF or a torn/corrupt record (clean stop)."""
-        data = f.read()
-        for off, length in iter_frames(data):
-            seq, etype, payload = msgpack.unpackb(
-                data[off:off + length], raw=False)
-            yield JournalEntry(seq, etype, payload)
+        data = map_or_read(f)
+        try:
+            for off, length in iter_frames(data):
+                seq, etype, payload = msgpack.unpackb(
+                    data[off:off + length], raw=False)
+                yield JournalEntry(seq, etype, payload)
+        finally:
+            if hasattr(data, "close"):
+                data.close()
 
 
 class Journaled:

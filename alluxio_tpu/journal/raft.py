@@ -120,19 +120,21 @@ class RaftLog:
         dirty = False
         if os.path.exists(self._log_path):
             off = 0
-            with open(self._log_path, "rb") as f:
-                data = f.read()
-            from alluxio_tpu.journal.format import iter_frames
+            from alluxio_tpu.journal.format import iter_frames, map_or_read
 
-            for body_off, length in iter_frames(data):
-                try:
-                    rec = RaftRecord.from_wire(msgpack.unpackb(
-                        data[body_off:body_off + length], raw=False))
-                except Exception:  # noqa: BLE001 crc-coincident garbage
-                    break  # treat as torn tail, same as format.py
-                self.records.append(rec)
-                self._offsets.append(body_off - _FRAME.size)
-                off = body_off + length
+            with open(self._log_path, "rb") as f:
+                data = map_or_read(f)
+                for body_off, length in iter_frames(data):
+                    try:
+                        rec = RaftRecord.from_wire(msgpack.unpackb(
+                            data[body_off:body_off + length], raw=False))
+                    except Exception:  # noqa: BLE001 crc-coincident junk
+                        break  # treat as torn tail, same as format.py
+                    self.records.append(rec)
+                    self._offsets.append(body_off - _FRAME.size)
+                    off = body_off + length
+                if hasattr(data, "close"):
+                    data.close()
             # a torn tail MUST be truncated away before appending: 'ab'
             # positions past the garbage, and records written after it
             # would be unreadable on the next restart (scan stops at the

@@ -98,7 +98,15 @@ def _buffer_address(view) -> "Tuple[int, int, object] | None":
     if not mv.readonly:
         buf = (ctypes.c_char * mv.nbytes).from_buffer(mv)
         return ctypes.addressof(buf), mv.nbytes, buf
-    return None
+    try:
+        # readonly memoryview/mmap: a numpy view exposes the address
+        # without requiring writability (native code only reads)
+        import numpy as np
+
+        arr = np.frombuffer(mv, dtype=np.uint8)
+        return arr.ctypes.data, arr.nbytes, (arr, mv)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 _SCAN_CHUNK = 65536  # frames per native call: bounds the offset arrays

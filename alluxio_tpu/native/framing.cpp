@@ -17,26 +17,29 @@
 
 namespace {
 
-// zlib CRC32 (poly 0xEDB88320, reflected), slice-by-8.
+// zlib CRC32 (poly 0xEDB88320, reflected), slice-by-8. Tables build in
+// a static initializer (runs once at dlopen, before any ctypes call can
+// race it — lazy bool-guarded init would be UB under the concurrent
+// first calls the GIL-releasing ctypes boundary allows).
 uint32_t g_tab[8][256];
-bool g_init = false;
 
-void init_tables() {
-    for (uint32_t i = 0; i < 256; ++i) {
-        uint32_t c = i;
-        for (int k = 0; k < 8; ++k)
-            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-        g_tab[0][i] = c;
+struct TabInit {
+    TabInit() {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            g_tab[0][i] = c;
+        }
+        for (uint32_t i = 0; i < 256; ++i)
+            for (int s = 1; s < 8; ++s)
+                g_tab[s][i] = g_tab[0][g_tab[s - 1][i] & 0xFFu] ^
+                              (g_tab[s - 1][i] >> 8);
     }
-    for (uint32_t i = 0; i < 256; ++i)
-        for (int s = 1; s < 8; ++s)
-            g_tab[s][i] =
-                g_tab[0][g_tab[s - 1][i] & 0xFFu] ^ (g_tab[s - 1][i] >> 8);
-    g_init = true;
-}
+};
+const TabInit g_tab_init;
 
 inline uint32_t crc32_impl(const uint8_t* p, size_t n, uint32_t seed) {
-    if (!g_init) init_tables();
     uint32_t c = ~seed;
     while (n >= 8) {
         // byte-wise 64-bit gather keeps this endian/alignment safe

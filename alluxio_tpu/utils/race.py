@@ -40,10 +40,12 @@ class _LockProxy:
         return False
 
     def acquire(self, *a, **kw):
-        self._auditor._before_acquire(self._name)
+        blocking = bool(a[0] if a else kw.get("blocking", True))
+        self._auditor._before_acquire(self._name, blocking=blocking)
         got = self._inner.acquire(*a, **kw)
         if got:
-            self._auditor._acquired(self._name)
+            # try-lock edges record on success only
+            self._auditor._acquired(self._name, record=not blocking)
         else:
             self._auditor._abandoned(self._name)
         return got
@@ -120,16 +122,8 @@ class LockOrderAuditor:
     def _stack(self) -> List[str]:
         return getattr(self._held, "stack", None) or []
 
-    def _before_acquire(self, name: str) -> None:
-        pass  # edges record on SUCCESS only (see _acquired)
-
-    def _acquired(self, name: str) -> None:
-        # record order edges only for acquisitions that SUCCEEDED: a
-        # failed try-lock (the standard hold-A-trylock-B-backoff
-        # pattern) cannot deadlock and must not count as an edge —
-        # TSAN exempts try-lock edges for the same reason
-        stack = self._stack()
-        for held in stack:
+    def _record_edges(self, name: str) -> None:
+        for held in self._stack():
             if held == name:
                 continue  # reentrant
             key = (held, name)
@@ -137,6 +131,21 @@ class LockOrderAuditor:
                 with self._edges_lock:
                     self.edges.setdefault(
                         key, "".join(traceback.format_stack(limit=12)))
+
+    def _before_acquire(self, name: str, blocking: bool = True) -> None:
+        # BLOCKING acquires record their edge up front — in an actual
+        # deadlock neither thread returns from acquire, and recording
+        # only on success would make the auditor blind in exactly the
+        # run that hangs. Non-blocking try-locks record on success only
+        # (hold-A-trylock-B-backoff cannot deadlock; TSAN exempts
+        # try-lock edges the same way).
+        if blocking:
+            self._record_edges(name)
+
+    def _acquired(self, name: str, *, record: bool = False) -> None:
+        if record:
+            self._record_edges(name)
+        stack = self._stack()
         stack.append(name)
         self._held.stack = stack
 

@@ -120,6 +120,12 @@ class TestWebEndpoint:
         assert code == 200
         assert b" " in body  # prometheus text lines "name value"
 
+    def test_dashboard_html_served_at_root(self, cluster):
+        code, body = _get(cluster, "/")
+        assert code == 200
+        assert b"<!doctype html>" in body
+        assert b"/api/v1/master" in body  # fetches the JSON routes
+
     def test_catalog_route_and_404(self, cluster):
         code, body = _get(cluster, "/api/v1/master/catalog")
         assert code == 200

@@ -47,6 +47,32 @@ class TestLockOrderAuditor:
                     pass
         aud.assert_clean()
 
+    def test_blocking_acquire_records_edge_even_while_stuck(self):
+        """The edge must exist BEFORE the acquire returns: in a real
+        deadlock neither thread ever succeeds, and the auditor must
+        still have the evidence."""
+        aud = LockOrderAuditor()
+        a = aud.wrap(threading.Lock(), "A")
+        b_inner = threading.Lock()
+        b = aud.wrap(b_inner, "B")
+        b_inner.acquire()  # B held elsewhere
+        released = threading.Event()
+
+        def t():
+            with a:
+                b.acquire()  # blocks until we release below
+                b.release()
+            released.set()
+
+        th = threading.Thread(target=t, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 5
+        while ("A", "B") not in aud.edges:
+            assert time.monotonic() < deadline, "edge never recorded"
+            time.sleep(0.02)
+        b_inner.release()
+        assert released.wait(5)
+
     def test_failed_trylock_records_no_edge(self):
         """hold-A-trylock-B-backoff cannot deadlock: a FAILED
         non-blocking acquire must not create an order edge (TSAN

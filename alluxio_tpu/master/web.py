@@ -23,44 +23,19 @@ from typing import Optional
 
 LOG = logging.getLogger(__name__)
 
-#: single-file status page over the JSON routes (stand-in for the
-#: reference's webui-master SPA, ``webui/master/``): no build step, no
-#: assets — fetches the same endpoints an operator can curl
-_DASHBOARD_HTML = b"""<!doctype html>
-<html><head><meta charset="utf-8"><title>alluxio-tpu master</title>
-<style>
- body{font-family:system-ui,sans-serif;margin:2rem;color:#222}
- h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
- table{border-collapse:collapse;margin:.5rem 0}
- td,th{border:1px solid #ccc;padding:.25rem .6rem;font-size:.9rem;
-       text-align:left}
- code{background:#f4f4f4;padding:0 .3rem}
- #err{color:#b00}
-</style></head><body>
-<h1>alluxio-tpu master</h1>
-<div id="err"></div>
-<h2>Cluster</h2><table id="info"></table>
-<h2>Workers</h2><table id="workers"></table>
-<h2>Mounts</h2><table id="mounts"></table>
-<h2>Catalog</h2><table id="catalog"></table>
-<p>Raw: <code>/api/v1/master/info</code> <code>/capacity</code>
-<code>/metrics</code> <code>/mounts</code> <code>/catalog</code>
-<code>/trace</code> <code>/metrics</code> (Prometheus)</p>
-<script>
-const gb = n => (n/2**30).toFixed(2)+' GiB';
-const row = (t, cells, th) => {
-  const tr = document.createElement('tr');
-  for (const c of cells) {
-    const el = document.createElement(th ? 'th' : 'td');
-    el.textContent = c; tr.appendChild(el);
-  }
-  t.appendChild(tr);
-};
-async function j(p){ const r = await fetch('/api/v1/master'+p);
-                     if(!r.ok) throw new Error(p+': '+r.status);
-                     return r.json(); }
-(async () => {
-  try {
+def _dashboard_html() -> bytes:
+    """Status page over the JSON routes (stand-in for the reference's
+    webui-master SPA, ``webui/master/``; shared chrome lives in
+    ``utils/statuspage.py``)."""
+    from alluxio_tpu.utils.statuspage import render
+
+    return render(
+        "alluxio-tpu master", "/api/v1/master",
+        sections=[("Cluster", "info"), ("Workers", "workers"),
+                  ("Mounts", "mounts"), ("Catalog", "catalog")],
+        raw_routes=["/api/v1/master/info", "/capacity", "/metrics",
+                    "/mounts", "/catalog", "/trace"],
+        js_body="""
     const info = await j('/info');
     const t = document.getElementById('info');
     for (const k of ['cluster_id','rpc_port','safe_mode','live_workers',
@@ -82,12 +57,7 @@ async function j(p){ const r = await fetch('/api/v1/master'+p);
     row(ct, ['database','tables'], true);
     for (const [db, tables] of Object.entries(c.databases))
       row(ct, [db, tables.join(', ')]);
-  } catch (e) {
-    document.getElementById('err').textContent = e;
-  }
-})();
-</script></body></html>
-"""
+""")
 
 
 class MasterWebServer:
@@ -104,7 +74,7 @@ class MasterWebServer:
                 try:
                     route = self.path.split("?", 1)[0].rstrip("/")
                     if route == "":
-                        self._send(200, _DASHBOARD_HTML,
+                        self._send(200, _dashboard_html(),
                                    "text/html; charset=utf-8")
                         return
                     if route == "/metrics":

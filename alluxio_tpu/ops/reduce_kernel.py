@@ -1,0 +1,93 @@
+"""Pallas TPU kernel: streaming scaled-sum over HBM-resident blocks.
+
+The hot op of the warm-read path (bench config #1 and any
+``device-side scan`` consumer): read every cached byte once, multiply
+by a scalar, reduce. XLA's fused reduce already runs near HBM peak;
+this kernel exists to (a) own the schedule explicitly — a gridded
+``BlockSpec`` pipeline double-buffers the HBM->VMEM DMAs against the
+VPU reduce with no fusion-heuristic dependence — and (b) serve as the
+repo's reference pallas pattern (guide: ``pallas_guide.md`` grid/
+BlockSpec pipelining).
+
+Falls back cleanly: callers use ``available()`` and keep the jnp path
+(e.g. ``bench.py``) when pallas/TPU is absent.
+"""
+
+from __future__ import annotations
+
+_LANES = 1024  # 8x128 VPU tile multiples
+_ROWS = 512    # rows per grid step: 512x1024 int32 = 2 MiB VMEM/block
+
+
+def available() -> bool:
+    try:
+        import jax
+        from jax.experimental import pallas as pl  # noqa: F401
+
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _kernel(x_ref, s_ref, o_ref):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        o_ref[0, 0] = jnp.int32(0)
+
+    # VPU multiply-reduce over this block; accumulation is safe because
+    # the TPU grid executes sequentially
+    o_ref[0, 0] += jnp.sum(x_ref[:] * s_ref[0, 0])
+
+
+def scaled_sum(x, scale, *, interpret: bool = False):
+    """``sum(x * scale)`` for int32 ``x`` of size divisible by
+    ``_ROWS * _LANES`` (use ``pad_to_kernel_shape`` otherwise — zeros
+    are reduction-neutral). Trace-time shapes, so calling this inside
+    the consumer's ``jit`` compiles it once; no module-level jax import
+    (``available()`` must stay checkable on jax-less hosts)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if x.size % (_ROWS * _LANES):
+        raise ValueError(
+            f"input size {x.size} is not a multiple of "
+            f"{_ROWS * _LANES}; pad with pad_to_kernel_shape() — "
+            f"flooring would silently drop the tail from the reduction")
+    flat = x.reshape(-1, _LANES)
+    tiles = flat.shape[0] // _ROWS
+    grid_spec = pl.GridSpec(
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+    )
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(flat, scale.reshape(1, 1).astype(jnp.int32))
+    return out[0, 0]
+
+
+def pad_to_kernel_shape(arr):
+    """Zero-pad a flat int32 array up to the kernel's block multiple."""
+    import jax.numpy as jnp
+
+    block = _ROWS * _LANES
+    n = arr.size
+    rem = (-n) % block
+    if rem:
+        arr = jnp.concatenate(
+            [arr.reshape(-1), jnp.zeros((rem,), dtype=arr.dtype)])
+    return arr.reshape(-1, _LANES)

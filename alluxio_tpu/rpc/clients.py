@@ -263,6 +263,14 @@ class MetaMasterClient(_BaseClient):
     def get_log_level(self, logger: str = "") -> dict:
         return self._call("get_log_level", {"logger": logger})
 
+    def set_trace_enabled(self, enabled: bool, *,
+                          clear: bool = False) -> dict:
+        return self._call("set_trace_enabled",
+                          {"enabled": enabled, "clear": clear})
+
+    def get_trace(self, *, limit: int = 500, prefix: str = "") -> dict:
+        return self._call("get_trace", {"limit": limit, "prefix": prefix})
+
     def set_path_conf(self, path: str, properties: Dict[str, str]) -> None:
         self._call("set_path_conf", {"path": str(path),
                                      "properties": properties})

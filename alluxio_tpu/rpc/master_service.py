@@ -245,6 +245,27 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
 
     svc.unary("set_log_level", _set_log_level)
     svc.unary("get_log_level", _get_log_level)
+
+    def _set_trace_enabled(r):
+        from alluxio_tpu.utils.tracing import set_tracing_enabled, tracer
+
+        _require_admin()
+        on = bool(r.get("enabled"))
+        set_tracing_enabled(on)
+        if r.get("clear"):
+            tracer().clear()
+        return {"enabled": on}
+
+    def _get_trace(r):
+        from alluxio_tpu.utils.tracing import tracer
+
+        return {"enabled": tracer().enabled,
+                "spans": tracer().snapshot(
+                    limit=int(r.get("limit") or 500),
+                    prefix=r.get("prefix") or "")}
+
+    svc.unary("set_trace_enabled", _set_trace_enabled)
+    svc.unary("get_trace", _get_trace)
     def _get_metrics(r):
         snap = metrics().snapshot()
         if metrics_master is not None:

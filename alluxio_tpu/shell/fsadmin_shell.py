@@ -297,5 +297,43 @@ class LogLevelCommand(Command):
         return 0
 
 
+@ADMIN_SHELL.register
+class TraceCommand(Command):
+    name = "trace"
+    description = ("Toggle span tracing and dump recent master spans "
+                   "(spans also serve at /api/v1/master/trace).")
+
+    def configure(self, p):
+        p.add_argument("--on", action="store_true",
+                       help="enable tracing (clears the ring)")
+        p.add_argument("--off", action="store_true",
+                       help="disable tracing")
+        p.add_argument("--limit", type=int, default=25,
+                       help="spans to print (most recent first)")
+        p.add_argument("--prefix", default="",
+                       help="only spans whose name starts with this")
+
+    def run(self, args, ctx):
+        mc = ctx.meta_client()
+        if args.on:
+            mc.set_trace_enabled(True, clear=True)
+            ctx.print("tracing enabled")
+            return 0
+        if args.off:
+            mc.set_trace_enabled(False)
+            ctx.print("tracing disabled")
+            return 0
+        resp = mc.get_trace(limit=args.limit, prefix=args.prefix)
+        ctx.print(f"tracing: {'on' if resp['enabled'] else 'off'} "
+                  f"({len(resp['spans'])} spans)")
+        for s in resp["spans"]:
+            dur = s["duration_ms"]
+            shown = "-" if dur is None else f"{round(dur, 2)}"
+            ctx.print(f"  {s['name']:<40} {shown:>9} ms  "
+                      f"thread={s['thread']}"
+                      + (f"  ERROR {s['error']}" if s["error"] else ""))
+        return 0
+
+
 def main(argv=None) -> int:
     return ADMIN_SHELL.run(sys.argv[1:] if argv is None else argv)

@@ -41,11 +41,15 @@ class _LockProxy:
 
     def acquire(self, *a, **kw):
         blocking = bool(a[0] if a else kw.get("blocking", True))
-        self._auditor._before_acquire(self._name, blocking=blocking)
+        timeout = a[1] if len(a) > 1 else kw.get("timeout", -1)
+        # a BOUNDED acquire (trylock or timed backoff) cannot deadlock:
+        # its edge records on success only, like TSAN's try-lock rule
+        bounded = (not blocking) or (
+            timeout is not None and timeout >= 0)
+        self._auditor._before_acquire(self._name, blocking=not bounded)
         got = self._inner.acquire(*a, **kw)
         if got:
-            # try-lock edges record on success only
-            self._auditor._acquired(self._name, record=not blocking)
+            self._auditor._acquired(self._name, record=bounded)
         else:
             self._auditor._abandoned(self._name)
         return got
@@ -56,27 +60,26 @@ class _LockProxy:
 
     # RWLock surface (utils/locks.py): both sides audit as one node —
     # order inversions matter regardless of read/write mode
-    def acquire_read(self, *a, **kw):
-        self._auditor._before_acquire(self._name)
-        got = self._inner.acquire_read(*a, **kw)
+    def _rw_acquire(self, fn, *a, **kw):
+        timeout = a[0] if a else kw.get("timeout")
+        bounded = timeout is not None and timeout >= 0
+        self._auditor._before_acquire(self._name, blocking=not bounded)
+        got = fn(*a, **kw)
         if got:
-            self._auditor._acquired(self._name)
+            self._auditor._acquired(self._name, record=bounded)
         else:
             self._auditor._abandoned(self._name)
         return got
+
+    def acquire_read(self, *a, **kw):
+        return self._rw_acquire(self._inner.acquire_read, *a, **kw)
 
     def release_read(self):
         self._auditor._released(self._name)
         return self._inner.release_read()
 
     def acquire_write(self, *a, **kw):
-        self._auditor._before_acquire(self._name)
-        got = self._inner.acquire_write(*a, **kw)
-        if got:
-            self._auditor._acquired(self._name)
-        else:
-            self._auditor._abandoned(self._name)
-        return got
+        return self._rw_acquire(self._inner.acquire_write, *a, **kw)
 
     def release_write(self):
         self._auditor._released(self._name)

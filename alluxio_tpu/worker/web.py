@@ -26,6 +26,35 @@ LOG = logging.getLogger(__name__)
 
 _BLOCK_LIST_CAP = 1000  # /blocks id sample cap: bounded response size
 
+def _dashboard_html() -> bytes:
+    """Status page (webui-worker stand-in; shared chrome in
+    ``utils/statuspage.py``)."""
+    from alluxio_tpu.utils.statuspage import render
+
+    return render(
+        "alluxio-tpu worker", "/api/v1/worker",
+        sections=[("Worker", "info"), ("Tiers", "tiers"),
+                  ("Blocks", "blocks")],
+        raw_routes=["/api/v1/worker/info", "/capacity", "/blocks",
+                    "/metrics"],
+        js_body="""
+    const info = await j('/info');
+    const t = document.getElementById('info');
+    for (const k of ['worker_id','host','rpc_port','tiered_identity',
+                     'uptime_ms'])
+      row(t, [k, String(info[k])]);
+    const cap = await j('/capacity');
+    const tt = document.getElementById('tiers');
+    row(tt, ['tier','capacity','used','dirs'], true);
+    for (const x of cap.tiers)
+      row(tt, [x.alias, gb(x.capacity), gb(x.used), x.dirs.length]);
+    const bl = await j('/blocks');
+    const bt = document.getElementById('blocks');
+    row(bt, ['tier','count'], true);
+    for (const [tier, d] of Object.entries(bl.blocks))
+      row(bt, [tier, d.count]);
+""")
+
 
 class WorkerWebServer:
     def __init__(self, worker, port: int = 0,
@@ -40,6 +69,10 @@ class WorkerWebServer:
             def do_GET(self):  # noqa: N802 (stdlib API)
                 try:
                     route = self.path.split("?", 1)[0].rstrip("/")
+                    if route == "":
+                        self._send(200, _dashboard_html(),
+                                   "text/html; charset=utf-8")
+                        return
                     if route == "/metrics":
                         from alluxio_tpu.metrics import metrics
 

@@ -195,8 +195,43 @@ def main() -> None:
 
                 return lax.fori_loop(0, K, body, acc0)
 
+            from alluxio_tpu.ops import reduce_kernel
+
+            if reduce_kernel.available():
+                @jax.jit
+                def consume_pallas(blocks, acc0):
+                    # explicit gridded HBM->VMEM pipeline (see
+                    # ops/reduce_kernel.py); measured at parity with
+                    # the fused XLA reduce — whichever calibrates
+                    # faster below carries the epoch loop
+                    X = reduce_kernel.pad_to_kernel_shape(
+                        jnp.concatenate(blocks).reshape(-1))
+
+                    def body(i, acc):
+                        return (reduce_kernel.scaled_sum(
+                            X, acc % 3 + 1) + acc) % 1000003
+
+                    return jax.lax.fori_loop(0, K, body, acc0)
+
+                candidates = [("xla", consume), ("pallas", consume_pallas)]
+            else:
+                candidates = [("xla", consume)]
+
             blocks = [b for b in loader.epoch()]  # HBM-resident now
-            _ = int(consume(blocks, jnp.int32(1)))  # compile + warm
+            if len(candidates) > 1:
+                cal = []
+                for name, fn in candidates:
+                    int(fn(blocks, jnp.int32(1)))  # compile + warm
+                    t0 = time.monotonic()
+                    int(fn(blocks, jnp.int32(1)))
+                    cal.append((time.monotonic() - t0, name, fn))
+                cal.sort()
+                log("reduce kernel calibration: " + ", ".join(
+                    f"{n}={t:.3f}s" for t, n, _ in cal)
+                    + f" -> using {cal[0][1]}")
+                consume = cal[0][2]
+            else:
+                _ = int(consume(blocks, jnp.int32(1)))  # compile + warm
             rates, times = [], []
             for e in range(EPOCHS):
                 t0 = time.monotonic()

@@ -242,3 +242,55 @@ class TestLogLevel:
         assert code == 0
         assert "atpu.shell.test -> ERROR" in out.getvalue()
         assert logging.getLogger("atpu.shell.test").level == logging.ERROR
+
+
+class TestTraceAdmin:
+    def test_trace_toggle_and_dump(self, cluster):
+        import io
+
+        from alluxio_tpu.shell.command import ShellContext
+        from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+        from alluxio_tpu.utils.tracing import set_tracing_enabled
+
+        conf = cluster.conf.copy()
+        conf.set(Keys.MASTER_HOSTNAME, "localhost")
+        conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+        try:
+            out = io.StringIO()
+            assert ADMIN_SHELL.run(["trace", "--on"],
+                                   ShellContext(conf, out=out)) == 0
+            # generate some traced RPCs
+            fs = cluster.file_system()
+            fs.write_all("/traced/x", b"1")
+            out = io.StringIO()
+            assert ADMIN_SHELL.run(
+                ["trace", "--limit", "50"],
+                ShellContext(conf, out=out)) == 0
+            text = out.getvalue()
+            assert "tracing: on" in text
+            assert ".create_file" in text
+            out = io.StringIO()
+            assert ADMIN_SHELL.run(["trace", "--off"],
+                                   ShellContext(conf, out=out)) == 0
+        finally:
+            set_tracing_enabled(False)
+
+    def test_trace_toggle_requires_admin(self, cluster):
+        from alluxio_tpu.rpc.clients import MetaMasterClient
+        from alluxio_tpu.security.authentication import USER_KEY
+        from alluxio_tpu.utils.exceptions import PermissionDeniedError
+
+        mc = MetaMasterClient(cluster.master.address,
+                              metadata=((USER_KEY, "mallory"),))
+        with pytest.raises(PermissionDeniedError):
+            mc.set_trace_enabled(True)
+        # reads stay open
+        mc.get_trace(limit=1)
+
+
+class TestWorkerDashboard:
+    def test_worker_html_served_at_root(self, cluster):
+        code, body = _wget(cluster, "/")
+        assert code == 200
+        assert b"<!doctype html>" in body
+        assert b"/api/v1/worker" in body

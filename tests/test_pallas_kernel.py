@@ -1,0 +1,42 @@
+"""Pallas reduce-kernel tests (interpret mode on the CPU backend; the
+real-TPU path is exercised by ``bench.py``'s calibration)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from alluxio_tpu.ops.reduce_kernel import (  # noqa: E402
+    _LANES, _ROWS, pad_to_kernel_shape, scaled_sum,
+)
+
+
+class TestScaledSum:
+    def test_matches_jnp_reduce(self):
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.integers(-1000, 1000, size=_ROWS * _LANES * 3,
+                                     dtype=np.int32))
+        for scale in (1, 3, -2):
+            got = int(scaled_sum(x, jnp.int32(scale), interpret=True))
+            ref = int(jnp.sum(x * jnp.int32(scale)))
+            assert got == ref
+
+    def test_int32_wraparound_semantics(self):
+        x = jnp.full((_ROWS * _LANES,), 2**30, dtype=jnp.int32)
+        got = int(scaled_sum(x, jnp.int32(3), interpret=True))
+        ref = int(jnp.sum(x * jnp.int32(3)))
+        assert got == ref  # both wrap identically
+
+    def test_padding_is_reduction_neutral(self):
+        rng = np.random.default_rng(11)
+        y = jnp.asarray(rng.integers(-5, 5, size=123457, dtype=np.int32))
+        p = pad_to_kernel_shape(y)
+        assert p.size % (_ROWS * _LANES) == 0
+        got = int(scaled_sum(p, jnp.int32(3), interpret=True))
+        ref = int(jnp.sum(y * jnp.int32(3)))
+        assert got == ref
+
+    def test_exact_block_needs_no_padding(self):
+        y = jnp.ones((_ROWS * _LANES,), dtype=jnp.int32)
+        p = pad_to_kernel_shape(y)
+        assert p.size == y.size

@@ -90,6 +90,22 @@ class TestLockOrderAuditor:
                 pass
         aud.assert_clean()
 
+    def test_timed_acquire_backoff_records_no_edge(self):
+        """acquire(timeout=T) that fails is a timed try-lock: no edge
+        (it cannot deadlock — it always comes back)."""
+        aud = LockOrderAuditor()
+        b_inner = threading.Lock()
+        a = aud.wrap(threading.Lock(), "A")
+        b = aud.wrap(b_inner, "B")
+        b_inner.acquire()
+        with a:
+            assert b.acquire(timeout=0.05) is False
+        b_inner.release()
+        with b:
+            with a:
+                pass
+        aud.assert_clean()
+
     def test_reentrant_acquire_not_flagged(self):
         aud = LockOrderAuditor()
         r = aud.wrap(threading.RLock(), "R")

@@ -304,9 +304,10 @@ class TraceCommand(Command):
                    "(spans also serve at /api/v1/master/trace).")
 
     def configure(self, p):
-        p.add_argument("--on", action="store_true",
+        g = p.add_mutually_exclusive_group()
+        g.add_argument("--on", action="store_true",
                        help="enable tracing (clears the ring)")
-        p.add_argument("--off", action="store_true",
+        g.add_argument("--off", action="store_true",
                        help="disable tracing")
         p.add_argument("--limit", type=int, default=25,
                        help="spans to print (most recent first)")

@@ -31,6 +31,7 @@ Commands:
   job-worker run a job worker process
   proxy      run the REST/S3 proxy process
   logserver  run the centralized log aggregation server
+  fuse       mount the namespace via FUSE (POSIX view)
   version    print the version
 
 Generic options:

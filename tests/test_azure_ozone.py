@@ -148,6 +148,27 @@ class TestOzone:
             assert ufs._key("ofs://om:9862/vol/bkt/d/f") == "d/f"
 
 
+class TestClusterMountAzure:
+    def test_mount_and_read_write_through(self, tmp_path, azure):
+        """abfs mounted into the namespace: cold read-through into the
+        worker cache + write-through back to the store (the same
+        contract TestClusterMountS3 proves for s3)."""
+        from alluxio_tpu.minicluster.local_cluster import LocalCluster
+        from alluxio_tpu.underfs.azure import AdlsGen2Client
+
+        client = AdlsGen2Client("fsys", "acct", azure.endpoint)
+        client.put("ds/part-0", b"azure-block-data" * 100)
+        with LocalCluster(str(tmp_path), num_workers=1,
+                          start_worker_heartbeats=True) as c:
+            fs = c.file_system()
+            fs.mount("/az", "abfs://fsys@acct.dfs.core.windows.net/ds",
+                     properties={"azure.endpoint": azure.endpoint})
+            assert fs.read_all("/az/part-0") == b"azure-block-data" * 100
+            fs.write_all("/az/out", b"written-back",
+                         write_type="CACHE_THROUGH")
+            assert client.get("ds/out") == b"written-back"
+
+
 def test_schemes_registered():
     schemes = supported_schemes()
     for s in ("wasb", "wasbs", "abfs", "abfss", "adl", "o3fs", "ofs"):

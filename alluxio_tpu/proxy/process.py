@@ -37,7 +37,8 @@ from urllib.parse import parse_qs, unquote, urlsplit
 from xml.sax.saxutils import escape
 
 from alluxio_tpu.utils.exceptions import (
-    DirectoryNotEmptyError, FileDoesNotExistError,
+    DirectoryNotEmptyError, FileDoesNotExistError, InvalidArgumentError,
+    InvalidPathError,
 )
 
 LOG = logging.getLogger(__name__)
@@ -196,6 +197,8 @@ class _S3Handler(BaseHTTPRequestHandler):
 
     # -- verbs ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802
+        if self.path.startswith("/api/v1/"):
+            return self._rest("GET")
         bucket, key, q = self._parse()
         try:
             if not bucket:
@@ -276,6 +279,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             self._fail(500, "InternalError", str(e))
 
     def do_POST(self):  # noqa: N802
+        if self.path.startswith("/api/v1/"):
+            return self._rest("POST")
         bucket, key, q = self._parse()
         try:
             if "uploads" in q:
@@ -287,6 +292,88 @@ class _S3Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             LOG.warning("s3 POST failed", exc_info=True)
             self._fail(500, "InternalError", str(e))
+
+    # -- native REST paths/streams API ---------------------------------------
+    # (reference: ``proxy/{PathsRestServiceHandler,
+    # StreamsRestServiceHandler}.java`` — the non-S3 half of the proxy.
+    # Streams here are stateless download/upload verbs rather than the
+    # reference's stream-id sessions: same coverage, no session table.)
+    def _rest(self, verb: str) -> None:
+        import json as _json
+
+        # body accounting (the S3 verbs set this in _parse)
+        self._unread = int(self.headers.get("Content-Length") or 0)
+        parts = urlsplit(self.path)
+        q = {k: v[0] for k, v in parse_qs(parts.query,
+                                          keep_blank_values=True).items()}
+        rest = parts.path[len("/api/v1/"):]
+        kind, _, tail = rest.partition("/")
+        if kind != "paths" or "/" not in tail:
+            return self._rest_err(404, f"no route {parts.path}")
+        raw_path, _, op = tail.rpartition("/")
+        path = "/" + unquote(raw_path).strip("/")
+        fs = self.s3.fs
+
+        def send_json(obj, code=200):
+            self._send(code, _json.dumps(obj, default=str).encode(),
+                       ctype="application/json")
+
+        try:
+            if verb == "GET" and op == "get-status":
+                return send_json(self._rest_info(fs.get_status(path)))
+            if verb == "GET" and op == "list-status":
+                return send_json([self._rest_info(i)
+                                  for i in fs.list_status(path)])
+            if verb == "GET" and op == "download":
+                info = fs.get_status(path)
+                with fs.open_file(path, info=info) as f:
+                    return self._stream_body(f, 0, info.length, 200, {})
+            if verb == "POST" and op == "exists":
+                return send_json(fs.exists(path))
+            if verb == "POST" and op == "create-directory":
+                fs.create_directory(
+                    path, recursive=q.get("recursive") == "true",
+                    allow_exists=q.get("allowExists") == "true")
+                return send_json({})
+            if verb == "POST" and op == "delete":
+                fs.delete(path, recursive=q.get("recursive") == "true")
+                return send_json({})
+            if verb == "POST" and op == "rename":
+                dst = q.get("dst")
+                if not dst:
+                    return self._rest_err(
+                        400, "rename requires ?dst=<path>")
+                fs.rename(path, dst)
+                return send_json({})
+            if verb == "POST" and op == "upload":
+                out = fs.create_file(path, overwrite=True)
+                with out:
+                    n = self._stream_request_body(out.write)
+                return send_json({"bytes": n})
+            return self._rest_err(404, f"no op {op!r} for {verb}")
+        except FileDoesNotExistError as e:
+            self._rest_err(404, str(e))
+        except DirectoryNotEmptyError as e:
+            self._rest_err(409, str(e))
+        except (InvalidArgumentError, InvalidPathError) as e:
+            # client mistakes must be 4xx: retry middleware treats 5xx
+            # as server faults and retries the unretryable
+            self._rest_err(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            LOG.warning("rest %s %s failed", verb, op, exc_info=True)
+            self._rest_err(500, f"{type(e).__name__}: {e}")
+
+    @staticmethod
+    def _rest_info(i) -> dict:
+        return {"path": i.path, "name": i.name, "folder": i.folder,
+                "length": i.length,
+                "lastModificationTimeMs": i.last_modification_time_ms}
+
+    def _rest_err(self, code: int, msg: str) -> None:
+        import json as _json
+
+        self._send(code, _json.dumps({"error": msg}).encode(),
+                   ctype="application/json")
 
     # -- bucket ops ----------------------------------------------------------
     def _list_buckets(self) -> None:

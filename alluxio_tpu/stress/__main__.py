@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
     wr.add_argument("--mem-mb", type=int, default=64)
 
     sub.add_parser("suite", help="run the whole BASELINE config family")
+    rp = sub.add_parser("report",
+                        help="render suite JSON to a single-file HTML "
+                             "report (graphs + tables)")
+    rp.add_argument("--input", default="BENCH_SUITE.json")
+    rp.add_argument("--out", default="BENCH_REPORT.html")
     return ap
 
 
@@ -159,6 +164,10 @@ def main(argv=None) -> int:
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1
+    elif args.bench == "report":
+        from alluxio_tpu.stress.report import main as report_main
+
+        return report_main(["--input", args.input, "--out", args.out])
     else:  # pragma: no cover — argparse guards
         return 2
     print(r.json_line(), flush=True)

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Run a command on every host in conf/masters over ssh
+# (reference: bin/alluxio-masters.sh — the HA quorum fan-out launcher).
+#
+#   bin/alluxio-tpu-masters.sh start      # start master+job-master
+#   bin/alluxio-tpu-masters.sh stop
+#   bin/alluxio-tpu-masters.sh cmd "uptime"
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+# shellcheck source=bin/cluster-fanout.sh
+source "${SCRIPT_DIR}/cluster-fanout.sh"
+CONF_FILE="${ALLUXIO_TPU_MASTERS_FILE:-${REPO_DIR}/conf/masters}"
+START_ROLES="master job_master"
+STOP_ROLES="master job_master"
+fanout_main "$@"

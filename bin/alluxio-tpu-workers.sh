@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Run a command on every host in conf/workers over ssh
+# (reference: bin/alluxio-workers.sh — the cluster fan-out launcher).
+#
+#   bin/alluxio-tpu-workers.sh start      # start worker+job-worker
+#   bin/alluxio-tpu-workers.sh stop
+#   bin/alluxio-tpu-workers.sh cmd "uptime"
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+# shellcheck source=bin/cluster-fanout.sh
+source "${SCRIPT_DIR}/cluster-fanout.sh"
+CONF_FILE="${ALLUXIO_TPU_WORKERS_FILE:-${REPO_DIR}/conf/workers}"
+START_ROLES="worker job_worker"
+STOP_ROLES="worker job_worker"
+fanout_main "$@"

@@ -1,0 +1,80 @@
+"""Native REST paths API tests (the proxy's non-S3 half; reference
+``proxy/{PathsRestServiceHandler,StreamsRestServiceHandler}.java``)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+from alluxio_tpu.proxy.process import ProxyProcess
+
+
+@pytest.fixture()
+def proxy(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1) as cluster:
+        conf = cluster.conf.copy()
+        conf.set(Keys.PROXY_WEB_PORT, 0)
+        p = ProxyProcess(conf, fs=cluster.file_system())
+        p.start()
+        try:
+            yield p
+        finally:
+            p.stop()
+
+
+def _req(proxy, method, route, data=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{proxy.port}/api/v1/paths{route}",
+        data=data, method=method)
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, resp.read()
+
+
+class TestRestPaths:
+    def test_full_lifecycle(self, proxy):
+        code, _ = _req(proxy, "POST",
+                       "/data/sub/create-directory?recursive=true")
+        assert code == 200
+        code, body = _req(proxy, "POST", "/data/sub/f.bin/upload",
+                          data=b"rest payload")
+        assert code == 200 and json.loads(body)["bytes"] == 12
+        code, body = _req(proxy, "GET", "/data/sub/f.bin/get-status")
+        st = json.loads(body)
+        assert st["length"] == 12 and not st["folder"]
+        code, body = _req(proxy, "GET", "/data/sub/f.bin/download")
+        assert code == 200 and body == b"rest payload"
+        code, body = _req(proxy, "GET", "/data/sub/list-status")
+        assert [e["name"] for e in json.loads(body)] == ["f.bin"]
+        code, _ = _req(proxy, "POST",
+                       "/data/sub/f.bin/rename?dst=/data/moved.bin")
+        assert code == 200
+        code, body = _req(proxy, "POST", "/data/moved.bin/exists")
+        assert json.loads(body) is True
+        code, _ = _req(proxy, "POST", "/data/moved.bin/delete")
+        assert code == 200
+        code, body = _req(proxy, "POST", "/data/moved.bin/exists")
+        assert json.loads(body) is False
+
+    def test_errors(self, proxy):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(proxy, "GET", "/nope/get-status")
+        assert ei.value.code == 404
+        assert "error" in json.loads(ei.value.read())
+        # non-empty dir without recursive -> conflict
+        _req(proxy, "POST", "/d/create-directory")
+        _req(proxy, "POST", "/d/x/upload", data=b"1")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(proxy, "POST", "/d/delete")
+        assert ei.value.code == 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(proxy, "GET", "/d/x/frobnicate")
+        assert ei.value.code == 404
+
+    def test_s3_dialect_still_served(self, proxy):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/", method="GET")
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert b"ListAllMyBucketsResult" in resp.read()

@@ -12,6 +12,32 @@ from alluxio_tpu.stress.base import (
 
 
 class TestBase:
+    def test_report_renders_from_suite_records(self, tmp_path):
+        import json
+
+        from alluxio_tpu.stress.report import main as report_main
+
+        records = [
+            {"bench": "worker-sequential", "errors": 0,
+             "metrics": {"gb_per_s": 12.5, "p50_us": 100.0}},
+            {"bench": "master-CreateFile", "errors": 0,
+             "metrics": {"ops_per_s": 1500.0, "p99_us": 900.0}},
+            {"bench": "distributed-prefetch", "errors": 0,
+             "metrics": {"mb_per_s": 250.0, "blocks": 32}},
+        ]
+        src = tmp_path / "suite.json"
+        out = tmp_path / "report.html"
+        src.write_text(json.dumps(records))
+        assert report_main(["--input", str(src),
+                            "--out", str(out)]) == 0
+        page = out.read_text()
+        assert "<svg" in page and "worker-sequential" in page
+        # one chart per unit group (one axis each), full table view
+        assert page.count("GB/s") >= 1 and page.count("ops/s") >= 1
+        assert "p99_us" in page
+        # values escape HTML
+        assert "<script src" not in page
+
     def test_percentiles_empty(self):
         assert percentiles([])["p50_us"] == 0.0
 

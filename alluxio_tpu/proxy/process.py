@@ -213,6 +213,11 @@ class _S3Handler(BaseHTTPRequestHandler):
             self._fail(500, "InternalError", str(e))
 
     def do_HEAD(self):  # noqa: N802
+        if self.path.startswith("/api/v1/"):
+            # /api/v1/ is reserved for the REST dialect on EVERY verb —
+            # a half-hijacked namespace (GET rest, PUT s3) would let an
+            # S3 client write objects it can never read back
+            return self._rest("HEAD")
         bucket, key, _ = self._parse()
         try:
             info = self.s3.fs.get_status(self._kpath(bucket, key))
@@ -232,6 +237,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             self._send(500, b"")
 
     def do_PUT(self):  # noqa: N802
+        if self.path.startswith("/api/v1/"):
+            return self._rest("PUT")
         bucket, key, q = self._parse()
         try:
             if not key:
@@ -262,6 +269,8 @@ class _S3Handler(BaseHTTPRequestHandler):
             self._fail(500, "InternalError", str(e))
 
     def do_DELETE(self):  # noqa: N802
+        if self.path.startswith("/api/v1/"):
+            return self._rest("DELETE")
         bucket, key, q = self._parse()
         try:
             if key and "uploadId" in q:
@@ -318,6 +327,7 @@ class _S3Handler(BaseHTTPRequestHandler):
             self._send(code, _json.dumps(obj, default=str).encode(),
                        ctype="application/json")
 
+        streaming = False
         try:
             if verb == "GET" and op == "get-status":
                 return send_json(self._rest_info(fs.get_status(path)))
@@ -326,8 +336,14 @@ class _S3Handler(BaseHTTPRequestHandler):
                                   for i in fs.list_status(path)])
             if verb == "GET" and op == "download":
                 info = fs.get_status(path)
-                with fs.open_file(path, info=info) as f:
+                f = fs.open_file(path, info=info)
+                try:
+                    # from here a failure happens mid-response: the
+                    # except handlers must abort, not answer twice
+                    streaming = True
                     return self._stream_body(f, 0, info.length, 200, {})
+                finally:
+                    f.close()
             if verb == "POST" and op == "exists":
                 return send_json(fs.exists(path))
             if verb == "POST" and op == "create-directory":
@@ -350,18 +366,34 @@ class _S3Handler(BaseHTTPRequestHandler):
                 with out:
                     n = self._stream_request_body(out.write)
                 return send_json({"bytes": n})
-            return self._rest_err(404, f"no op {op!r} for {verb}")
+            return self._rest_err(
+                404 if verb in ("GET", "POST") else 405,
+                f"no op {op!r} for {verb}")
         except FileDoesNotExistError as e:
-            self._rest_err(404, str(e))
+            if streaming:
+                self.close_connection = True
+            else:
+                self._rest_err(404, str(e))
         except DirectoryNotEmptyError as e:
-            self._rest_err(409, str(e))
+            if streaming:
+                self.close_connection = True
+            else:
+                self._rest_err(409, str(e))
         except (InvalidArgumentError, InvalidPathError) as e:
             # client mistakes must be 4xx: retry middleware treats 5xx
             # as server faults and retries the unretryable
-            self._rest_err(400, str(e))
+            if streaming:
+                self.close_connection = True
+            else:
+                self._rest_err(400, str(e))
         except Exception as e:  # noqa: BLE001
             LOG.warning("rest %s %s failed", verb, op, exc_info=True)
-            self._rest_err(500, f"{type(e).__name__}: {e}")
+            if streaming:
+                # headers already flushed: a second response would be
+                # counted as body bytes — abort the connection instead
+                self.close_connection = True
+            else:
+                self._rest_err(500, f"{type(e).__name__}: {e}")
 
     @staticmethod
     def _rest_info(i) -> dict:

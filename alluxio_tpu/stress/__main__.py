@@ -165,9 +165,9 @@ def main(argv=None) -> int:
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1
     elif args.bench == "report":
-        from alluxio_tpu.stress.report import main as report_main
+        from alluxio_tpu.stress.report import write_report
 
-        return report_main(["--input", args.input, "--out", args.out])
+        return write_report(args.input, args.out)
     else:  # pragma: no cover — argparse guards
         return 2
     print(r.json_line(), flush=True)

@@ -128,24 +128,56 @@ def render_report(results: List[dict], *, title: str = "alluxio-tpu "
             f"</body></html>")
 
 
-def main(argv=None) -> int:
-    import argparse
+def _load_results(path: str) -> List[dict]:
+    """Accept BOTH result shapes: a JSON array (``bench.py --suite``'s
+    BENCH_SUITE.json) and JSONL (``stress suite`` stdout redirected to
+    a file — one record per line, possibly interleaved with log
+    lines)."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+        return data if isinstance(data, list) else [data]
+    except json.JSONDecodeError:
+        out = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        if not out:
+            raise
+        return out
+
+
+def write_report(input_path: str, out_path: str) -> int:
+    """Single entry used by both CLIs (``stress report`` and the
+    standalone module)."""
     import json
     import sys
 
-    p = argparse.ArgumentParser(prog="stress report")
-    p.add_argument("--input", default="BENCH_SUITE.json",
-                   help="suite results JSON (list of bench records)")
-    p.add_argument("--out", default="BENCH_REPORT.html")
-    args = p.parse_args(argv)
     try:
-        with open(args.input) as f:
-            results = json.load(f)
+        results = _load_results(input_path)
     except (OSError, json.JSONDecodeError) as e:
-        print(f"cannot read suite results {args.input!r}: {e}",
+        print(f"cannot read suite results {input_path!r}: {e}",
               file=sys.stderr)
         return 1
-    with open(args.out, "w") as f:
+    with open(out_path, "w") as f:
         f.write(render_report(results))
-    print(f"wrote {args.out} ({len(results)} benches)", file=sys.stderr)
+    print(f"wrote {out_path} ({len(results)} benches)", file=sys.stderr)
     return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="stress report")
+    p.add_argument("--input", default="BENCH_SUITE.json",
+                   help="suite results (JSON array or JSONL)")
+    p.add_argument("--out", default="BENCH_REPORT.html")
+    args = p.parse_args(argv)
+    return write_report(args.input, args.out)

@@ -56,7 +56,44 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _probe_device(timeout_s: float = 180.0):
+    """Device init with a deadline: a wedged accelerator tunnel (stuck
+    grant) must fail the bench FAST with a diagnosis, not hang the
+    driver until its own timeout with zero output."""
+    import queue
+    import threading
+
+    out: "queue.Queue" = queue.Queue()
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            dev = jax.devices()[0]
+            jnp.ones((4,)).sum().block_until_ready()  # full round trip
+            out.put(dev)
+        except Exception as e:  # noqa: BLE001
+            out.put(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    try:
+        got = out.get(timeout=timeout_s)
+    except queue.Empty:
+        log(f"FATAL: device init did not complete within {timeout_s}s "
+            f"— the accelerator tunnel looks wedged (stuck grant?); "
+            f"no metric emitted")
+        raise SystemExit(3)
+    if isinstance(got, Exception):
+        log(f"FATAL: device init failed: {got}")
+        raise SystemExit(3)
+    return got
+
+
 def main() -> None:
+    device = _probe_device()
+
     import jax
     import jax.numpy as jnp
 
@@ -64,7 +101,6 @@ def main() -> None:
     from alluxio_tpu.client.streams import WriteType
     from alluxio_tpu.minicluster import LocalCluster
 
-    device = jax.devices()[0]
     log(f"device: {device}")
     total_bytes = BLOCK_BYTES * NUM_BLOCKS
 

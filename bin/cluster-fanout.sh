@@ -14,11 +14,13 @@ fanout() {
     return 1
   fi
   local pids=()
-  while IFS= read -r host; do
+  # `|| [[ -n ... ]]` keeps a final unterminated line; `ssh -n` stops
+  # the backgrounded ssh from draining the conf file off shared stdin
+  while IFS= read -r host || [[ -n "${host}" ]]; do
     [[ -z "${host}" || "${host}" == \#* ]] && continue
     echo "[${host}] ${remote_cmd}"
     # shellcheck disable=SC2086
-    ssh ${SSH_OPTS} "${host}" "${remote_cmd}" &
+    ssh -n ${SSH_OPTS} "${host}" "${remote_cmd}" &
     pids+=($!)
   done < "${CONF_FILE}"
   local rc=0

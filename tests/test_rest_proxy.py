@@ -73,6 +73,23 @@ class TestRestPaths:
             _req(proxy, "GET", "/d/x/frobnicate")
         assert ei.value.code == 404
 
+    def test_api_prefix_reserved_on_every_verb(self, proxy):
+        """PUT/DELETE/HEAD under /api/v1/ must NOT fall through to the
+        S3 dialect (a half-hijacked namespace lets an S3 client write
+        objects it can never read back)."""
+        for method in ("PUT", "DELETE"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{proxy.port}/api/v1/data.bin",
+                data=b"x" if method == "PUT" else None, method=method)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=15)
+            assert ei.value.code in (404, 405)
+        # and no phantom S3 bucket materialized
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/", method="GET")
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert b"api" not in resp.read()
+
     def test_s3_dialect_still_served(self, proxy):
         req = urllib.request.Request(
             f"http://127.0.0.1:{proxy.port}/", method="GET")

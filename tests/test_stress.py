@@ -37,6 +37,15 @@ class TestBase:
         assert "p99_us" in page
         # values escape HTML
         assert "<script src" not in page
+        # JSONL shape (suite stdout redirected) parses too, with log
+        # noise interleaved
+        jsonl = tmp_path / "suite.jsonl"
+        jsonl.write_text("[suite] running worker ...\n" + "\n".join(
+            json.dumps(r) for r in records))
+        out2 = tmp_path / "report2.html"
+        assert report_main(["--input", str(jsonl),
+                            "--out", str(out2)]) == 0
+        assert "worker-sequential" in out2.read_text()
 
     def test_percentiles_empty(self):
         assert percentiles([])["p50_us"] == 0.0

@@ -142,9 +142,15 @@ class MasterProcess:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
         """Boot straight to primary; returns the bound RPC port."""
+        from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
         from alluxio_tpu.utils.tracing import set_tracing_enabled
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
+        # stall detector (reference: JvmPauseMonitor started at
+        # AlluxioMasterProcess.java:265-273): a paused master misses
+        # heartbeats and trips elections — make it visible. ONE per
+        # process: in-process clusters share the host stall.
+        ensure_process_monitor()
         self.journal.start()
         backup = self._conf.get(Keys.MASTER_JOURNAL_INIT_FROM_BACKUP)
         if backup and hasattr(self.journal, "init_from_backup"):
@@ -352,6 +358,13 @@ class FaultTolerantMasterProcess(MasterProcess):
         callers poll ``rpc_port``/``serving``."""
         import threading
 
+        from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
+        from alluxio_tpu.utils.tracing import set_tracing_enabled
+
+        set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
+        # the HA master is the one whose elections stall detection
+        # protects — it must not be the one path without it
+        ensure_process_monitor()
         self.selector.start()
         self.journal.start()
         self._init_from_backup_if_configured()

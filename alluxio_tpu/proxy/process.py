@@ -328,6 +328,15 @@ class _S3Handler(BaseHTTPRequestHandler):
                        ctype="application/json")
 
         streaming = False
+
+        def fail(code: int, msg: str) -> None:
+            if streaming:
+                # headers already flushed: a second response would be
+                # counted as body bytes — abort the connection instead
+                self.close_connection = True
+            else:
+                self._rest_err(code, msg)
+
         try:
             if verb == "GET" and op == "get-status":
                 return send_json(self._rest_info(fs.get_status(path)))
@@ -370,30 +379,16 @@ class _S3Handler(BaseHTTPRequestHandler):
                 404 if verb in ("GET", "POST") else 405,
                 f"no op {op!r} for {verb}")
         except FileDoesNotExistError as e:
-            if streaming:
-                self.close_connection = True
-            else:
-                self._rest_err(404, str(e))
+            fail(404, str(e))
         except DirectoryNotEmptyError as e:
-            if streaming:
-                self.close_connection = True
-            else:
-                self._rest_err(409, str(e))
+            fail(409, str(e))
         except (InvalidArgumentError, InvalidPathError) as e:
             # client mistakes must be 4xx: retry middleware treats 5xx
             # as server faults and retries the unretryable
-            if streaming:
-                self.close_connection = True
-            else:
-                self._rest_err(400, str(e))
+            fail(400, str(e))
         except Exception as e:  # noqa: BLE001
             LOG.warning("rest %s %s failed", verb, op, exc_info=True)
-            if streaming:
-                # headers already flushed: a second response would be
-                # counted as body bytes — abort the connection instead
-                self.close_connection = True
-            else:
-                self._rest_err(500, f"{type(e).__name__}: {e}")
+            fail(500, f"{type(e).__name__}: {e}")
 
     @staticmethod
     def _rest_info(i) -> dict:

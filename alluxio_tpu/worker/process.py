@@ -159,9 +159,11 @@ class BlockWorker:
     def start(self) -> None:
         """Register then start heartbeats
         (reference: ``DefaultBlockWorker.start:197-242``)."""
+        from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
         from alluxio_tpu.utils.tracing import set_tracing_enabled
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
+        ensure_process_monitor()
         self._master_sync.register_with_master()
         if self._meta_client is not None:
             try:  # config consistency report (ServerConfigurationChecker)

@@ -180,6 +180,57 @@ class TestInodeTreeLockOrder:
             aud.assert_clean()
 
 
+class TestPauseMonitor:
+    def test_observe_thresholds(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry
+        from alluxio_tpu.utils.pause_monitor import PauseMonitor
+
+        reg = MetricsRegistry()
+        pm = PauseMonitor(interval_s=0.5, warn_s=1.0, error_s=5.0,
+                          metrics=reg)
+        assert pm.observe(0.6) == 0.0  # normal drift: no pause
+        assert pm.observe(2.0) == 1.5  # warn-level pause
+        assert reg.counter("Process.Pauses").count == 1
+        assert pm.observe(6.0) == 5.5  # severe pause
+        assert reg.counter("Process.SeverePauses").count == 1
+        assert pm.max_pause_s == 5.5
+        assert reg.snapshot()["Process.MaxPauseSeconds"] == 5.5
+
+    def test_gauge_present_from_construction(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry
+        from alluxio_tpu.utils.pause_monitor import PauseMonitor
+
+        reg = MetricsRegistry()
+        PauseMonitor(metrics=reg)
+        # "healthy" must read as 0.0, not as a missing series
+        assert reg.snapshot()["Process.MaxPauseSeconds"] == 0.0
+
+    def test_thread_lifecycle_and_restart(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry
+        from alluxio_tpu.utils.pause_monitor import PauseMonitor
+
+        reg = MetricsRegistry()
+        pm = PauseMonitor(interval_s=0.05, warn_s=0.2, error_s=10.0,
+                          metrics=reg).start()
+        try:
+            time.sleep(0.3)  # idle: nothing recorded
+            assert reg.counter("Process.SeverePauses").count == 0
+        finally:
+            pm.stop()
+        assert pm._thread is None
+        # restart after stop must actually monitor again
+        pm.start()
+        assert pm._thread is not None and pm._thread.is_alive()
+        pm.stop()
+
+    def test_process_singleton(self):
+        from alluxio_tpu.utils import pause_monitor as pmod
+
+        a = pmod.ensure_process_monitor()
+        b = pmod.ensure_process_monitor()
+        assert a is b  # one stall = one event, however many roles
+
+
 class TestTracing:
     def test_span_nesting_and_snapshot(self):
         set_tracing_enabled(True)

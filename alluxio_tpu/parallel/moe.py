@@ -1,0 +1,92 @@
+"""Expert-parallel mixture-of-experts FFN.
+
+The EP strategy for the multichip story (SURVEY §2.11's SPMD checklist;
+the reference has no model compute, so this is the TPU-native extension
+the data plane feeds): experts are sharded over a mesh axis and tokens
+are dispatched densely via one-hot combine — written as plain einsums
+with sharding constraints so XLA inserts the all-to-alls itself (the
+scaling-book recipe: annotate, don't hand-schedule).
+
+Top-1 token-choice routing with capacity = tokens (dense dispatch): at
+the sizes the dryrun exercises, correctness and sharding layout are the
+point; capacity-dropping is an optimization layered on the same einsums.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+EXPERT_AXIS = "model"  # experts ride the model axis (ep x tp fuse)
+
+
+def init_moe_params(key, *, n_experts: int, d_model: int,
+                    d_ff: int, dtype=None) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.float32
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = d_model ** -0.5
+    return {
+        "gate": (jax.random.normal(k1, (d_model, n_experts)) *
+                 scale).astype(dtype),
+        "w_in": (jax.random.normal(k2, (n_experts, d_model, d_ff)) *
+                 scale).astype(dtype),
+        "w_out": (jax.random.normal(k3, (n_experts, d_ff, d_model)) *
+                  (d_ff ** -0.5)).astype(dtype),
+    }
+
+
+def moe_param_shardings(mesh) -> Dict[str, Any]:
+    """Experts sharded over the expert axis; gate replicated."""
+    from alluxio_tpu.parallel.mesh import named_sharding
+
+    return {
+        "gate": named_sharding(mesh),
+        "w_in": named_sharding(mesh, EXPERT_AXIS),
+        "w_out": named_sharding(mesh, EXPERT_AXIS),
+    }
+
+
+def moe_ffn(params, x):
+    """(B, T, d_model) -> (B, T, d_model), top-1 routed.
+
+    Dense dispatch: ``probs`` one-hot selects the expert per token; the
+    expert einsums contract over the sharded expert dim, so under pjit
+    the dispatch/combine become all-to-all-style collectives over
+    ``EXPERT_AXIS`` without any manual communication.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("btd,de->bte", x, params["gate"])
+    top = jnp.argmax(logits, axis=-1)
+    n_experts = params["gate"].shape[-1]
+    onehot = jax.nn.one_hot(top, n_experts, dtype=x.dtype)
+    # router gradient flows through the softmax prob of the taken expert
+    gate = jnp.take_along_axis(
+        jax.nn.softmax(logits, axis=-1), top[..., None], axis=-1)
+    # dispatch: (e, B, T, d) views of tokens, zero where not routed
+    dispatched = jnp.einsum("btd,bte->ebtd", x, onehot)
+    hidden = jax.nn.gelu(
+        jnp.einsum("ebtd,edf->ebtf", dispatched, params["w_in"]))
+    expert_out = jnp.einsum("ebtf,efd->ebtd", hidden, params["w_out"])
+    # combine: sum over experts (only the routed slot is nonzero)
+    combined = jnp.einsum("ebtd,bte->btd", expert_out, onehot)
+    return combined * gate
+
+
+def load_balance_loss(params, x) -> "Any":
+    """Auxiliary load-balancing loss (Switch-style): mean fraction per
+    expert x mean router prob per expert, scaled by n_experts^2."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("btd,de->bte", x, params["gate"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    n_experts = params["gate"].shape[-1]
+    hard = jax.nn.one_hot(jnp.argmax(logits, -1), n_experts,
+                          dtype=x.dtype)
+    frac = hard.mean(axis=(0, 1))
+    prob = probs.mean(axis=(0, 1))
+    return (frac * prob).sum() * n_experts * n_experts

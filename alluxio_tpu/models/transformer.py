@@ -37,6 +37,9 @@ class TransformerConfig:
     n_classes: int = 1000
     max_len: int = 256
     dtype: Any = jnp.bfloat16
+    #: >0 switches the FFN to a top-1 MoE with this many experts
+    #: (expert-parallel over the model axis; the second model family)
+    moe_experts: int = 0
 
     @property
     def d_head(self) -> int:
@@ -59,14 +62,22 @@ def init_params(cfg: TransformerConfig, key) -> Dict[str, Any]:
     }
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[4 + i], 6)
-        params["layers"].append({
+        layer = {
             "ln1": {"scale": jnp.ones(cfg.d_model, cfg.dtype)},
             "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.d_head)),
             "wo": dense(k[1], (cfg.n_heads, cfg.d_head, cfg.d_model)),
             "ln2": {"scale": jnp.ones(cfg.d_model, cfg.dtype)},
-            "w1": dense(k[2], (cfg.d_model, cfg.d_ff)),
-            "w2": dense(k[3], (cfg.d_ff, cfg.d_model)),
-        })
+        }
+        if cfg.moe_experts > 0:
+            from alluxio_tpu.parallel.moe import init_moe_params
+
+            layer["moe"] = init_moe_params(
+                k[2], n_experts=cfg.moe_experts, d_model=cfg.d_model,
+                d_ff=cfg.d_ff, dtype=cfg.dtype)
+        else:
+            layer["w1"] = dense(k[2], (cfg.d_model, cfg.d_ff))
+            layer["w2"] = dense(k[3], (cfg.d_ff, cfg.d_model))
+        params["layers"].append(layer)
     return params
 
 
@@ -79,9 +90,14 @@ def param_shardings(cfg: TransformerConfig) -> Dict[str, Any]:
         "wqkv": P(None, None, MODEL_AXIS, None),
         "wo": P(MODEL_AXIS, None, None),
         "ln2": {"scale": P()},
-        "w1": P(None, MODEL_AXIS),
-        "w2": P(MODEL_AXIS, None),
     }
+    if cfg.moe_experts > 0:
+        from alluxio_tpu.parallel.moe import moe_param_specs
+
+        layer["moe"] = moe_param_specs()
+    else:
+        layer["w1"] = P(None, MODEL_AXIS)
+        layer["w2"] = P(MODEL_AXIS, None)
     return {
         "embed": P(),
         "pos": P(),
@@ -108,33 +124,58 @@ def _attention(x, layer, cfg: TransformerConfig, *,
 
 
 def _mlp(x, layer):
+    if "moe" in layer:
+        from alluxio_tpu.parallel.moe import moe_ffn
+
+        return moe_ffn(layer["moe"], x)
     h = jnp.einsum("btd,df->btf", x, layer["w1"])
     h = jax.nn.gelu(h)
     return jnp.einsum("btf,fd->btd", h, layer["w2"])
 
 
-def forward(params, tokens, cfg: TransformerConfig, *,
-            seq_axis: Optional[str] = None):
+def forward_with_aux(params, tokens, cfg: TransformerConfig, *,
+                     seq_axis: Optional[str] = None):
     """tokens: (B, T, vocab_or_patch_dim) float inputs (e.g. flattened
-    patches from the decode op). Returns (B, n_classes) logits."""
+    patches from the decode op). Returns ((B, n_classes) logits, aux)
+    where ``aux`` is the summed MoE load-balance loss (0 when dense) —
+    without it top-1 routing collapses every token onto one expert."""
     x = jnp.einsum("btp,pd->btd", tokens.astype(cfg.dtype), params["embed"])
     t = x.shape[1]
     x = x + params["pos"][:t][None]
+    aux = jnp.float32(0.0)
     for layer in params["layers"]:
         x = x + _attention(_rms_norm(x, layer["ln1"]["scale"]), layer, cfg,
                            seq_axis=seq_axis)
-        x = x + _mlp(_rms_norm(x, layer["ln2"]["scale"]), layer)
+        ffn_in = _rms_norm(x, layer["ln2"]["scale"])
+        if "moe" in layer:
+            from alluxio_tpu.parallel.moe import load_balance_loss
+
+            aux = aux + load_balance_loss(
+                layer["moe"], ffn_in).astype(jnp.float32)
+        x = x + _mlp(ffn_in, layer)
     x = _rms_norm(x, params["final_ln"]["scale"])
     pooled = jnp.mean(x, axis=1)
-    return jnp.einsum("bd,dc->bc", pooled, params["head"]).astype(jnp.float32)
+    logits = jnp.einsum("bd,dc->bc", pooled,
+                        params["head"]).astype(jnp.float32)
+    return logits, aux
+
+
+def forward(params, tokens, cfg: TransformerConfig, *,
+            seq_axis: Optional[str] = None):
+    return forward_with_aux(params, tokens, cfg, seq_axis=seq_axis)[0]
+
+
+#: weight of the Switch-style balance loss in the training objective
+MOE_AUX_WEIGHT = 0.01
 
 
 def loss_fn(params, tokens, labels, cfg: TransformerConfig, *,
             seq_axis: Optional[str] = None):
-    logits = forward(params, tokens, cfg, seq_axis=seq_axis)
+    logits, aux = forward_with_aux(params, tokens, cfg,
+                                   seq_axis=seq_axis)
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
-    return nll
+    return nll + MOE_AUX_WEIGHT * aux
 
 
 def images_to_tokens(images, patch: int = 16):

@@ -37,15 +37,25 @@ def init_moe_params(key, *, n_experts: int, d_model: int,
     }
 
 
-def moe_param_shardings(mesh) -> Dict[str, Any]:
-    """Experts sharded over the expert axis; gate replicated."""
-    from alluxio_tpu.parallel.mesh import named_sharding
+def moe_param_specs() -> Dict[str, Any]:
+    """The ONE source of the expert layout (PartitionSpecs): experts
+    sharded over the expert axis, gate replicated. The transformer's
+    ``param_shardings`` and ``moe_param_shardings`` both derive from
+    this so the layouts cannot drift."""
+    from jax.sharding import PartitionSpec as P
 
     return {
-        "gate": named_sharding(mesh),
-        "w_in": named_sharding(mesh, EXPERT_AXIS),
-        "w_out": named_sharding(mesh, EXPERT_AXIS),
+        "gate": P(),
+        "w_in": P(EXPERT_AXIS),
+        "w_out": P(EXPERT_AXIS),
     }
+
+
+def moe_param_shardings(mesh) -> Dict[str, Any]:
+    from jax.sharding import NamedSharding
+
+    return {k: NamedSharding(mesh, spec)
+            for k, spec in moe_param_specs().items()}
 
 
 def moe_ffn(params, x):

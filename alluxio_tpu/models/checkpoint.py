@@ -1,0 +1,146 @@
+"""Model checkpoints stored IN the namespace.
+
+The model-plane half of SURVEY §5.4 (the control plane already has
+journal/checkpoint/backup): sharded train state serializes through the
+``FileSystem`` client into cached, UFS-persistable files, and restores
+straight back onto a device mesh — so checkpoints ride the same tiered
+cache, replication, and persistence machinery as training data, and a
+restore on a warm cluster reads from HBM/MEM tiers instead of cold
+object storage.
+
+Layout under ``<path>/``: ``tree.msgpack`` (structure + dtypes/shapes +
+a manifest) and one ``leaf-<i>.bin`` per array (raw C-order bytes).
+Arrays sharded over a mesh are fetched whole (``np.asarray``) on save —
+single-host scope; multi-host writers shard the leaf files by process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _flatten(tree):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return leaves, treedef
+
+
+def save_pytree(fs, path: str, tree, *, write_type=None) -> int:
+    """Serialize a pytree of arrays under ``path``; returns leaf count."""
+    import msgpack
+
+    kwargs = {"write_type": write_type} if write_type else {}
+    leaves, treedef = _flatten(tree)
+    metas = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        metas.append({"dtype": str(arr.dtype), "shape": list(arr.shape)})
+        fs.write_all(f"{path}/leaf-{i}.bin",
+                     np.ascontiguousarray(arr).tobytes(), **kwargs)
+    blob = msgpack.packb({
+        "treedef": str(treedef),
+        "n_leaves": len(leaves),
+        "metas": metas,
+    }, use_bin_type=True)
+    fs.write_all(f"{path}/tree.msgpack", blob, **kwargs)
+    return len(leaves)
+
+
+def load_pytree(fs, path: str, *, like=None, shardings=None):
+    """Restore a pytree saved by :func:`save_pytree`.
+
+    - ``like``: a pytree with the SAME structure (e.g. freshly-inited
+      params) supplying the treedef — required because treedefs don't
+      round-trip through strings.
+    - ``shardings``: optional matching pytree of shardings; leaves are
+      ``jax.device_put`` onto them (restore-to-mesh).
+    """
+    import msgpack
+
+    import jax
+
+    if like is None:
+        raise ValueError("load_pytree needs `like=` (a structure-matched "
+                         "pytree, e.g. freshly initialized params)")
+    meta = msgpack.unpackb(fs.read_all(f"{path}/tree.msgpack"),
+                           raw=False)
+    like_leaves, treedef = _flatten(like)
+    if meta["n_leaves"] != len(like_leaves):
+        raise ValueError(
+            f"checkpoint has {meta['n_leaves']} leaves; `like` has "
+            f"{len(like_leaves)} — structure mismatch")
+    out_leaves = []
+    shard_leaves = None
+    if shardings is not None:
+        # shardings are unregistered pytree nodes (leaves by default);
+        # the is_leaf only needs to keep explicit Nones as leaves
+        shard_leaves = jax.tree_util.tree_flatten(
+            shardings, is_leaf=lambda x: x is None)[0]
+        if len(shard_leaves) != len(like_leaves):
+            raise ValueError(
+                f"shardings tree has {len(shard_leaves)} leaves; model "
+                f"has {len(like_leaves)} — pass a structure-matched "
+                f"tree (use None for replicated leaves)")
+    for i, (m, ref) in enumerate(zip(meta["metas"], like_leaves)):
+        raw = fs.read_all(f"{path}/leaf-{i}.bin")
+        arr = np.frombuffer(raw, dtype=np.dtype(m["dtype"])) \
+            .reshape(m["shape"])
+        if list(np.shape(ref)) != m["shape"]:
+            raise ValueError(
+                f"leaf {i}: checkpoint shape {m['shape']} != model "
+                f"shape {list(np.shape(ref))}")
+        ref_dtype = np.asarray(ref).dtype
+        if np.dtype(m["dtype"]) != ref_dtype:
+            raise ValueError(
+                f"leaf {i}: checkpoint dtype {m['dtype']} != model "
+                f"dtype {ref_dtype} — a silent dtype change would "
+                f"recompile and shift numerics")
+        if shard_leaves is not None and shard_leaves[i] is not None:
+            out_leaves.append(jax.device_put(arr, shard_leaves[i]))
+        else:
+            out_leaves.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def save_train_state(fs, path: str, params, opt_state, *, step: int,
+                     write_type=None) -> None:
+    """Checkpoint (params, opt_state, step) under ``path``."""
+    kwargs = {"write_type": write_type} if write_type else {}
+    save_pytree(fs, f"{path}/params", params, write_type=write_type)
+    save_pytree(fs, f"{path}/opt", opt_state, write_type=write_type)
+    fs.write_all(f"{path}/STEP", str(step).encode(), **kwargs)
+
+
+def load_train_state(fs, path: str, *, like_params, like_opt,
+                     param_shardings=None, opt_shardings=None):
+    """Restore (params, opt_state, step) saved by save_train_state."""
+    params = load_pytree(fs, f"{path}/params", like=like_params,
+                         shardings=param_shardings)
+    opt = load_pytree(fs, f"{path}/opt", like=like_opt,
+                      shardings=opt_shardings)
+    step = int(fs.read_all(f"{path}/STEP").decode())
+    return params, opt, step
+
+
+def latest_step(fs, base: str) -> Optional[int]:
+    """Highest ``step-<n>`` child under ``base`` (checkpoint dirs written
+    as ``{base}/step-{n}``), or None."""
+    from alluxio_tpu.utils.exceptions import FileDoesNotExistError
+
+    try:
+        infos = fs.list_status(base)
+    except FileDoesNotExistError:
+        return None  # no checkpoints yet; transient RPC errors RAISE —
+        # "cannot list" must not read as "resume from scratch"
+    steps = []
+    for i in infos:
+        name = i.name
+        if name.startswith("step-"):
+            try:
+                steps.append(int(name[len("step-"):]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None

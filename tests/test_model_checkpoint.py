@@ -1,0 +1,106 @@
+"""Model checkpoints in the namespace: save sharded train state through
+the FileSystem client, restore onto the mesh, resume training
+(SURVEY §5.4's model-plane half)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from alluxio_tpu.minicluster import LocalCluster  # noqa: E402
+from alluxio_tpu.models.checkpoint import (  # noqa: E402
+    latest_step, load_pytree, load_train_state, save_pytree,
+    save_train_state,
+)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1) as c:
+        yield c
+
+
+class TestPytreeRoundTrip:
+    def test_nested_tree_round_trips(self, cluster):
+        fs = cluster.file_system()
+        tree = {"a": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+                "b": [jnp.ones((2,), jnp.int32),
+                      {"c": jnp.asarray(3.5, jnp.bfloat16)}]}
+        assert save_pytree(fs, "/ckpt/t", tree) == 3
+        like = jax.tree_util.tree_map(jnp.zeros_like, tree)
+        back = load_pytree(fs, "/ckpt/t", like=like)
+        for got, want in zip(jax.tree_util.tree_leaves(back),
+                             jax.tree_util.tree_leaves(tree)):
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                          np.asarray(want, np.float32))
+
+    def test_structure_and_shape_mismatches_raise(self, cluster):
+        fs = cluster.file_system()
+        save_pytree(fs, "/ckpt/m", {"a": jnp.ones((2, 2))})
+        with pytest.raises(ValueError, match="structure"):
+            load_pytree(fs, "/ckpt/m",
+                        like={"a": jnp.ones((2, 2)),
+                              "b": jnp.ones((1,))})
+        with pytest.raises(ValueError, match="shape"):
+            load_pytree(fs, "/ckpt/m", like={"a": jnp.ones((3, 3))})
+
+
+class TestTrainStateResume:
+    def test_save_restore_resume_sharded(self, cluster):
+        """Full cycle: train 3 steps -> checkpoint into the namespace ->
+        rebuild fresh state -> restore ONTO THE MESH -> losses continue
+        from the checkpointed trajectory."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device CPU mesh")
+        from alluxio_tpu.models.train import (
+            make_sharded_train_state, make_train_step,
+        )
+        from alluxio_tpu.models.transformer import TransformerConfig
+        from alluxio_tpu.parallel.mesh import make_mesh
+
+        fs = cluster.file_system()
+        mesh = make_mesh({"data": 4, "model": 2})
+        cfg = TransformerConfig(vocab_or_patch_dim=12, d_model=16,
+                                n_heads=4, d_ff=32, n_layers=1,
+                                n_classes=5, max_len=4,
+                                dtype=jnp.float32)
+        params, opt, tx, shardings = make_sharded_train_state(cfg, mesh)
+        step = make_train_step(cfg, mesh, tx, shardings)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.standard_normal((8, 4, 12)),
+                             jnp.float32)
+        labels = jnp.asarray(rng.integers(0, 5, size=(8,)), jnp.int32)
+        for _ in range(3):
+            params, opt, loss = step(params, opt, tokens, labels)
+        save_train_state(fs, "/ckpt/step-3", params, opt, step=3)
+        # the reference trajectory continues two more steps
+        p_ref, o_ref = params, opt
+        ref_losses = []
+        for _ in range(2):
+            p_ref, o_ref, loss = step(p_ref, o_ref, tokens, labels)
+            ref_losses.append(float(loss))
+
+        # fresh state, restore from namespace onto the mesh
+        params2, opt2, _, _ = make_sharded_train_state(cfg, mesh,
+                                                       seed=123)
+        params3, opt3, at = load_train_state(
+            fs, "/ckpt/step-3", like_params=params2, like_opt=opt2,
+            param_shardings=shardings)
+        assert at == 3
+        got_losses = []
+        p, o = params3, opt3
+        for _ in range(2):
+            p, o, loss = step(p, o, tokens, labels)
+            got_losses.append(float(loss))
+        np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5)
+
+    def test_latest_step_discovery(self, cluster):
+        fs = cluster.file_system()
+        assert latest_step(fs, "/ckpts") is None
+        for s in (10, 2, 30):
+            save_train_state(fs, f"/ckpts/step-{s}",
+                             {"w": jnp.ones((2,))}, {"m": jnp.ones((2,))},
+                             step=s)
+        assert latest_step(fs, "/ckpts") == 30

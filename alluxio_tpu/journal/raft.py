@@ -293,6 +293,7 @@ class RaftNode:
 
         self.state = FOLLOWER
         self.leader_id: Optional[str] = None
+        self._transferring = False  # §3.10: no proposals mid-handover
         self.commit_index = 0
         self.applied_index = 0
         self.applied_seq = 0
@@ -469,8 +470,11 @@ class RaftNode:
                     self._start_election()
                 time.sleep(0.02)
 
-    def _start_election(self) -> None:
-        if not self._pre_vote_wins():
+    def _start_election(self, *, force: bool = False) -> None:
+        """``force`` skips the pre-vote round — used by leadership
+        transfer (Raft §3.10 TimeoutNow): the target must be able to
+        depose a HEALTHY leader, which pre-vote exists to prevent."""
+        if not force and not self._pre_vote_wins():
             # a live leader is still heartbeating a majority (we're the
             # partitioned/rejoining one): do NOT bump the term — pre-vote
             # (Raft §9.6) keeps a rejoining node from deposing a healthy
@@ -592,6 +596,11 @@ class RaftNode:
         self.state = FOLLOWER
         if leader is not None:
             self.leader_id = leader
+        elif was_leader:
+            # stepping down with no known successor: a stale self-
+            # pointing leader_id would read as "someone else won" to
+            # transfer_leadership and misdirect client redirects
+            self.leader_id = None
         self._reset_election_deadline()
         if was_leader:
             LOG.warning("raft %s: stepped down in term %d",
@@ -739,6 +748,77 @@ class RaftNode:
             return {"term": self.log.term, "ok": True,
                     "match_index": self.log.last_index}
 
+    def transfer_leadership(self, target_id: str,
+                            timeout_s: float = 5.0) -> bool:
+        """Leader-side graceful handover (Raft §3.10; reference: Ratis
+        leadership transfer behind ``journal quorum elect``): pause new
+        proposals, bring the target fully up to date, then TimeoutNow so
+        it elects immediately (force-election past pre-vote). Returns
+        True once this node observes the target's leadership. Aborts
+        WITHOUT firing the election when catch-up fails — TimeoutNow at
+        a lagging target can only depose the healthy leader and lose
+        the vote (§5.4.1), a pure availability hole."""
+        with self.lock:
+            if self.state != LEADER:
+                raise JournalClosedError(
+                    f"not the raft leader (leader={self.leader_id})")
+            if target_id not in self.peers:
+                raise ValueError(f"unknown quorum member {target_id!r}")
+            addr = self.peers[target_id]
+            # §3.10: stop taking client requests for the duration, THEN
+            # snapshot the index the target must reach — no append can
+            # race past it while the flag is up
+            self._transferring = True
+            last = self.log.last_index
+            term = self.log.term
+        try:
+            catch_up_deadline = time.monotonic() + timeout_s / 2
+            caught_up = False
+            while time.monotonic() < catch_up_deadline:
+                with self.lock:
+                    if self.match_index.get(target_id, 0) >= last:
+                        caught_up = True
+                        break
+                    ev = self._peer_wakeups.get(target_id)
+                if ev is not None:
+                    ev.set()
+                time.sleep(0.02)
+            if not caught_up:
+                return False  # abort: no TimeoutNow at a lagging target
+            try:
+                self.transport(addr, "timeout_now", {"term": term},
+                               timeout=2.0)
+            except Exception:  # noqa: BLE001 target unreachable
+                return False
+            observe_deadline = time.monotonic() + timeout_s / 2
+            while time.monotonic() < observe_deadline:
+                with self.lock:
+                    if self.state != LEADER:
+                        # step-down cleared leader_id; the new leader's
+                        # first heartbeat fills it in
+                        if self.leader_id == target_id:
+                            return True
+                        if self.leader_id is not None:
+                            return False  # someone else won
+                time.sleep(0.02)
+            return False
+        finally:
+            with self.lock:
+                self._transferring = False
+
+    def handle_timeout_now(self, req: dict) -> dict:
+        """TimeoutNow from the leader: start a forced election NOW.
+        Stale senders are rejected by term — a delayed TimeoutNow from
+        a deposed leader must not force-depose the healthy one (the
+        disruption pre-vote exists to prevent)."""
+        with self.lock:
+            if self._stopped or self.state == LEADER or \
+                    req.get("term", 0) < self.log.term:
+                return {"ok": False}
+        threading.Thread(target=self._start_election,
+                         kwargs={"force": True}, daemon=True).start()
+        return {"ok": True}
+
     def quorum_info(self) -> dict:
         with self.lock:
             members = [{"node_id": self.node_id, "address": "self",
@@ -771,6 +851,10 @@ class RaftNode:
             if self.state != LEADER:
                 raise JournalClosedError(
                     f"not the raft leader (leader={self.leader_id})")
+            if self._transferring:
+                raise JournalClosedError(
+                    "leadership transfer in progress; retry against "
+                    "the new leader")
             rec = RaftRecord(self.log.term, self.log.last_index + 1, entries)
             self.log.append(rec)
             idx = rec.index
@@ -987,6 +1071,7 @@ def raft_journal_service(node: RaftNode):
     svc.unary("append_entries", node.handle_append_entries)
     svc.unary("install_snapshot", node.handle_install_snapshot)
     svc.unary("get_quorum_info", lambda r: node.quorum_info())
+    svc.unary("timeout_now", node.handle_timeout_now)
     return svc
 
 
@@ -1133,6 +1218,9 @@ class EmbeddedJournalSystem(JournalSystem):
 
     def quorum_info(self) -> dict:
         return self.node.quorum_info()
+
+    def transfer_leadership(self, target_id: str) -> bool:
+        return self.node.transfer_leadership(target_id)
 
 
 class RaftPrimarySelector(PrimarySelector):

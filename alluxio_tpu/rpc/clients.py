@@ -271,6 +271,13 @@ class MetaMasterClient(_BaseClient):
     def get_trace(self, *, limit: int = 500, prefix: str = "") -> dict:
         return self._call("get_trace", {"limit": limit, "prefix": prefix})
 
+    def get_quorum_info(self) -> dict:
+        return self._call("get_quorum_info", {})
+
+    def transfer_quorum_leadership(self, target: str) -> dict:
+        return self._call("transfer_quorum_leadership",
+                          {"target": target})
+
     def set_path_conf(self, path: str, properties: Dict[str, str]) -> None:
         self._call("set_path_conf", {"path": str(path),
                                      "properties": properties})

@@ -303,6 +303,29 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
 
     svc.unary("checkpoint", _checkpoint)
 
+    def _quorum_info(r):
+        """Quorum membership/roles (reference: journal_master.proto
+        GetQuorumInfo behind ``fsadmin journal quorum``)."""
+        if journal is None or not hasattr(journal, "quorum_info"):
+            from alluxio_tpu.utils.exceptions import FailedPreconditionError
+
+            raise FailedPreconditionError(
+                "quorum info requires the EMBEDDED journal")
+        return journal.quorum_info()
+
+    def _transfer_leadership(r):
+        _require_admin()
+        if journal is None or not hasattr(journal, "transfer_leadership"):
+            from alluxio_tpu.utils.exceptions import FailedPreconditionError
+
+            raise FailedPreconditionError(
+                "leadership transfer requires the EMBEDDED journal")
+        ok = journal.transfer_leadership(str(r["target"]))
+        return {"transferred": bool(ok)}
+
+    svc.unary("get_quorum_info", _quorum_info)
+    svc.unary("transfer_quorum_leadership", _transfer_leadership)
+
     def _backup(r):
         _require_admin()
         if journal is None or not hasattr(journal, "write_backup"):

@@ -189,16 +189,33 @@ class JournalCommand(Command):
     description = "Journal operations: checkpoint | dump."
 
     def configure(self, p):
-        p.add_argument("op", choices=["checkpoint", "dump"])
+        p.add_argument("op", choices=["checkpoint", "dump", "quorum"])
         p.add_argument("--folder", default=None,
                        help="journal dir for dump (default: configured)")
         p.add_argument("--start", type=int, default=0)
         p.add_argument("--end", type=int, default=None)
+        p.add_argument("--transfer", default="",
+                       help="quorum: hand leadership to this member id")
 
     def run(self, args, ctx):
         if args.op == "checkpoint":
             ctx.meta_client().checkpoint()
             ctx.print("Successfully took a checkpoint on the primary master")
+            return 0
+        if args.op == "quorum":
+            mc = ctx.meta_client()
+            if args.transfer:
+                resp = mc.transfer_quorum_leadership(args.transfer)
+                ok = resp.get("transferred")
+                ctx.print(f"leadership transfer to {args.transfer}: "
+                          f"{'done' if ok else 'FAILED'}")
+                return 0 if ok else 1
+            info = mc.get_quorum_info()
+            ctx.print(f"term {info['term']}  leader {info['leader']}  "
+                      f"commit {info['commit_index']}")
+            for m in info["members"]:
+                ctx.print(f"  {m['node_id']:<24} {m['role']:<9} "
+                          f"match={m['match_index']} ({m['address']})")
             return 0
         from alluxio_tpu.conf import Keys
         from alluxio_tpu.journal.tool import dump_journal

@@ -116,6 +116,105 @@ class TestQuorum:
             for j in systems:
                 j.stop()
 
+    def test_leadership_transfer(self, tmp_path):
+        """Graceful handover (quorum elect): the leader brings the
+        target up to date, TimeoutNow makes it elect immediately (past
+        pre-vote), and writes keep flowing under the new leader."""
+        systems, kvs = make_quorum(tmp_path, free_ports(3))
+        try:
+            for j in systems:
+                j.start()
+            wait_for(lambda: leader_of(systems) is not None,
+                     msg="election")
+            leader = leader_of(systems)
+            for i in range(5):
+                put(leader, f"pre-{i}", i)
+            target_id = next(iter(leader.node.peers))
+            assert leader.transfer_leadership(target_id) is True
+            wait_for(lambda: leader_of(systems) is not None,
+                     msg="new leader")
+            new_leader = leader_of(systems)
+            assert new_leader.node.node_id == target_id
+            assert not leader.node.is_leader()
+            put(new_leader, "post", 99)
+            for kv in kvs:
+                wait_for(lambda kv=kv: kv.data.get("post") == 99,
+                         msg="post-transfer convergence")
+        finally:
+            for j in systems:
+                j.stop()
+
+    def test_stale_timeout_now_rejected(self, tmp_path):
+        """A delayed TimeoutNow from an old term must not force-depose
+        the healthy leader (the disruption pre-vote prevents)."""
+        systems, _ = make_quorum(tmp_path, free_ports(3))
+        try:
+            for j in systems:
+                j.start()
+            wait_for(lambda: leader_of(systems) is not None,
+                     msg="election")
+            leader = leader_of(systems)
+            follower = next(j for j in systems
+                            if not j.node.is_leader())
+            resp = follower.node.handle_timeout_now(
+                {"term": leader.node.log.term - 1})
+            assert resp == {"ok": False}
+            time.sleep(0.3)
+            assert leader.node.is_leader()  # undisturbed
+        finally:
+            for j in systems:
+                j.stop()
+
+    def test_transfer_aborts_for_unreachable_target(self, tmp_path):
+        """Catch-up failure aborts WITHOUT firing TimeoutNow: the
+        current leader keeps leading and keeps accepting writes."""
+        systems, _ = make_quorum(tmp_path, free_ports(3))
+        try:
+            for j in systems:
+                j.start()
+            wait_for(lambda: leader_of(systems) is not None,
+                     msg="election")
+            leader = leader_of(systems)
+            target_id = next(iter(leader.node.peers))
+            # make the target unreachable for replication AND transfer
+            orig = leader.node.transport
+
+            def drop(addr, method, payload, timeout=None):
+                if addr == leader.node.peers[target_id]:
+                    raise ConnectionError("partitioned")
+                return orig(addr, method, payload, timeout=timeout)
+
+            put(leader, "before", 1)
+            leader.node.transport = drop
+            leader.node.match_index[target_id] = 0
+            put(leader, "gap", 2)  # target now lags
+            assert leader.transfer_leadership(target_id) is False
+            leader.node.transport = orig
+            assert leader.node.is_leader()
+            put(leader, "after", 3)  # proposals resumed
+        finally:
+            for j in systems:
+                j.stop()
+
+    def test_quorum_info_reports_members(self, tmp_path):
+        systems, _ = make_quorum(tmp_path, free_ports(3))
+        try:
+            for j in systems:
+                j.start()
+            wait_for(lambda: leader_of(systems) is not None,
+                     msg="election")
+            leader = leader_of(systems)
+            put(leader, "x", 1)
+            info = leader.quorum_info()
+            assert info["leader"] == leader.node.node_id
+            assert len(info["members"]) == 3
+            roles = {m["node_id"]: m["role"] for m in info["members"]}
+            assert roles[leader.node.node_id] == "LEADER"
+            assert list(roles.values()).count("FOLLOWER") == 2
+        finally:
+            for j in systems:
+                j.stop()
+
     def test_follower_cannot_write(self, tmp_path):
         systems, _ = make_quorum(tmp_path, free_ports(3))
         try:

@@ -248,6 +248,16 @@ class TestAdminShell:
                                  ["journal", "checkpoint"])
         assert code == 0 and "checkpoint" in out.lower()
 
+    def test_journal_quorum_requires_embedded(self, cluster):
+        # LOCAL journal: a clean typed failure, not a traceback
+        code, _, err = run_shell(ADMIN_SHELL, cluster,
+                                 ["journal", "quorum"])
+        assert code == 1 and "EMBEDDED" in err
+        code, _, err = run_shell(
+            ADMIN_SHELL, cluster,
+            ["journal", "quorum", "--transfer", "m1"])
+        assert code == 1 and "EMBEDDED" in err
+
 
 class TestJobShell:
     def test_ls_stat_cancel(self, cluster):

@@ -79,7 +79,9 @@ class TestMultiProcess:
                 raise TimeoutError("no serving primary")
 
             leader = primary_index()
-            fs = FsMasterClient(c.master_addresses, retry_duration_s=30.0)
+            # generous failover window: elections on a contended 1-core
+            # CI box can take tens of seconds during a full-suite run
+            fs = FsMasterClient(c.master_addresses, retry_duration_s=120.0)
             acked = []
             for i in range(15):
                 fs.create_directory(f"/pre-{i}")

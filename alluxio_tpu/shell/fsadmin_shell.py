@@ -135,6 +135,39 @@ class DoctorCommand(Command):
                 issues += 1
         if issues == 0 and report["status"] == "PASSED":
             ctx.print("No configuration conflicts found.")
+        # quorum health (EMBEDDED journal only; silent elsewhere)
+        try:
+            q = ctx.meta_client().get_quorum_info()
+        except Exception:  # noqa: BLE001 - LOCAL/UFS journal
+            q = None
+        if q is not None:
+            ctx.print(f"Quorum: leader={q['leader']} term={q['term']} "
+                      f"members={len(q['members'])}")
+            # match_index is only meaningful on a settled LEADER (it
+            # resets to 0 at election and is absent on followers) —
+            # lag analysis from any other respondent is a false alarm
+            me = next((m for m in q["members"]
+                       if m["address"] == "self"), None)
+            if me is not None and me["role"] == "LEADER":
+                for m in q["members"]:
+                    if m is me:
+                        continue
+                    if m["match_index"] + 50 < q["commit_index"]:
+                        ctx.print(
+                            f"WARN: quorum member {m['node_id']} lags "
+                            f"{q['commit_index'] - m['match_index']} "
+                            f"entries behind the commit index")
+        # process stall telemetry (pause monitor)
+        try:
+            metrics = ctx.meta_client().get_metrics()
+            pauses = metrics.get("Process.SeverePauses", 0)
+            maxp = metrics.get("Process.MaxPauseSeconds", 0.0)
+            if pauses or (maxp and maxp >= 1.0):
+                ctx.print(f"WARN: master stalled (max pause "
+                          f"{maxp:.2f}s, severe pauses {int(pauses)}) — "
+                          f"GC/CFS/host pressure can trip elections")
+        except Exception:  # noqa: BLE001
+            pass
         return 0 if report["status"] != "FAILED" else 1
 
 

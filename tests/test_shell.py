@@ -248,6 +248,26 @@ class TestAdminShell:
                                  ["journal", "checkpoint"])
         assert code == 0 and "checkpoint" in out.lower()
 
+    def test_doctor_surfaces_process_stalls(self, cluster):
+        from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
+
+        pm = ensure_process_monitor()
+        before_max = pm.max_pause_s
+        before_total = pm.total_pause_s
+        pm.observe(8.0)  # simulate a severe stall
+        try:
+            code, out, _ = run_shell(ADMIN_SHELL, cluster, ["doctor"])
+            assert code == 0
+            assert "stalled" in out
+        finally:
+            # undo ALL the simulated-stall state: the registry is
+            # process-global, and a leaked SeverePauses count would
+            # make every later doctor invocation warn
+            pm.max_pause_s = before_max
+            pm.total_pause_s = before_total
+            metrics().counter("Process.SeverePauses").dec()
+
     def test_journal_quorum_requires_embedded(self, cluster):
         # LOCAL journal: a clean typed failure, not a traceback
         code, _, err = run_shell(ADMIN_SHELL, cluster,

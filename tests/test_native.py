@@ -90,6 +90,33 @@ class TestNativeScanner:
         assert native.prefault(arr) is True
 
 
+class TestConcurrency:
+    def test_parallel_scans_agree(self, lib):
+        """The ctypes boundary releases the GIL: concurrent scans (e.g.
+        several minicluster roles recovering at once) must all see the
+        same frames — guards the CRC-table static-init discipline."""
+        import threading
+
+        bodies = [os.urandom(64) for _ in range(500)]
+        buf = b"".join(_frame(b) for b in bodies)
+        results, errors = [], []
+
+        def scan():
+            try:
+                results.append(native.scan_frames(buf))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=scan) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert all(r == results[0] for r in results)
+        assert len(results[0][0]) == 500
+
+
 class TestJournalIntegration:
     def test_decode_stream_uses_validated_frames(self, tmp_path, lib):
         from alluxio_tpu.journal.format import JournalEntry

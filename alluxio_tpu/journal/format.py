@@ -25,9 +25,14 @@ _HEADER = struct.Struct("<II")  # length, crc32
 def map_or_read(f: BinaryIO):
     """A contiguous view of a log file: mmap when possible (zero heap
     copy on multi-GB recovery), ``f.read()`` fallback (pipes, empty
-    files — mmapping zero bytes raises)."""
+    files — mmapping zero bytes raises). The two paths would disagree
+    for a pre-seeked file (mmap maps from 0, read() from ``tell()``),
+    so callers must pass freshly-opened files — asserted here rather
+    than papered over with a sliced view the cleanup sites couldn't
+    ``close()``."""
     import mmap
 
+    assert f.tell() == 0, "map_or_read requires a freshly-opened file"
     try:
         return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     except (ValueError, OSError):

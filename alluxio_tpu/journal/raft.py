@@ -786,7 +786,8 @@ class RaftNode:
             if not caught_up:
                 return False  # abort: no TimeoutNow at a lagging target
             try:
-                self.transport(addr, "timeout_now", {"term": term},
+                self.transport(addr, "timeout_now",
+                               {"term": term, "leader_id": self.node_id},
                                timeout=2.0)
             except Exception:  # noqa: BLE001 target unreachable
                 return False
@@ -808,12 +809,20 @@ class RaftNode:
 
     def handle_timeout_now(self, req: dict) -> dict:
         """TimeoutNow from the leader: start a forced election NOW.
-        Stale senders are rejected by term — a delayed TimeoutNow from
-        a deposed leader must not force-depose the healthy one (the
-        disruption pre-vote exists to prevent)."""
+        §3.10: TimeoutNow is LEADER-initiated only — the sender must
+        identify as the current leader at the current term, not merely
+        be term-fresh. This rejects honest-but-confused senders (a
+        stale candidate at an equal term, a buggy follower) whose
+        forced election would bypass pre-vote. Like all of Raft it is
+        crash-fault-tolerant only: a *malicious* peer forging the
+        leader's id is outside the model (peers are trusted)."""
         with self.lock:
             if self._stopped or self.state == LEADER or \
                     req.get("term", 0) < self.log.term:
+                return {"ok": False}
+            sender = req.get("leader_id")
+            if req.get("term", 0) == self.log.term and \
+                    sender != self.leader_id:
                 return {"ok": False}
         threading.Thread(target=self._start_election,
                          kwargs={"force": True}, daemon=True).start()

@@ -29,7 +29,7 @@ import time
 import xml.etree.ElementTree as ET
 from email.utils import formatdate, parsedate_to_datetime
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import quote, urlsplit
+from urllib.parse import quote, unquote_plus, urlsplit
 
 import requests
 
@@ -75,10 +75,16 @@ class _SharedKey:
             if k.startswith("x-ms-"))
         canon_res = f"/{self.account}{parts.path}"
         if parts.query:
+            # Azure computes the string-to-sign over URL-DECODED query
+            # names/values (SharedKey spec "Constructing the canonicalized
+            # resource string"): a prefix containing %2F or a continuation
+            # token with '+'/'=' must be decoded here or the service
+            # rejects the signature with 403 AuthenticationFailed.
             q: Dict[str, List[str]] = {}
             for kv in parts.query.split("&"):
                 k, _, v = kv.partition("=")
-                q.setdefault(k.lower(), []).append(v)
+                q.setdefault(unquote_plus(k).lower(), []).append(
+                    unquote_plus(v))
             for k in sorted(q):
                 canon_res += f"\n{k}:{','.join(sorted(q[k]))}"
         to_sign = "\n".join([

@@ -131,14 +131,19 @@ class WorkerWebServer:
                         } for d in t.dirs],
                     } for t in meta.tiers]}
                 if route == "/api/v1/worker/blocks":
+                    # block_ids() snapshots under the per-dir lock, so
+                    # iteration here is safe against concurrent
+                    # eviction/commit without holding the store-wide
+                    # allocation lock (an admin poll must not stall the
+                    # write path); cross-dir counts may be ~1 op skewed
                     out = {}
                     for t in meta.tiers:
                         count, sample = 0, []
                         for d in t.dirs:
-                            for b in d.block_ids():
-                                count += 1
-                                if len(sample) < _BLOCK_LIST_CAP:
-                                    sample.append(b)
+                            ids = d.block_ids()
+                            count += len(ids)
+                            sample.extend(
+                                ids[:_BLOCK_LIST_CAP - len(sample)])
                         out[t.alias] = {"count": count,
                                         "sample": sample}
                     return {"blocks": out}

@@ -15,10 +15,16 @@ from tests.testutils.fake_azure import FakeAzureServer
 from tests.testutils.fake_s3 import FakeS3Server
 
 
+KEY_B64 = base64.b64encode(b"k" * 32).decode()
+
+
 @pytest.fixture()
 def azure():
-    with FakeAzureServer() as srv:
+    # the fake recomputes every SharedKey signature server-side: any
+    # canonicalization drift in the client signer turns into a 403 here
+    with FakeAzureServer(verify_key_b64=KEY_B64) as srv:
         yield srv
+        assert srv.state.auth_failures == 0
 
 
 @pytest.fixture()
@@ -26,7 +32,7 @@ def wasb(azure):
     return WasbUnderFileSystem(
         "wasb://cont@acct.blob.core.windows.net/",
         {"azure.endpoint": azure.endpoint,
-         "azure.account.key": base64.b64encode(b"k" * 32).decode()})
+         "azure.account.key": KEY_B64})
 
 
 @pytest.fixture()
@@ -34,7 +40,7 @@ def abfs(azure):
     return AdlsUnderFileSystem(
         "abfs://fsys@acct.dfs.core.windows.net/",
         {"azure.endpoint": azure.endpoint,
-         "azure.account.key": base64.b64encode(b"k" * 32).decode()})
+         "azure.account.key": KEY_B64})
 
 
 class TestWasb:
@@ -101,6 +107,32 @@ class TestAbfs:
 
 
 class TestSharedKeySigner:
+    def test_signed_list_with_encoded_query_values(self, azure, wasb):
+        """Regression for the round-3 advisor finding: list_prefix sends
+        ``prefix=%2F``-style encoded query values; Azure signs over the
+        DECODED values, so a signer canonicalizing raw percent-encoded
+        text gets 403 from the (verifying) fake."""
+        with wasb.create("wasb://cont@a/deep/nested/f.bin") as w:
+            w.write(b"x")
+        names = {s.name for s in
+                 wasb.list_status("wasb://cont@a/deep/nested")}
+        assert names == {"f.bin"}
+        assert azure.state.auth_checked > 0
+        assert azure.state.auth_failures == 0
+
+    def test_fake_rejects_bad_signature(self, azure):
+        """The verifying fake must actually reject a wrong key —
+        otherwise the fixture's auth_failures==0 assert proves nothing."""
+        from alluxio_tpu.underfs.azure import AzureBlobClient
+
+        bad = AzureBlobClient(
+            "cont", "acct", "",
+            {"azure.endpoint": azure.endpoint,
+             "azure.account.key": base64.b64encode(b"wrong" * 8).decode()})
+        with pytest.raises(Exception):
+            bad.put("nope", b"x")
+        assert azure.state.auth_failures == 1
+        azure.state.auth_failures = 0  # expected; reset for teardown
     def test_signature_is_deterministic_hmac(self):
         key = base64.b64encode(b"secret-key-material").decode()
         s = _SharedKey("acct", key)

@@ -159,6 +159,17 @@ class TestQuorum:
             resp = follower.node.handle_timeout_now(
                 {"term": leader.node.log.term - 1})
             assert resp == {"ok": False}
+            # §3.10: TimeoutNow is leader-initiated ONLY — a current-term
+            # request whose sender identifies as a non-leader peer (a
+            # stale candidate, a buggy follower) must be rejected too,
+            # not just old-term ones
+            other = next(j for j in systems
+                         if not j.node.is_leader()
+                         and j is not follower)
+            resp = follower.node.handle_timeout_now(
+                {"term": leader.node.log.term,
+                 "leader_id": other.node.node_id})
+            assert resp == {"ok": False}
             time.sleep(0.3)
             assert leader.node.is_leader()  # undisturbed
         finally:

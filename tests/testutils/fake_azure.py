@@ -4,13 +4,16 @@ paths API (abfs). One store backs both, like a real HNS account."""
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import hmac
 import json
 import threading
 import time
 from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
-from urllib.parse import parse_qs, unquote, urlsplit
+from typing import Dict, Optional
+from urllib.parse import parse_qs, unquote, unquote_plus, urlsplit
 from xml.sax.saxutils import escape
 
 
@@ -21,6 +24,53 @@ class _State:
         #: uncommitted DFS appends: "container/key" -> bytearray
         self.staging: Dict[str, bytearray] = {}
         self.lock = threading.Lock()
+        #: when set, every request carrying an Authorization header is
+        #: re-signed server-side and rejected (403) on mismatch
+        self.verify_key: Optional[bytes] = None
+        self.auth_failures = 0
+        self.auth_checked = 0
+
+
+def _expected_signature(handler: "_Handler", account: str,
+                        key: bytes) -> str:
+    """Independent server-side SharedKey string-to-sign (2015-02-21+
+    dialect): standard headers, canonicalized x-ms-* headers, then the
+    canonicalized resource with URL-DECODED query names/values — written
+    from the Azure spec, NOT by importing the client signer, so the two
+    implementations genuinely cross-check each other."""
+    parts = urlsplit(handler.path)
+    h = {k.lower(): v.strip() for k, v in handler.headers.items()}
+    canon_headers = "".join(
+        f"{k}:{h[k]}\n" for k in sorted(h) if k.startswith("x-ms-"))
+    canon_res = f"/{account}{parts.path}"
+    if parts.query:
+        q: Dict[str, list] = {}
+        for kv in parts.query.split("&"):
+            k, _, v = kv.partition("=")
+            q.setdefault(unquote_plus(k).lower(), []).append(
+                unquote_plus(v))
+        for k in sorted(q):
+            canon_res += f"\n{k}:{','.join(sorted(q[k]))}"
+    length = h.get("content-length", "")
+    if length == "0":
+        length = ""
+    to_sign = "\n".join([
+        handler.command,
+        h.get("content-encoding", ""),
+        h.get("content-language", ""),
+        length,
+        h.get("content-md5", ""),
+        h.get("content-type", ""),
+        "",  # Date (always empty: x-ms-date is used instead)
+        h.get("if-modified-since", ""),
+        h.get("if-match", ""),
+        h.get("if-none-match", ""),
+        h.get("if-unmodified-since", ""),
+        h.get("range", ""),
+        canon_headers + canon_res,
+    ])
+    return base64.b64encode(
+        hmac.new(key, to_sign.encode(), hashlib.sha256).digest()).decode()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -28,6 +78,24 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):
         pass
+
+    def _check_auth(self) -> bool:
+        """True if the request may proceed."""
+        st = self.state
+        if st.verify_key is None:
+            return True
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("SharedKey "):
+            return True  # anonymous / SAS requests are not SharedKey
+        st.auth_checked += 1
+        account, _, sig = auth[len("SharedKey "):].partition(":")
+        want = _expected_signature(self, account, st.verify_key)
+        if sig != want:
+            st.auth_failures += 1
+            self._send(403, b"<Error><Code>AuthenticationFailed"
+                            b"</Code></Error>")
+            return False
+        return True
 
     def _parse(self):
         parts = urlsplit(self.path)
@@ -57,6 +125,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- verbs ---------------------------------------------------------------
     def do_PUT(self):  # noqa: N802
+        if not self._check_auth():
+            return
         c, key, q = self._parse()
         st = self.state
         full = f"{c}/{key}"
@@ -86,6 +156,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(201)
 
     def do_PATCH(self):  # noqa: N802
+        if not self._check_auth():
+            return
         c, key, q = self._parse()
         st = self.state
         full = f"{c}/{key}"
@@ -105,6 +177,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(400)
 
     def do_GET(self):  # noqa: N802
+        if not self._check_auth():
+            return
         c, key, q = self._parse()
         st = self.state
         if "comp" in q and q.get("comp") == "list":
@@ -127,6 +201,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, data)
 
     def do_HEAD(self):  # noqa: N802
+        if not self._check_auth():
+            return
         c, key, _ = self._parse()
         with self.state.lock:
             data = self.state.blobs.get(f"{c}/{key}")
@@ -138,6 +214,8 @@ class _Handler(BaseHTTPRequestHandler):
             "ETag": f'"{hash(data) & 0xffffffff:x}"'})
 
     def do_DELETE(self):  # noqa: N802
+        if not self._check_auth():
+            return
         c, key, _ = self._parse()
         full = f"{c}/{key}"
         with self.state.lock:
@@ -177,8 +255,12 @@ class _Handler(BaseHTTPRequestHandler):
 class FakeAzureServer:
     """``with FakeAzureServer() as srv: srv.endpoint``."""
 
-    def __init__(self) -> None:
+    def __init__(self, verify_key_b64: str = None) -> None:
+        """``verify_key_b64``: when given, SharedKey-authenticated
+        requests are re-signed server-side and 403'd on mismatch."""
         self.state = _State()
+        if verify_key_b64:
+            self.state.verify_key = base64.b64decode(verify_key_b64)
 
         class H(_Handler):
             state = self.state

@@ -27,12 +27,15 @@ def map_or_read(f: BinaryIO):
     copy on multi-GB recovery), ``f.read()`` fallback (pipes, empty
     files — mmapping zero bytes raises). The two paths would disagree
     for a pre-seeked file (mmap maps from 0, read() from ``tell()``),
-    so callers must pass freshly-opened files — asserted here rather
-    than papered over with a sliced view the cleanup sites couldn't
-    ``close()``."""
+    so callers must pass freshly-opened or rewound files — checked
+    here (when the stream can tell at all) rather than papered over
+    with a sliced view the cleanup sites couldn't ``close()``."""
     import mmap
 
-    assert f.tell() == 0, "map_or_read requires a freshly-opened file"
+    if f.seekable() and f.tell() != 0:
+        raise ValueError("map_or_read requires position 0 "
+                         "(pre-seeked file would decode differently "
+                         "on the mmap vs read() path)")
     try:
         return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
     except (ValueError, OSError):

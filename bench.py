@@ -45,27 +45,72 @@ import time
 import numpy as np
 
 BLOCK_BYTES = int(os.environ.get("BENCH_BLOCK_BYTES", 32 << 20))
-NUM_BLOCKS = int(os.environ.get("BENCH_NUM_BLOCKS", 16))
+# 64 x 32 MiB = 2 GiB HBM working set: the round-2 data put the XLA
+# while-loop's fixed per-iteration cost at ~57 us against a 0.66 ms
+# read, an 8% tax; 4x the per-iteration read amortizes it to ~2%
+NUM_BLOCKS = int(os.environ.get("BENCH_NUM_BLOCKS", 64))
 EPOCHS = int(os.environ.get("BENCH_HBM_EPOCHS", 5))
-K = int(os.environ.get("BENCH_CHAIN_ITERS", 12000))
+# K scales inversely with the working set: K * NUM_BLOCKS * BLOCK_BYTES
+# (total device-side bytes per epoch) matches round 2's 6.4 TB
+K = int(os.environ.get("BENCH_CHAIN_ITERS", 3000))
+UNROLL = int(os.environ.get("BENCH_UNROLL", 4))
 V5E_HBM_GBPS = 819.0
 TARGET_GBPS = 0.9 * V5E_HBM_GBPS
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
 
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def _probe_device(timeout_s: float = 180.0):
-    """Device init with a deadline: a wedged accelerator tunnel (stuck
-    grant) must fail the bench FAST with a diagnosis, not hang the
-    driver until its own timeout with zero output."""
+_PROBE_SRC = """
+import jax, jax.numpy as jnp
+dev = jax.devices()[0]
+jnp.ones((4,)).sum().block_until_ready()
+print("PROBE_OK", dev, flush=True)
+"""
+
+
+def _probe_device(attempts: int = PROBE_ATTEMPTS,
+                  timeout_s: float = PROBE_TIMEOUT_S) -> bool:
+    """Bounded-retry device probe in CHILD processes: a wedged
+    accelerator tunnel (stuck grant) must not hang the bench — each
+    attempt gets its own clean process + deadline, and after the last
+    one the caller falls back to host-only metrics so the driver always
+    receives a parseable JSON line (round-3 shipped ``parsed: null``
+    when one in-process probe hung; never again)."""
+    import subprocess
+
+    for i in range(1, attempts + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s)
+            if "PROBE_OK" in (r.stdout or ""):
+                log(f"device probe attempt {i}/{attempts}: "
+                    f"{r.stdout.strip().splitlines()[-1]}")
+                return True
+            log(f"device probe attempt {i}/{attempts}: rc={r.returncode} "
+                f"{(r.stderr or '').strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"device probe attempt {i}/{attempts}: no device grant "
+                f"within {timeout_s:.0f}s — tunnel wedged?")
+        if i < attempts:
+            time.sleep(15 * i)  # grants sometimes free up between tries
+    return False
+
+
+def _init_device(timeout_s: float = 240.0):
+    """In-process init AFTER a successful child probe (the grant is
+    known to be available, so this should be fast) — still guarded by
+    a deadline in case the grant vanished between probe and init."""
     import queue
     import threading
 
     out: "queue.Queue" = queue.Queue()
 
-    def probe():
+    def init():
         try:
             import jax
             import jax.numpy as jnp
@@ -76,23 +121,114 @@ def _probe_device(timeout_s: float = 180.0):
         except Exception as e:  # noqa: BLE001
             out.put(e)
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=init, daemon=True)
     t.start()
     try:
         got = out.get(timeout=timeout_s)
     except queue.Empty:
-        log(f"FATAL: device init did not complete within {timeout_s}s "
-            f"— the accelerator tunnel looks wedged (stuck grant?); "
-            f"no metric emitted")
-        raise SystemExit(3)
+        log(f"in-process device init still hung after {timeout_s:.0f}s")
+        return None
     if isinstance(got, Exception):
-        log(f"FATAL: device init failed: {got}")
-        raise SystemExit(3)
+        log(f"in-process device init failed: {got!r}")
+        return None
     return got
 
 
+def _spawn_host_fallback(diagnosis: str) -> None:
+    """Run the host-only fallback in a CHILD process with the axon
+    plugin env removed: in a wedged-tunnel process even
+    ``JAX_PLATFORMS=cpu`` hangs at backend discovery once the plugin
+    is registered (observed), so the fallback needs an interpreter
+    that never saw the plugin. The child inherits stdout, so its JSON
+    line IS this process's one line."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gate
+    env["JAX_PLATFORMS"] = "cpu"
+    failure = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--host-fallback", diagnosis], env=env, timeout=900)
+        if r.returncode != 0:
+            failure = f"fallback bench failed rc={r.returncode}"
+    except Exception as e:  # noqa: BLE001 incl. TimeoutExpired
+        failure = f"fallback bench died: {type(e).__name__}"
+    if failure is not None:
+        # never leave the driver with nothing parseable
+        print(json.dumps({
+            "metric": f"HOST-ONLY DIAGNOSTIC ({failure})",
+            "value": 0.0, "unit": "GB/s", "vs_baseline": 0.0,
+            "tpu_wedged": True, "diagnosis": diagnosis,
+        }), flush=True)
+
+
+def _host_fallback(diagnosis: str) -> None:
+    """TPU unreachable: measure the HOST half of the data plane (cold
+    write-through + warm host-tier short-circuit read) and emit a
+    clearly-labelled diagnostic JSON line. ``vs_baseline`` is 0.0 —
+    host numbers are NOT evidence against the HBM target; the point is
+    that the driver records a diagnosis instead of ``parsed: null``."""
+    from alluxio_tpu.client.streams import WriteType
+    from alluxio_tpu.minicluster import LocalCluster
+
+    total_bytes = BLOCK_BYTES * min(NUM_BLOCKS, 16)
+    base = tempfile.mkdtemp(prefix="atpu_bench_host_",
+                            dir="/dev/shm" if os.path.isdir("/dev/shm")
+                            else None)
+    value = 0.0
+    try:
+        with LocalCluster(base, num_workers=1, block_size=BLOCK_BYTES,
+                          worker_mem_bytes=total_bytes + (256 << 20)) as c:
+            fs = c.file_system()
+            rng = np.random.default_rng(0)
+            n = total_bytes // BLOCK_BYTES
+            t0 = time.monotonic()
+            for i in range(n):
+                fs.write_all(
+                    f"/bench/shard-{i}",
+                    rng.integers(0, 255, size=BLOCK_BYTES,
+                                 dtype=np.uint8).tobytes(),
+                    write_type=WriteType.MUST_CACHE)
+            cold = total_bytes / (time.monotonic() - t0) / 1e9
+            rates = []
+            for _e in range(3):
+                t0 = time.monotonic()
+                got = sum(len(fs.read_all(f"/bench/shard-{i}"))
+                          for i in range(n))
+                rates.append(got / (time.monotonic() - t0) / 1e9)
+            value = sorted(rates)[len(rates) // 2]
+            log(f"host fallback: cold write {cold:.2f} GB/s, warm "
+                f"host-tier read {', '.join(f'{r:.2f}' for r in rates)} "
+                f"GB/s")
+            fs.close()
+    except Exception as e:  # noqa: BLE001 never lose the diagnosis
+        log(f"host fallback bench itself failed: {e!r}")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    print(json.dumps({
+        "metric": "HOST-ONLY DIAGNOSTIC warm host-tier read GB/s "
+                  "(TPU unavailable: no HBM evidence this run)",
+        "value": round(value, 2),
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "tpu_wedged": True,
+        "diagnosis": diagnosis,
+    }), flush=True)
+
+
 def main() -> None:
-    device = _probe_device()
+    if not _probe_device():
+        _spawn_host_fallback(
+            f"no device grant after {PROBE_ATTEMPTS} attempts x "
+            f"{PROBE_TIMEOUT_S:.0f}s — accelerator tunnel wedged")
+        return
+    device = _init_device()
+    if device is None:
+        _spawn_host_fallback("child probe saw a device but in-process "
+                             "init failed or hung")
+        return
 
     import jax
     import jax.numpy as jnp
@@ -123,6 +259,7 @@ def main() -> None:
                 fs.write_all(f"/bench/shard-{i}", payloads[i],
                              write_type=WriteType.MUST_CACHE)
             log(f"cold write: {total_bytes / (time.monotonic() - t0) / 1e9:.2f} GB/s")
+            del payloads[1:]  # worker holds the data now; free host RAM
 
             # -- raw tunnel h2d ceiling (environment baseline) -------------
             # DISTINCT source arrays per put: re-putting one buffer can
@@ -229,7 +366,9 @@ def main() -> None:
 
                 import jax.lax as lax
 
-                return lax.fori_loop(0, K, body, acc0)
+                # unroll: several body copies per while-iteration —
+                # same K reads, 1/UNROLL of the loop-condition overhead
+                return lax.fori_loop(0, K, body, acc0, unroll=UNROLL)
 
             from alluxio_tpu.ops import reduce_kernel
 
@@ -247,7 +386,8 @@ def main() -> None:
                         return (reduce_kernel.scaled_sum(
                             X, acc % 3 + 1) + acc) % 1000003
 
-                    return jax.lax.fori_loop(0, K, body, acc0)
+                    return jax.lax.fori_loop(0, K, body, acc0,
+                                             unroll=UNROLL)
 
                 candidates = [("xla", consume), ("pallas", consume_pallas)]
             else:
@@ -291,8 +431,9 @@ def main() -> None:
             # at equal K; report the implied raw rate assuming the measured
             # ~65 ms/dispatch tunnel cost instead
             med_t = times[order[EPOCHS // 2]]
-            log(f"implied raw device read rate (65 ms dispatch cost "
-                f"removed): {K * total_bytes / max(med_t - 0.065, 1e-9) / 1e9:.1f} GB/s")
+            if med_t > 0.5:  # meaningless when the epoch ~ dispatch cost
+                log(f"implied raw device read rate (65 ms dispatch cost "
+                    f"removed): {K * total_bytes / (med_t - 0.065) / 1e9:.1f} GB/s")
             log(f"loader stats: {loader.hbm_stats()}")
 
             # -- e2e: decode -> train-step epoch over cached records -------
@@ -492,5 +633,9 @@ def suite() -> None:
 if __name__ == "__main__":
     if "--suite" in sys.argv:
         suite()
+    elif "--host-fallback" in sys.argv:
+        i = sys.argv.index("--host-fallback")
+        _host_fallback(sys.argv[i + 1] if len(sys.argv) > i + 1
+                       else "unknown")
     else:
         main()

@@ -92,6 +92,8 @@ class _Handler(BaseHTTPRequestHandler):
         want = _expected_signature(self, account, st.verify_key)
         if sig != want:
             st.auth_failures += 1
+            self._body()  # drain: a reset mid-upload would surface as
+            # ConnectionError client-side instead of the clean 403
             self._send(403, b"<Error><Code>AuthenticationFailed"
                             b"</Code></Error>")
             return False

@@ -77,9 +77,13 @@ class FileSystem:
         from alluxio_tpu.security.authentication import client_metadata
 
         md = tuple(client_metadata(self._conf))
-        self.fs_master = FsMasterClient(master_address, metadata=md)
-        self.block_master = BlockMasterClient(master_address, metadata=md)
-        self.meta_master = MetaMasterClient(master_address, metadata=md)
+        fp_dir = self._conf.get(Keys.MASTER_FASTPATH_DIR)
+        self.fs_master = FsMasterClient(master_address, metadata=md,
+                                        fastpath_dir=fp_dir)
+        self.block_master = BlockMasterClient(master_address, metadata=md,
+                                              fastpath_dir=fp_dir)
+        self.meta_master = MetaMasterClient(master_address, metadata=md,
+                                            fastpath_dir=fp_dir)
         identity = TieredIdentity.from_spec(
             self._conf.get(Keys.TIERED_IDENTITY),
             hostname=socket.gethostname())

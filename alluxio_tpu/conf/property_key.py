@@ -43,7 +43,9 @@ class ConsistencyLevel(enum.Enum):
 
 
 _DURATION_RE = re.compile(r"^\s*(-?\d+(?:\.\d+)?)\s*(ms|s|sec|m|min|h|hr|d|day)?\s*$")
-_BYTES_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(b|kb|mb|gb|tb|pb|k|m|g|t|p)?\s*$", re.I)
+_BYTES_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)\s*(b|kb|mb|gb|tb|pb|k|m|g|t|p|ki|mi|gi|ti|pi)?\s*$",
+    re.I)
 
 _DURATION_UNITS = {
     None: 0.001,  # bare numbers are milliseconds, matching the reference
@@ -61,11 +63,13 @@ _DURATION_UNITS = {
 _BYTE_UNITS = {
     None: 1,
     "b": 1,
-    "k": 1 << 10, "kb": 1 << 10,
-    "m": 1 << 20, "mb": 1 << 20,
-    "g": 1 << 30, "gb": 1 << 30,
-    "t": 1 << 40, "tb": 1 << 40,
-    "p": 1 << 50, "pb": 1 << 50,
+    # "ki/mi/gi" are the Kubernetes quantity spellings — accepted so
+    # chart values flow into ATPU_* env vars verbatim
+    "k": 1 << 10, "kb": 1 << 10, "ki": 1 << 10,
+    "m": 1 << 20, "mb": 1 << 20, "mi": 1 << 20,
+    "g": 1 << 30, "gb": 1 << 30, "gi": 1 << 30,
+    "t": 1 << 40, "tb": 1 << 40, "ti": 1 << 40,
+    "p": 1 << 50, "pb": 1 << 50, "pi": 1 << 50,
 }
 
 
@@ -312,6 +316,24 @@ class Keys:
         scope=Scope.MASTER,
         description="Serve the read-only HTTP/JSON state endpoint "
                     "(reference: AlluxioMasterRestServiceHandler).")
+    MASTER_MOUNT_TABLE_ROOT_UFS = _k(
+        "atpu.master.mount.table.root.ufs", default="",
+        scope=Scope.MASTER,
+        description="UFS URI mounted at the namespace root (reference: "
+                    "alluxio.master.mount.table.root.ufs). Empty: a "
+                    "local directory under atpu.home.")
+    MASTER_FASTPATH_ENABLED = _k(
+        "atpu.master.fastpath.enabled", KeyType.BOOL, default=True,
+        scope=Scope.MASTER,
+        description="Serve metadata RPCs over a same-host Unix-socket "
+                    "fast path (framed msgpack, no HTTP/2) alongside "
+                    "gRPC; local clients short-circuit onto it and "
+                    "remote ones keep using gRPC (rpc/fastpath.py).")
+    MASTER_FASTPATH_DIR = _k(
+        "atpu.master.fastpath.dir", default="/tmp",
+        description="Directory for the fastpath Unix socket "
+                    "(atpu-master-<rpc_port>.sock); clients probe the "
+                    "same conventional path.")
     MASTER_JOURNAL_TYPE = _k("atpu.master.journal.type", KeyType.ENUM,
                              default="LOCAL", choices=("LOCAL", "UFS", "EMBEDDED", "NOOP"),
                              scope=Scope.MASTER)

@@ -107,6 +107,26 @@ class JournalSystem:
     def create_context(self) -> JournalContext:
         return JournalContext(self)
 
+    def deferred_durability(self):
+        """Scope in which journal contexts may DEFER their durability
+        wait to scope exit (reference: ``AsyncJournalWriter`` — state is
+        applied immediately, the fsync happens once per RPC, after all
+        locks are released, before the response goes out). Default: a
+        no-op scope; flavors with a real fsync override this."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def immediate_durability(self):
+        """Scope that suspends ``deferred_durability`` for writes that
+        must be durable BEFORE their effects are exposed to other
+        threads (e.g. id-chunk reservations: an id may be handed out,
+        used and journaled by another RPC before the deferring RPC ever
+        flushes its reservation)."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
     # maintenance
     def checkpoint(self) -> None: ...
 
@@ -155,6 +175,12 @@ class LocalJournalSystem(JournalSystem):
         self._file_start_seq = 1
         self._lock = threading.RLock()
         self._closed = False
+        # group commit: one fsync covers every entry written before it
+        # (reference: AsyncJournalWriter's flush batching)
+        self._flush_lock = threading.Lock()
+        self._written_seq = 0   # last seq written to the file buffer
+        self._durable_seq = 0   # last seq known fsync-durable
+        self._deferred = threading.local()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -227,6 +253,7 @@ class LocalJournalSystem(JournalSystem):
             return
         self._file.flush()
         os.fsync(self._file.fileno())
+        self._durable_seq = max(self._durable_seq, self._written_seq)
         self._file.close()
         self._file = None
         cur = os.path.join(self._log_dir, ACTIVE_LOG)
@@ -251,13 +278,18 @@ class LocalJournalSystem(JournalSystem):
             return JournalEntry(self._seq, entry_type, payload)
 
     def write_and_flush(self, entries: List[JournalEntry]) -> None:
-        """Group-commit: write + fsync this batch, then apply to state.
+        """Write + apply this batch; make it durable before returning —
+        either right here, or (inside a ``deferred_durability`` scope)
+        once at scope exit so one fsync covers every context the RPC
+        opened AND coalesces with other threads' flushes (group commit,
+        reference ``AsyncJournalWriter``).
 
-        The reference applies state first and journals async
-        (AsyncJournalWriter) with flush-before-RPC-return; we journal first
-        then apply, which gives the same externally-visible contract
-        (no acknowledged mutation is lost) with a simpler recovery story
-        (no rollback of un-journaled state needed).
+        The write and the in-memory apply stay under the main lock (no
+        semantic change for state readers); only the fsync moves out.
+        An entry is applied before it is durable — same visibility
+        contract as the reference, which applies first and flushes
+        before the mutating RPC responds: no ACKNOWLEDGED mutation is
+        ever lost.
         """
         if not entries:
             return
@@ -266,16 +298,84 @@ class LocalJournalSystem(JournalSystem):
                 raise JournalClosedError("journal not open for writes")
             for e in entries:
                 self._file.write(e.encode())
-            self._flush_locked()
+            # monotonic: batches may write out of allocation order
+            # across threads; regressing this would make _ensure_durable
+            # under-record what an fsync covered (redundant fsyncs)
+            if entries[-1].sequence > self._written_seq:
+                self._written_seq = entries[-1].sequence
             for e in entries:
                 self._apply(e)
             self._maybe_rotate()
             if self._seq - self._last_checkpoint_seq >= self._checkpoint_period:
                 self._checkpoint_locked()
+        last = entries[-1].sequence
+        if getattr(self._deferred, "on", False):
+            self._deferred.want = last
+            return
+        self._ensure_durable(last)
 
-    def _flush_locked(self) -> None:
-        self._file.flush()
-        os.fsync(self._file.fileno())
+    def deferred_durability(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            prev = getattr(self._deferred, "on", False)
+            self._deferred.on = True
+            self._deferred.want = 0
+            try:
+                yield
+            finally:
+                want = getattr(self._deferred, "want", 0)
+                self._deferred.on = prev
+                if want:
+                    self._ensure_durable(want)
+
+        return scope()
+
+    def immediate_durability(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            prev = getattr(self._deferred, "on", False)
+            self._deferred.on = False
+            try:
+                yield
+            finally:
+                self._deferred.on = prev
+
+        return scope()
+
+    def _ensure_durable(self, seq: int) -> None:
+        """Block until every entry up to ``seq`` is fsync-durable. One
+        flusher syncs for the whole convoy: waiters that arrive while an
+        fsync is in flight find their seq already covered and return
+        without issuing their own."""
+        if self._durable_seq >= seq:
+            return
+        with self._flush_lock:
+            with self._lock:
+                if self._durable_seq >= seq:
+                    return
+                f = self._file
+                if f is None:
+                    # rotation/close fsyncs everything it closes
+                    return
+                f.flush()
+                target = self._written_seq
+                fd = f.fileno()
+            try:
+                os.fsync(fd)
+            except (OSError, ValueError):
+                # the log rotated under us and closed this fd — rotation
+                # fsyncs before closing, so our entries are durable
+                with self._lock:
+                    if self._durable_seq >= seq:
+                        return
+                    raise
+            with self._lock:
+                if target > self._durable_seq:
+                    self._durable_seq = target
 
     # -- checkpoint ---------------------------------------------------------
     def checkpoint(self) -> None:

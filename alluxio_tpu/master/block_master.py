@@ -106,18 +106,49 @@ class BlockMaster(Journaled):
         #: prune_device_reports, driven by the lost-worker heartbeat)
         self._device_report_ms: Dict[str, int] = {}
         self.device_report_ttl_ms = 5 * 60 * 1000
+        #: ids below this mark are covered by a journaled reservation
+        self._container_reserved = 0
+        self._reserve_lock = threading.Lock()
         self._lost_blocks: Set[int] = set()
         #: listeners fired on worker loss (elastic re-replication hook)
         self.lost_worker_listeners: List = []
 
+    #: container ids are journaled as a high-water mark in chunks of this
+    #: size: one BLOCK_CONTAINER_ID entry covers the next N allocations,
+    #: so create_file doesn't pay a journal flush per id. Replay resumes
+    #: from the mark; ids the crashed master never handed out are simply
+    #: skipped (ids are opaque). Reference:
+    #: ``BlockContainerIdGenerator`` + ``JournalEntry.block_container_id``.
+    CONTAINER_ID_RESERVATION = 1024
+
     # ------------------------------------------------------------ container
     def new_container_id(self) -> int:
-        """Journaled container-id allocation (reference journals the counter
-        in batches; we journal each bump — cheap at msgpack sizes)."""
+        """Journaled container-id allocation via chunked reservation.
+
+        The mark must be DURABLE before any id it covers is published:
+        another RPC could use id mark-1 and group-commit its inode entry
+        while this RPC's (deferred) reservation flush never happens, and
+        replay would then re-issue used ids. Hence immediate_durability
+        + publishing ``_container_reserved`` only after the write (one
+        fsync per CONTAINER_ID_RESERVATION creates).
+
+        Locking: a DEDICATED ``_reserve_lock``, never ``self._lock`` —
+        journal writes apply entries under the journal lock and that
+        apply path takes ``self._lock`` (``process_entry``), so holding
+        ``self._lock`` while entering the journal would be an ABBA
+        deadlock against any concurrent block mutation."""
         cid = self.container_ids.next_container_id()
-        with self._journal.create_context() as ctx:
-            ctx.append(EntryType.BLOCK_CONTAINER_ID,
-                       {"next_container_id": cid + 1, "owner": self.journal_name})
+        if cid >= self._container_reserved:
+            with self._reserve_lock:
+                if cid < self._container_reserved:  # another thread won
+                    return cid
+                mark = cid + self.CONTAINER_ID_RESERVATION
+                with self._journal.immediate_durability(), \
+                        self._journal.create_context() as ctx:
+                    ctx.append(EntryType.BLOCK_CONTAINER_ID,
+                               {"next_container_id": mark,
+                                "owner": self.journal_name})
+                self._container_reserved = mark
         return cid
 
     # -------------------------------------------------------------- workers
@@ -478,7 +509,16 @@ class BlockMaster(Journaled):
                 self._lost_blocks.discard(p["block_id"])
         elif t == EntryType.BLOCK_CONTAINER_ID and \
                 p.get("owner") == self.journal_name:
-            self.container_ids.restore(p["next_container_id"])
+            if self._journal.is_primary():
+                # live self-apply: the generator already advanced past
+                # the ids being reserved; jumping it to the mark would
+                # burn the whole chunk and re-reserve on EVERY call.
+                # Only track the covered range.
+                self._container_reserved = max(
+                    self._container_reserved, p["next_container_id"])
+            else:
+                # replay / standby tailing: resume above the mark
+                self.container_ids.restore(p["next_container_id"])
         else:
             return False
         return True
@@ -486,7 +526,12 @@ class BlockMaster(Journaled):
     def snapshot(self) -> dict:
         with self._lock:
             return {
-                "next_container_id": self.container_ids.peek,
+                # the RESERVED mark, not peek: a checkpoint GCs the
+                # segment holding the reservation entry, so the snapshot
+                # must carry the full covered range or replay would
+                # re-issue ids handed out after the checkpoint
+                "next_container_id": max(self.container_ids.peek,
+                                         self._container_reserved),
                 "blocks": [(m.block_id, m.length) for m in self._blocks.values()],
             }
 
@@ -496,5 +541,6 @@ class BlockMaster(Journaled):
                             for bid, length in snap.get("blocks", [])}
             self.container_ids = ids.ContainerIdGenerator(
                 snap.get("next_container_id", 1))
+            self._container_reserved = snap.get("next_container_id", 1)
             self._locations.clear()
             self._lost_blocks.clear()

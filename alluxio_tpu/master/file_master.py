@@ -214,14 +214,18 @@ class FileSystemMaster:
 
     def list_status(self, path: "str | AlluxioURI", *, recursive: bool = False,
                     load_direct_children: bool = True,
-                    sync_interval_ms: int = -1) -> List[FileInfo]:
+                    sync_interval_ms: int = -1,
+                    wire: bool = False) -> List[FileInfo]:
+        """``wire=True``: entries are returned as wire DICTS (what the
+        RPC handler ships) — N dataclass constructions skipped."""
         uri = AlluxioURI(path)
-        self._maybe_sync(uri, sync_interval_ms)
+        synced = self._maybe_sync(uri, sync_interval_ms)
         status = self.get_status(uri)  # loads the inode itself if needed
         if not status.folder:
-            return [status]
+            return [status.to_wire()] if wire else [status]
         if load_direct_children:
-            self._load_children_if_needed(uri)
+            self._load_children_if_needed(uri, force=synced)
+        info = self._file_info_dict if wire else self._file_info
         out: List[FileInfo] = []
         with self.inode_tree.lock.read_locked():
             lookup = self.inode_tree.lookup(uri)
@@ -232,11 +236,29 @@ class FileSystemMaster:
             self._check_access(lookup, READ)
 
             def emit(dir_inode: Inode, dir_uri: AlluxioURI) -> None:
+                # resolve the directory's mount ONCE; children extend it
+                # by name. Only a child that is itself a mount point (a
+                # nested mount lands exactly one level down) needs its
+                # own resolution — the rest skip the per-child mount
+                # walk + URI construction that dominated listing CPU.
+                try:
+                    dres = self.mount_table.resolve(dir_uri)
+                    d_ufs = dres.ufs_path.rstrip("/")
+                    d_mount = dres.mount_id
+                except Exception:  # noqa: BLE001 unmounted region
+                    d_ufs, d_mount = "", 0
+                d_path = dir_uri.path if dir_uri.path != "/" else ""
                 for child in self.inode_tree.children(dir_inode):
-                    child_uri = dir_uri.join(child.name)
-                    out.append(self._file_info(child, child_uri))
+                    child_path = f"{d_path}/{child.name}"
+                    if self.mount_table.is_mount_path(child_path):
+                        child_uri = dir_uri.join(child.name)
+                        out.append(info(child, child_uri))
+                    else:
+                        mount = (f"{d_ufs}/{child.name}" if d_ufs else "",
+                                 d_mount)
+                        out.append(info(child, child_path, mount=mount))
                     if recursive and child.is_directory:
-                        emit(child, child_uri)
+                        emit(child, dir_uri.join(child.name))
 
             emit(lookup.inode, uri)
         return out
@@ -263,7 +285,21 @@ class FileSystemMaster:
                                      offset=i * inode.block_size_bytes))
         return out
 
-    def _file_info(self, inode: Inode, uri: AlluxioURI) -> FileInfo:
+    def _file_info(self, inode: Inode, uri: "AlluxioURI | str",
+                   mount: Optional[tuple] = None) -> FileInfo:
+        return FileInfo.from_wire(self._file_info_dict(inode, uri, mount))
+
+    def _file_info_dict(self, inode: Inode, uri: "AlluxioURI | str",
+                        mount: Optional[tuple] = None) -> dict:
+        """FileInfo in WIRE-DICT form — the RPC handlers ship this
+        straight into msgpack without materializing a FileInfo (a
+        listing of N entries skips N dataclass constructions + N
+        ``to_wire`` copies; in-process callers get objects via
+        ``_file_info``). ``mount``: precomputed ``(ufs_path, mount_id)``
+        from a listing loop that resolved the parent once (the child
+        then cannot be a mount point — the caller checked); ``uri`` may
+        then be a plain path string, skipping per-child URI
+        construction."""
         in_mem = 0
         fbi: List[FileBlockInfo] = []
         if not inode.is_directory and inode.block_ids:
@@ -277,31 +313,44 @@ class FileSystemMaster:
                     mem_bytes += f.block_info.length
             in_mem = int(100 * mem_bytes / inode.length) if inode.length else (
                 100 if fbi else 0)
-        try:
-            resolution = self.mount_table.resolve(uri)
-            ufs_path = resolution.ufs_path
-            mount_id = resolution.mount_id
-        except Exception:  # noqa: BLE001 - unmounted regions have no UFS path
-            ufs_path, mount_id = "", 0
-        return FileInfo(
-            file_id=inode.id, name=inode.name or "/", path=uri.path,
-            ufs_path=ufs_path, length=inode.length,
-            block_size_bytes=inode.block_size_bytes,
-            creation_time_ms=inode.creation_time_ms,
-            last_modification_time_ms=inode.last_modification_time_ms,
-            last_access_time_ms=inode.last_access_time_ms,
-            completed=inode.completed or inode.is_directory,
-            folder=inode.is_directory, pinned=inode.pinned,
-            pinned_media=list(inode.pinned_media), cacheable=inode.cacheable,
-            persisted=inode.persistence_state == PersistenceState.PERSISTED,
-            persistence_state=inode.persistence_state,
-            block_ids=list(inode.block_ids), in_memory_percentage=in_mem,
-            ttl=inode.ttl, ttl_action=inode.ttl_action, owner=inode.owner,
-            group=inode.group, mode=inode.mode,
-            mount_point=self.mount_table.is_mount_point(uri),
-            mount_id=mount_id, replication_min=inode.replication_min,
-            replication_max=inode.replication_max, file_block_infos=fbi,
-            xattr=dict(inode.xattr))
+        if mount is not None:
+            ufs_path, mount_id = mount
+            is_mp = False
+            path = uri if isinstance(uri, str) else uri.path
+        else:
+            if isinstance(uri, str):
+                uri = AlluxioURI(uri)
+            path = uri.path
+            try:
+                resolution = self.mount_table.resolve(uri)
+                ufs_path = resolution.ufs_path
+                mount_id = resolution.mount_id
+            except Exception:  # noqa: BLE001 - unmounted: no UFS path
+                ufs_path, mount_id = "", 0
+            is_mp = self.mount_table.is_mount_point(uri)
+        return {
+            "file_id": inode.id, "name": inode.name or "/", "path": path,
+            "ufs_path": ufs_path, "length": inode.length,
+            "block_size_bytes": inode.block_size_bytes,
+            "creation_time_ms": inode.creation_time_ms,
+            "last_modification_time_ms": inode.last_modification_time_ms,
+            "last_access_time_ms": inode.last_access_time_ms,
+            "completed": inode.completed or inode.is_directory,
+            "folder": inode.is_directory, "pinned": inode.pinned,
+            "pinned_media": list(inode.pinned_media),
+            "cacheable": inode.cacheable,
+            "persisted":
+                inode.persistence_state == PersistenceState.PERSISTED,
+            "persistence_state": inode.persistence_state,
+            "block_ids": list(inode.block_ids),
+            "in_memory_percentage": in_mem,
+            "ttl": inode.ttl, "ttl_action": inode.ttl_action,
+            "owner": inode.owner, "group": inode.group, "mode": inode.mode,
+            "mount_point": is_mp, "mount_id": mount_id,
+            "replication_min": inode.replication_min,
+            "replication_max": inode.replication_max,
+            "file_block_infos": [f.to_wire() for f in fbi],
+            "xattr": dict(inode.xattr)}
 
     # --------------------------------------------------------------- create
     def create_file(self, path: "str | AlluxioURI", *,
@@ -1031,14 +1080,17 @@ class FileSystemMaster:
                 pass
 
     # ------------------------------------------------------- UFS metadata sync
-    def _maybe_sync(self, uri: AlluxioURI, sync_interval_ms: int) -> None:
+    def _maybe_sync(self, uri: AlluxioURI, sync_interval_ms: int) -> bool:
         """On-access sync gate (reference: ``InodeSyncStream.java:115`` +
         ``UfsSyncPathCache``): -1 never, 0 always, >0 min interval. A
-        recursive sync of an ancestor freshens this path too."""
+        recursive sync of an ancestor freshens this path too. Returns
+        True when a sync actually ran — listings use that to force a
+        UFS child re-list past ``direct_children_loaded``."""
         if not self._sync_cache.should_sync(uri.path, self._now(),
                                             sync_interval_ms):
-            return
+            return False
         self.sync_metadata(uri)
+        return True
 
     def sync_metadata(self, path: "str | AlluxioURI", *,
                       recursive: bool = False) -> bool:
@@ -1229,8 +1281,21 @@ class FileSystemMaster:
                     remaining -= self._default_block_size
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
-    def _load_children_if_needed(self, uri: AlluxioURI) -> None:
-        """List the UFS dir and load any children absent from the tree."""
+    def _load_children_if_needed(self, uri: AlluxioURI,
+                                 force: bool = False) -> None:
+        """List the UFS dir and load any children absent from the tree —
+        ONCE per directory: ``direct_children_loaded`` marks a dir whose
+        UFS children are in the tree, and subsequent listings skip the
+        UFS round trip entirely. A listing whose sync-interval fired
+        passes ``force=True`` to re-list past the flag (that is HOW
+        external UFS changes surface — reference:
+        ``InodeDirectory.isDirectChildrenLoaded`` +
+        ``DefaultFileSystemMaster.listStatus`` descendant sync)."""
+        if not force:
+            with self.inode_tree.lock.read_locked():
+                lookup = self.inode_tree.lookup(uri)
+                if lookup.exists and lookup.inode.direct_children_loaded:
+                    return
         try:
             resolution = self.mount_table.resolve(uri)
         except Exception:  # noqa: BLE001
@@ -1239,7 +1304,10 @@ class FileSystemMaster:
             return
         ufs = self._ufs.get(resolution.mount_id)
         children = ufs.list_status(resolution.ufs_path)
-        if not children:
+        if children is None:
+            # could not list (UFS dir gone/unreadable) — the once-only
+            # flag must NOT latch on this outcome or the children would
+            # be hidden forever once the dir reappears
             return
         with self.inode_tree.lock.read_locked():
             lookup = self.inode_tree.lookup(uri)
@@ -1249,6 +1317,21 @@ class FileSystemMaster:
         for st in children:
             if st.name not in known:
                 self._load_metadata_if_exists(uri.join(st.name))
+        self._mark_children_loaded(uri)
+
+    def _mark_children_loaded(self, uri: AlluxioURI) -> None:
+        """Journal ``direct_children_loaded`` so the once-only contract
+        survives failover (the flag rides the same INODE_DIRECTORY
+        upsert entries create_file journals for implicit parents)."""
+        with self.inode_tree.lock.write_locked():
+            lookup = self.inode_tree.lookup(uri)
+            if not lookup.exists or not lookup.inode.is_directory or \
+                    lookup.inode.direct_children_loaded:
+                return
+            with self._journal.create_context() as ctx:
+                ctx.append(EntryType.UPDATE_INODE,
+                           {"id": lookup.inode.id,
+                            "direct_children_loaded": True})
 
     # --------------------------------------------------------------- TTL
     def check_ttl_expired(self) -> List[str]:

@@ -101,6 +101,12 @@ class MountTable:
         with self._lock:
             return uri.path in self._mounts
 
+    def is_mount_path(self, path: str) -> bool:
+        """``is_mount_point`` for a plain path string (hot listing loop:
+        no AlluxioURI construction per child)."""
+        with self._lock:
+            return path in self._mounts
+
     def contains_mount_below(self, uri: AlluxioURI) -> bool:
         """True if any mount point (other than at uri) is nested under uri."""
         with self._lock:

@@ -124,8 +124,9 @@ class MasterProcess:
         self.config_checker = ConfigurationChecker()
         self.config_checker.register(
             "master", {k: str(v) for k, v in conf.to_map().items()})
-        self._root_ufs_uri = root_ufs_uri or conf.get(Keys.HOME) + \
-            "/underFSStorage"
+        self._root_ufs_uri = root_ufs_uri or \
+            conf.get(Keys.MASTER_MOUNT_TABLE_ROOT_UFS) or \
+            conf.get(Keys.HOME) + "/underFSStorage"
         self.rpc_server: Optional[RpcServer] = None
         self.web_server = None
         self.web_port: Optional[int] = None
@@ -198,6 +199,19 @@ class MasterProcess:
             permission_checker=self.permission_checker,
             metrics_master=self.metrics_master))
         self.rpc_port = self.rpc_server.start()
+        if self._conf.get_bool(Keys.MASTER_FASTPATH_ENABLED):
+            from alluxio_tpu.rpc.fastpath import (
+                FastPathServer, socket_path_for,
+            )
+
+            self.fastpath_server = FastPathServer(
+                socket_path_for(
+                    f"localhost:{self.rpc_port}",
+                    self._conf.get(Keys.MASTER_FASTPATH_DIR)),
+                authenticator=authenticator)
+            for svc in self.rpc_server._services.values():
+                self.fastpath_server.add_service(svc)
+            self.fastpath_server.start()
         if self._conf.get_bool(Keys.MASTER_WEB_ENABLED):
             from alluxio_tpu.master.web import MasterWebServer
 
@@ -297,6 +311,8 @@ class MasterProcess:
             t.stop()
         if getattr(self, "web_server", None) is not None:
             self.web_server.stop()
+        if getattr(self, "fastpath_server", None) is not None:
+            self.fastpath_server.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
         if getattr(self, "audit_writer", None) is not None:
@@ -412,6 +428,11 @@ class FaultTolerantMasterProcess(MasterProcess):
                 for t in self._threads:
                     t.stop()
                 self._threads = []
+                if getattr(self, "fastpath_server", None) is not None:
+                    # a deposed master must not keep serving local
+                    # clients over the Unix socket either
+                    self.fastpath_server.stop()
+                    self.fastpath_server = None
                 if self.rpc_server is not None:
                     self.rpc_server.stop()
                     self.rpc_server = None

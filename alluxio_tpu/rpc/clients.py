@@ -33,9 +33,28 @@ class _BaseClient:
 
     def __init__(self, address: str, *, retry_duration_s: float = 30.0,
                  base_sleep_s: float = 0.05, max_sleep_s: float = 3.0,
-                 metadata=None) -> None:
-        self._channels = [RpcChannel(a.strip(), metadata=metadata)
-                          for a in str(address).split(",") if a.strip()]
+                 metadata=None, fastpath: bool = True,
+                 fastpath_dir: Optional[str] = None) -> None:
+        """``fastpath_dir``: where master fastpath sockets live; pass the
+        ``atpu.master.fastpath.dir`` property when a Configuration is at
+        hand (FileSystem does) — otherwise the env override or /tmp."""
+        import os as _os
+
+        from alluxio_tpu.rpc.fastpath import HybridChannel
+
+        use_fast = fastpath and not _os.environ.get("ATPU_FASTPATH_DISABLE")
+        fast_dir = fastpath_dir or \
+            _os.environ.get("ATPU_MASTER_FASTPATH_DIR", "/tmp")
+        self._channels = []
+        for a in str(address).split(","):
+            if not a.strip():
+                continue
+            ch = RpcChannel(a.strip(), metadata=metadata)
+            if use_fast:
+                # probes <dir>/atpu-master-<port>.sock; silently stays
+                # pure-gRPC when the master is remote or fastpath is off
+                ch = HybridChannel(ch, fastpath_dir=fast_dir)
+            self._channels.append(ch)
         self._active = 0
         self._retry_duration_s = retry_duration_s
         self._base_sleep_s = base_sleep_s

@@ -24,12 +24,22 @@ BLOCK_SERVICE = "atpu.BlockMaster"
 META_SERVICE = "atpu.MetaMaster"
 
 
-def _timed(name: str, fn):
-    m = metrics()
+def _timed(name: str, fn, journal=None):
+    """Per-RPC timing + (when a journal is given) deferred durability:
+    every journal context the handler opens applies state immediately
+    but fsyncs ONCE here, after all master locks are released — one
+    group-committed flush per mutating RPC instead of one per context
+    (reference: RpcUtils wrappers + AsyncJournalWriter)."""
+    timer = metrics().timer(f"Master.rpc.{name}")  # resolve once
 
-    def wrapper(req):
-        with m.timer(f"Master.rpc.{name}").time():
-            return fn(req)
+    if journal is None:
+        def wrapper(req):
+            with timer.time():
+                return fn(req)
+    else:
+        def wrapper(req):
+            with timer.time(), journal.deferred_durability():
+                return fn(req)
 
     return wrapper
 
@@ -40,7 +50,7 @@ def fs_master_service(fsm: FileSystemMaster,
     svc = ServiceDefinition(FS_SERVICE)
 
     def u(name, fn):
-        timed = _timed(name, fn)
+        timed = _timed(name, fn, journal=fsm._journal)
         if audit_writer is None:
             svc.unary(name, timed)
             return
@@ -86,10 +96,9 @@ def fs_master_service(fsm: FileSystemMaster,
     u("get_status", lambda r: fsm.get_status(
         r["path"], sync_interval_ms=r.get("sync_interval_ms", -1)).to_wire())
     u("exists", lambda r: {"exists": fsm.exists(r["path"])})
-    u("list_status", lambda r: {"infos": [
-        i.to_wire() for i in fsm.list_status(
-            r["path"], recursive=r.get("recursive", False),
-            sync_interval_ms=r.get("sync_interval_ms", -1))]})
+    u("list_status", lambda r: {"infos": fsm.list_status(
+        r["path"], recursive=r.get("recursive", False),
+        sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)})
     u("create_file", lambda r: fsm.create_file(
         r["path"], block_size_bytes=r.get("block_size_bytes"),
         recursive=r.get("recursive", True), ttl=r.get("ttl", -1),
@@ -157,7 +166,7 @@ def block_master_service(bm: BlockMaster) -> ServiceDefinition:
     svc = ServiceDefinition(BLOCK_SERVICE)
 
     def u(name, fn):
-        svc.unary(name, _timed(name, fn))
+        svc.unary(name, _timed(name, fn, journal=bm._journal))
 
     u("get_worker_id", lambda r: {"worker_id": bm.get_worker_id(
         WorkerNetAddress.from_wire(r["address"]))})

@@ -33,7 +33,8 @@ def table_master_service(table_master,
     def _attach(r):
         _require_admin()
         return {"db": table_master.attach_database(
-            r["udb_type"], r["connection"], r.get("db_name", ""))}
+            r["udb_type"], r["connection"], r.get("db_name", ""),
+            options=r.get("options") or {})}
 
     def _detach(r):
         _require_admin()
@@ -84,10 +85,10 @@ class TableMasterClient:
             ExponentialTimeBoundedRetry(self._retry_duration_s, 0.05, 3.0))
 
     def attach_database(self, udb_type: str, connection: str,
-                        db_name: str = "") -> str:
+                        db_name: str = "", options: dict = None) -> str:
         return self._call("attach_database", {
             "udb_type": udb_type, "connection": connection,
-            "db_name": db_name})["db"]
+            "db_name": db_name, "options": options or {}})["db"]
 
     def detach_database(self, db: str) -> None:
         self._call("detach_database", {"db": db})

@@ -81,43 +81,72 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+SUITE = (
+    ("worker-sequential", ["worker", "--mode", "sequential",
+                           "--threads", "4", "--duration", "5"]),
+    ("worker-random-4k", ["worker", "--mode", "random",
+                          "--threads", "8", "--duration", "5"]),
+    ("master-CreateFile", ["master", "--op", "CreateFile",
+                           "--threads", "8", "--duration", "5"]),
+    ("master-GetStatus", ["master", "--op", "GetStatus",
+                          "--threads", "8", "--duration", "5"]),
+    ("master-ListStatus", ["master", "--op", "ListStatus", "--threads",
+                           "8", "--duration", "5",
+                           "--fixed-count", "100"]),
+    ("master-DeleteFile", ["master", "--op", "DeleteFile", "--threads",
+                           "8", "--duration", "5",
+                           "--fixed-count", "2000"]),
+    ("prefetch", ["prefetch", "--num-workers", "4", "--num-files", "8",
+                  "--file-mb", "16"]),
+    ("table-projection", ["table"]),
+    ("write-eviction", ["write"]),
+)
+
+
 def run_suite() -> list:
-    """The five BASELINE configs + a master-op sample, sized to finish in
-    a few minutes in-process. Returns the list of BenchResults."""
-    from alluxio_tpu.stress import (
-        master_bench, prefetch_bench, table_bench, worker_bench,
-        write_bench,
-    )
+    """The five BASELINE configs + master-op samples, each in its OWN
+    subprocess: a bench must not inherit the previous one's page-cache
+    pressure, lingering cluster threads or fragmented heap (sequential
+    in-process runs measured 2-4x slower than isolated ones for the
+    later benches). Returns the list of BenchResults."""
+    import os
+    import subprocess
+    import time
 
+    from alluxio_tpu.stress.base import BenchResult
+
+    env = dict(os.environ)
+    # accelerator plugin adds ~2.4s boot + a PJRT init to every child;
+    # the stress suite is host-side
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
     results = []
-    for name, fn in (
-        ("worker-sequential", lambda: worker_bench.run(
-            mode="sequential", threads=4, duration_s=5.0)),
-        ("worker-random-4k", lambda: worker_bench.run(
-            mode="random", threads=8, duration_s=5.0)),
-        ("master-CreateFile", lambda: master_bench.run(
-            op="CreateFile", threads=8, duration_s=5.0)),
-        ("master-GetStatus", lambda: master_bench.run(
-            op="GetStatus", threads=8, duration_s=5.0)),
-        ("master-ListStatus", lambda: master_bench.run(
-            op="ListStatus", threads=8, duration_s=5.0, fixed_count=100)),
-        ("master-DeleteFile", lambda: master_bench.run(
-            op="DeleteFile", threads=8, duration_s=5.0, fixed_count=2000)),
-        ("prefetch", lambda: prefetch_bench.run(
-            num_workers=4, num_files=8, file_bytes=16 << 20)),
-        ("table-projection", lambda: table_bench.run()),
-        ("write-eviction", lambda: write_bench.run()),
-    ):
+    for name, argv in SUITE:
         print(f"[suite] running {name} ...", file=sys.stderr, flush=True)
+        proc = None
         try:
-            r = fn()
+            if results:
+                # let the previous bench's teardown IO (tmpdir deletion,
+                # page-cache writeback) drain — it measured 2-3x into
+                # the next bench's tail latencies on a 1-core host
+                os.sync()
+                time.sleep(4)
+            proc = subprocess.run(
+                [sys.executable, "-m", "alluxio_tpu.stress", *argv],
+                capture_output=True, text=True, timeout=600, env=env)
+            line = (proc.stdout or "").strip().splitlines()[-1]
+            d = json.loads(line)
+            r = BenchResult(bench=d["bench"], params=d["params"],
+                            metrics=d["metrics"], errors=d["errors"],
+                            duration_s=d["duration_s"])
         except Exception as e:  # noqa: BLE001 — record and continue
-            from alluxio_tpu.stress.base import BenchResult
-
             r = BenchResult(bench=name, params={}, metrics={},
                             errors=1, duration_s=0.0)
             r.metrics["error"] = f"{type(e).__name__}: {e}"
-            print(f"[suite] {name} FAILED: {e}", file=sys.stderr)
+            tail = ""
+            if proc is not None and getattr(proc, "stderr", None):
+                tail = proc.stderr[-300:]
+            print(f"[suite] {name} FAILED: {e} {tail}", file=sys.stderr)
         print(r.json_line(), flush=True)
         results.append(r)
     return results

@@ -89,6 +89,52 @@ class TestOnAccessSync:
         cluster.fs_client().sync_metadata("/mut.txt")
         assert fs.read_all("/mut.txt") == b"version-2-different"
 
+    def test_children_loaded_once_then_cached(self, cluster):
+        """direct_children_loaded semantics: the first listing loads UFS
+        children; later out-of-band UFS files do NOT appear on plain
+        listings (metadata is cached, reference listStatus semantics)…"""
+        fs = cluster.file_system()
+        root = _root_ufs_dir(cluster)
+        os.makedirs(os.path.join(root, "dcl"))
+        with open(os.path.join(root, "dcl", "a.bin"), "wb") as f:
+            f.write(b"a")
+        assert {i.name for i in fs.list_status("/dcl")} == {"a.bin"}
+        with open(os.path.join(root, "dcl", "b.bin"), "wb") as f:
+            f.write(b"b")
+        assert {i.name for i in fs.list_status("/dcl")} == {"a.bin"}
+
+    def test_sync_interval_zero_forces_child_relist(self, cluster):
+        """…but sync_interval_ms=0 must re-list past the flag (the
+        documented escape hatch — regression for the round-4 review
+        finding where the flag hid new UFS files forever)."""
+        fs = cluster.file_system()
+        root = _root_ufs_dir(cluster)
+        os.makedirs(os.path.join(root, "dcl2"))
+        with open(os.path.join(root, "dcl2", "a.bin"), "wb") as f:
+            f.write(b"a")
+        assert {i.name for i in fs.list_status("/dcl2")} == {"a.bin"}
+        with open(os.path.join(root, "dcl2", "b.bin"), "wb") as f:
+            f.write(b"b")
+        names = {i.name for i in fs.fs_master.list_status(
+            "/dcl2", sync_interval_ms=0)}
+        assert names == {"a.bin", "b.bin"}
+
+    def test_unlistable_dir_does_not_latch_loaded_flag(self, cluster):
+        """A None UFS listing (dir missing) must not journal the
+        once-only flag: when the dir reappears with content, listings
+        see it."""
+        import shutil
+
+        fs = cluster.file_system()
+        root = _root_ufs_dir(cluster)
+        fs.create_directory("/latch")  # namespace-only at first
+        assert fs.list_status("/latch") == []
+        # now the UFS dir appears out-of-band with a child
+        os.makedirs(os.path.join(root, "latch"), exist_ok=True)
+        with open(os.path.join(root, "latch", "late.bin"), "wb") as f:
+            f.write(b"late")
+        assert {i.name for i in fs.list_status("/latch")} == {"late.bin"}
+
     def test_recursive_sync_loads_subtree(self, cluster):
         fs = cluster.file_system()
         root = _root_ufs_dir(cluster)

@@ -20,15 +20,26 @@ class AttachDbCommand(Command):
                    "(e.g. attachdb fs /warehouse/sales).")
 
     def configure(self, p):
-        p.add_argument("udb_type", help="under-database type (e.g. 'fs')")
+        p.add_argument("udb_type",
+                       help="under-database type ('fs' or 'hive')")
         p.add_argument("connection",
-                       help="UDB connection (namespace path for 'fs')")
+                       help="UDB connection (namespace path for 'fs', "
+                            "thrift://host:port for 'hive')")
         p.add_argument("--db", default="",
-                       help="catalog database name (default: derived)")
+                       help="catalog database name (default: derived; "
+                            "required for hive)")
+        p.add_argument("-o", "--option", action="append", default=[],
+                       metavar="K=V",
+                       help="UDB option (e.g. "
+                            "path_translations=hdfs://nn/w=/mnt/w)")
 
     def run(self, args, ctx):
+        options = {}
+        for kv in args.option:
+            k, _, v = kv.partition("=")
+            options[k] = v
         name = ctx.table_client().attach_database(
-            args.udb_type, args.connection, args.db)
+            args.udb_type, args.connection, args.db, options=options)
         ctx.print(f"Attached database {name}")
         return 0
 

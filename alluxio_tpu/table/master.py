@@ -58,9 +58,10 @@ class TableMaster(Journaled):
 
     # -- API: databases ------------------------------------------------------
     def attach_database(self, udb_type: str, connection: str,
-                        db_name: str = "") -> str:
+                        db_name: str = "",
+                        options: Optional[Dict[str, str]] = None) -> str:
         udb = udb_factory(udb_type, self._file_system(), connection,
-                          db_name)
+                          db_name, options)
         name = udb.database_name()
         with self._mutate_lock:
             with self._lock:
@@ -69,7 +70,8 @@ class TableMaster(Journaled):
             tables = [udb.get_table(t) for t in udb.table_names()]
             with self._journal.create_context() as ctx:
                 ctx.append(EntryType.ATTACH_DB, {
-                    "db": name, "type": udb_type, "connection": connection})
+                    "db": name, "type": udb_type, "connection": connection,
+                    "options": dict(options or {})})
                 for t in tables:
                     ctx.append(EntryType.ADD_TABLE,
                                {"db": name, "table": t.to_wire()})
@@ -95,9 +97,10 @@ class TableMaster(Journaled):
                     raise NotFoundError(
                         f"database {db_name} is not attached")
                 udb_type, connection = db["type"], db["connection"]
+                options = db.get("options") or {}
                 known = set(db["tables"])
             udb = udb_factory(udb_type, self._file_system(), connection,
-                              db_name)
+                              db_name, options)
             tables = [udb.get_table(t) for t in udb.table_names()]
             dropped = known - {t.name for t in tables}
             with self._journal.create_context() as ctx:
@@ -223,6 +226,7 @@ class TableMaster(Journaled):
             with self._lock:
                 self._dbs[p["db"]] = {"type": p["type"],
                                       "connection": p["connection"],
+                                      "options": dict(p.get("options", {})),
                                       "tables": {}}
             return True
         if t == EntryType.DETACH_DB:

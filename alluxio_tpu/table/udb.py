@@ -143,10 +143,15 @@ class FsUnderDatabase(UnderDatabase):
                 for f in pf.schema_arrow]
 
 
-def udb_factory(udb_type: str, fs, connection: str,
-                db_name: str = "") -> UnderDatabase:
+def udb_factory(udb_type: str, fs, connection: str, db_name: str = "",
+                options: Optional[Dict[str, str]] = None) -> UnderDatabase:
     """Registry keyed by udb type (reference: ServiceLoader discovery)."""
     if udb_type == "fs":
         return FsUnderDatabase(fs, connection, db_name)
+    if udb_type == "hive":
+        from alluxio_tpu.table.hive import HiveUnderDatabase
+
+        return HiveUnderDatabase(fs, connection, db_name, options)
     raise NotFoundError(
-        f"unknown under-database type {udb_type!r} (available: fs)")
+        f"unknown under-database type {udb_type!r} "
+        f"(available: fs, hive)")

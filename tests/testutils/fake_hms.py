@@ -1,0 +1,175 @@
+"""In-process fake Hive metastore: TBinaryProtocol over a TCP socket,
+serving the read-side HMS subset (get_all_databases / get_database /
+get_all_tables / get_table / get_partitions) from an in-memory catalog.
+
+Server-side encoding is written independently from the client in
+``table/thrift_proto.py`` only in the sense that the STRUCT LAYOUTS are
+spelled out by field id here (Table id 1/7/8, StorageDescriptor 1/2,
+FieldSchema 1/2, Partition 1/6 — hive_metastore.thrift), so a drifting
+client decode shows up as wrong values, not silent agreement."""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Dict, List
+
+from alluxio_tpu.table.thrift_proto import (
+    EXCEPTION, I32, LIST, REPLY, STRING, STRUCT, Reader, ThriftError,
+    Writer,
+)
+
+
+class HmsTable:
+    def __init__(self, name: str, location: str,
+                 cols: List[tuple], partition_keys: List[str] = (),
+                 partitions: Dict[str, str] = None) -> None:
+        """``cols``: [(name, hive_type)]; ``partitions``:
+        {"k=v/k2=v2": location}."""
+        self.name = name
+        self.location = location
+        self.cols = list(cols)
+        self.partition_keys = list(partition_keys)
+        self.partitions = dict(partitions or {})
+
+
+class FakeHmsState:
+    def __init__(self) -> None:
+        #: db -> {table-name: HmsTable}
+        self.dbs: Dict[str, Dict[str, HmsTable]] = {}
+        self.calls: List[str] = []
+
+
+def _field_schema(name: str, typ: str):
+    return [(1, STRING, name), (2, STRING, typ)]
+
+
+def _sd(cols: List[tuple], location: str):
+    return [
+        (1, LIST, (STRUCT, [_field_schema(n, t) for n, t in cols])),
+        (2, STRING, location),
+    ]
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    state: FakeHmsState = None
+
+    def _reply(self, name: str, seqid: int, result_fields) -> None:
+        w = Writer().message(name, REPLY, seqid)
+        w.write_value(STRUCT, result_fields)
+        self.request.sendall(w.data())
+
+    def _exception(self, name: str, seqid: int, msg: str) -> None:
+        w = Writer().message(name, EXCEPTION, seqid)
+        w.write_value(STRUCT, [(1, STRING, msg), (2, I32, 1)])
+        self.request.sendall(w.data())
+
+    def handle(self) -> None:
+        buf = b""
+        while True:
+            # accumulate until one full message decodes
+            while True:
+                try:
+                    r = Reader(buf)
+                    r.message()
+                    r.struct()
+                    break
+                except ThriftError:
+                    try:
+                        chunk = self.request.recv(1 << 16)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+            r = Reader(buf)
+            buf = b""
+            name, _mtype, seqid = r.message()
+            args = r.struct()
+            self.state.calls.append(name)
+            try:
+                self._dispatch(name, seqid, args)
+            except BrokenPipeError:
+                return
+
+    def _dispatch(self, name: str, seqid: int, args: dict) -> None:
+        st = self.state
+        if name == "get_all_databases":
+            self._reply(name, seqid,
+                        [(0, LIST, (STRING, sorted(st.dbs)))])
+        elif name == "get_database":
+            db = args.get(1, "")
+            if db not in st.dbs:
+                self._reply(name, seqid, [(1, STRUCT, [
+                    (1, STRING, f"database {db} not found")])])
+                return
+            self._reply(name, seqid, [(0, STRUCT, [
+                (1, STRING, db), (2, STRING, "fake db"),
+                (3, STRING, f"hdfs://fake/warehouse/{db}.db")])])
+        elif name == "get_all_tables":
+            db = args.get(1, "")
+            self._reply(name, seqid, [(0, LIST, (
+                STRING, sorted(st.dbs.get(db, {}))))])
+        elif name == "get_table":
+            db, tbl = args.get(1, ""), args.get(2, "")
+            t = st.dbs.get(db, {}).get(tbl)
+            if t is None:
+                self._reply(name, seqid, [(1, STRUCT, [
+                    (1, STRING, f"table {db}.{tbl} not found")])])
+                return
+            self._reply(name, seqid, [(0, STRUCT, [
+                (1, STRING, t.name), (2, STRING, db),
+                (7, STRUCT, _sd(t.cols, t.location)),
+                (8, LIST, (STRUCT, [_field_schema(k, "string")
+                                    for k in t.partition_keys])),
+                (12, STRING, "EXTERNAL_TABLE"),
+            ])])
+        elif name == "get_partitions":
+            db, tbl = args.get(1, ""), args.get(2, "")
+            t = st.dbs.get(db, {}).get(tbl)
+            parts = []
+            if t is not None:
+                for spec, loc in sorted(t.partitions.items()):
+                    values = [kv.partition("=")[2]
+                              for kv in spec.split("/") if kv]
+                    parts.append([
+                        (1, LIST, (STRING, values)),
+                        (2, STRING, db), (3, STRING, tbl),
+                        (6, STRUCT, _sd(t.cols, loc)),
+                    ])
+            self._reply(name, seqid, [(0, LIST, (STRUCT, parts))])
+        else:
+            self._exception(name, seqid, f"unknown method {name}")
+
+
+class FakeHmsServer:
+    """``with FakeHmsServer() as hms: hms.uri`` -> ``thrift://...``."""
+
+    def __init__(self) -> None:
+        self.state = FakeHmsState()
+
+        class H(_Handler):
+            state = self.state
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._httpd = Server(("127.0.0.1", 0), H)
+        self.port = self._httpd.server_address[1]
+        self.uri = f"thrift://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def add_table(self, db: str, table: HmsTable) -> None:
+        self.state.dbs.setdefault(db, {})[table.name] = table
+
+    def __enter__(self) -> "FakeHmsServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        return False

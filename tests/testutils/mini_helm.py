@@ -1,0 +1,183 @@
+"""A tiny Go-template renderer covering exactly the constructs the
+in-tree helm chart uses, so CI can validate `helm template`-equivalent
+rendering on a box without helm. Supported: ``{{ .Release.Name }}``,
+``{{ .Values.a.b }}``, ``{{- if EXPR }} / {{- else }} / {{- end }}``,
+``{{- range $k, $v := .Values.map }}``, and the functions ``int``,
+``gt``. Anything else in a template raises — the chart must stay inside
+this subset or grow the renderer."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Tuple
+
+_TOKEN = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _lookup(ctx: Dict[str, Any], dotted: str) -> Any:
+    cur: Any = ctx
+    for part in dotted.lstrip(".").split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+        if cur is None:
+            return None
+    return cur
+
+
+def _eval(expr: str, ctx: Dict[str, Any]) -> Any:
+    expr = expr.strip()
+    if expr.startswith("(") and expr.endswith(")"):
+        return _eval(expr[1:-1], ctx)
+    # function calls: int X / gt A B  (args may be parenthesized)
+    m = re.match(r"^(int|gt)\s+(.*)$", expr)
+    if m:
+        fn, rest = m.group(1), m.group(2)
+        args = _split_args(rest)
+        vals = [_eval(a, ctx) for a in args]
+        if fn == "int":
+            return int(vals[0] or 0)
+        if fn == "gt":
+            return vals[0] > vals[1]
+    if expr.startswith(".") or expr.startswith("$"):
+        if expr.startswith("$"):
+            return ctx.get(expr)
+        return _lookup(ctx, expr)
+    if re.match(r"^-?\d+$", expr):
+        return int(expr)
+    if expr.startswith('"') and expr.endswith('"'):
+        return expr[1:-1]
+    raise ValueError(f"mini_helm cannot evaluate {expr!r}")
+
+
+def _split_args(s: str) -> List[str]:
+    args, depth, cur = [], 0, ""
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == " " and depth == 0:
+            if cur:
+                args.append(cur)
+                cur = ""
+        else:
+            cur += ch
+    if cur:
+        args.append(cur)
+    return args
+
+
+def _tokenize(src: str) -> List[Tuple[str, str]]:
+    """-> [(kind, payload)]: kind in text|if|else|end|range|expr."""
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    for m in _TOKEN.finditer(src):
+        text = src[pos:m.start()]
+        # {{- trims preceding whitespace INCLUDING the newline
+        if src[m.start():m.start() + 3] == "{{-":
+            text = text.rstrip(" \t")
+            if text.endswith("\n"):
+                text = text[:-1]
+        out.append(("text", text))
+        body = m.group(1)
+        if body.startswith("if "):
+            out.append(("if", body[3:]))
+        elif body == "else":
+            out.append(("else", ""))
+        elif body == "end":
+            out.append(("end", ""))
+        elif body.startswith("range "):
+            out.append(("range", body[6:]))
+        else:
+            out.append(("expr", body))
+        pos = m.end()
+        if m.group(0).endswith("-}}"):
+            while pos < len(src) and src[pos] in " \t\n":
+                pos += 1
+    out.append(("text", src[pos:]))
+    return out
+
+
+def _render_block(tokens: List[Tuple[str, str]], i: int,
+                  ctx: Dict[str, Any], out: List[str],
+                  emit: bool) -> int:
+    """Render until a matching else/end; returns index of that token."""
+    while i < len(tokens):
+        kind, payload = tokens[i]
+        if kind == "text":
+            if emit:
+                out.append(payload)
+            i += 1
+        elif kind == "expr":
+            if emit:
+                v = _eval(payload, ctx)
+                out.append("" if v is None else
+                           ("true" if v is True else
+                            "false" if v is False else str(v)))
+            i += 1
+        elif kind == "if":
+            cond = bool(_eval(payload, ctx)) if emit else False
+            j = _render_block(tokens, i + 1, ctx, out, emit and cond)
+            if j < len(tokens) and tokens[j][0] == "else":
+                j = _render_block(tokens, j + 1, ctx, out,
+                                  emit and not cond)
+            i = j + 1  # skip the end
+        elif kind == "range":
+            m = re.match(r"^\$(\w+),\s*\$(\w+)\s*:=\s*(.+)$", payload)
+            if not m:
+                raise ValueError(f"mini_helm range: {payload!r}")
+            kvar, vvar, coll_expr = m.groups()
+            coll = _eval(coll_expr, ctx) or {}
+            # find the end without emitting
+            j = _render_block(tokens, i + 1, ctx, [], False)
+            if emit:
+                for k in sorted(coll):
+                    sub = dict(ctx)
+                    sub[f"${kvar}"], sub[f"${vvar}"] = k, coll[k]
+                    _render_block(tokens, i + 1, sub, out, True)
+            i = j + 1
+        elif kind in ("else", "end"):
+            return i
+        else:  # pragma: no cover
+            raise ValueError(kind)
+    return i
+
+
+def render(src: str, values: Dict[str, Any],
+           release_name: str = "release") -> str:
+    ctx = {"Values": values, "Release": {"Name": release_name}}
+    out: List[str] = []
+    _render_block(_tokenize(src), 0, ctx, out, True)
+    return "".join(out)
+
+
+def render_chart(chart_dir: str, values: Dict[str, Any] = None,
+                 release_name: str = "atpu") -> Dict[str, str]:
+    """Render every template with values.yaml merged under overrides;
+    returns {template-name: rendered-yaml}."""
+    import os
+
+    import yaml
+
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        base = yaml.safe_load(f) or {}
+
+    def merge(dst, src):
+        for k, v in (src or {}).items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+        return dst
+
+    vals = merge(base, values or {})
+    tdir = os.path.join(chart_dir, "templates")
+    out = {}
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            out[name] = render(f.read(), vals, release_name)
+    return out

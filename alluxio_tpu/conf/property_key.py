@@ -454,9 +454,17 @@ class Keys:
                            default="/tmp/alluxio_tpu/backups", scope=Scope.MASTER)
     MASTER_DAILY_BACKUP_ENABLED = _k("atpu.master.daily.backup.enabled",
                                      KeyType.BOOL, default=False, scope=Scope.MASTER)
-    MASTER_EMBEDDED_JOURNAL_ADDRESSES = _k(
-        "atpu.master.embedded.journal.addresses", KeyType.LIST, default=None,
-        scope=Scope.MASTER)
+    MASTER_DAILY_BACKUP_INTERVAL = _k(
+        "atpu.master.daily.backup.interval", KeyType.DURATION,
+        default="24h", scope=Scope.MASTER,
+        description="How often the scheduled-backup heartbeat lands a "
+                    "metadata backup (reference: DailyMetadataBackup's "
+                    "time-of-day schedule, interval-based here).")
+    MASTER_DAILY_BACKUP_RETENTION = _k(
+        "atpu.master.daily.backup.retention", KeyType.INT, default=3,
+        scope=Scope.MASTER,
+        description="Scheduled backups kept after pruning (reference: "
+                    "alluxio.master.daily.backup.files.retained).")
     MASTER_METADATA_SYNC_EXECUTOR_POOL_SIZE = _k(
         "atpu.master.metadata.sync.executor.pool.size", KeyType.INT, default=8,
         scope=Scope.MASTER)

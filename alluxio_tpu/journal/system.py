@@ -491,6 +491,12 @@ class LocalJournalSystem(JournalSystem):
         stamp = time.strftime("%Y%m%d-%H%M%S")
         path = os.path.join(backup_dir,
                             f"atpu-backup-{stamp}-{snap['sequence']}.bak")
+        n = 1
+        while os.path.exists(path):  # same second + sequence: uniquify
+            path = os.path.join(
+                backup_dir,
+                f"atpu-backup-{stamp}-{snap['sequence']}.{n}.bak")
+            n += 1
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(snap, use_bin_type=True))

@@ -255,6 +255,21 @@ class MasterProcess:
                 _Exec(self.ufs_cleaner.heartbeat),
                 conf.get_duration_s(Keys.MASTER_UFS_CLEANUP_INTERVAL)),
         ]
+        if conf.get_bool(Keys.MASTER_DAILY_BACKUP_ENABLED):
+            from alluxio_tpu.master.backup import ScheduledBackup
+
+            self.scheduled_backup = ScheduledBackup(
+                self.journal, conf.get(Keys.MASTER_BACKUP_DIR),
+                interval_s=conf.get_duration_s(
+                    Keys.MASTER_DAILY_BACKUP_INTERVAL),
+                retention=conf.get_int(Keys.MASTER_DAILY_BACKUP_RETENTION))
+            # ticked well under the backup interval so a missed beat
+            # only delays, never skips, a due backup
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.MASTER_DAILY_BACKUP,
+                _Exec(self.scheduled_backup.heartbeat),
+                min(60.0, conf.get_duration_s(
+                    Keys.MASTER_DAILY_BACKUP_INTERVAL))))
         from alluxio_tpu.metrics import metrics as _metrics
         from alluxio_tpu.metrics.sinks import SinkManager
 

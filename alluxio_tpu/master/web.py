@@ -10,7 +10,14 @@ Routes:
   GET /api/v1/master/metrics   flat metrics snapshot (JSON)
   GET /api/v1/master/mounts    mount table
   GET /api/v1/master/catalog   table-service databases/tables
+  GET /api/v1/master/browse    ?path= namespace listing w/ tier residency
+  GET /api/v1/master/config    effective configuration + value sources
+  GET /api/v1/master/logs      ?n=&level= recent log records (in-process
+                               ring; the logserver holds the full stream)
   GET /metrics                 Prometheus text exposition
+  GET /browse /config /logs    HTML pages over the routes above
+                               (reference: webui/master's browse/config/
+                               logs SPA pages)
 """
 
 from __future__ import annotations
@@ -34,7 +41,8 @@ def _dashboard_html() -> bytes:
         sections=[("Cluster", "info"), ("Workers", "workers"),
                   ("Mounts", "mounts"), ("Catalog", "catalog")],
         raw_routes=["/api/v1/master/info", "/capacity", "/metrics",
-                    "/mounts", "/catalog", "/trace"],
+                    "/mounts", "/catalog", "/trace",
+                    "/browse", "/config", "/logs"],
         js_body="""
     const info = await j('/info');
     const t = document.getElementById('info');
@@ -60,6 +68,78 @@ def _dashboard_html() -> bytes:
 """)
 
 
+def _page_html(page: str) -> bytes:
+    """The browse/config/logs pages (reference: ``webui/master``'s
+    Browse / Configuration / Logs SPA pages, as self-contained HTML
+    over the JSON routes)."""
+    from alluxio_tpu.utils.statuspage import render
+
+    if page == "browse":
+        return render(
+            "alluxio-tpu browse", "/api/v1/master",
+            sections=[("Namespace", "listing")],
+            raw_routes=["/api/v1/master/browse?path=/"],
+            js_body="""
+    const params = new URLSearchParams(location.search);
+    const path = params.get('path') || '/';
+    const d = await j('/browse?path=' + encodeURIComponent(path));
+    const t = document.getElementById('listing');
+    const h = document.createElement('h3');
+    // textContent only: ?path= is attacker-controlled (reflected XSS
+    // via innerHTML otherwise)
+    h.textContent = 'path: ' + path + (path === '/' ? '' : ' — ');
+    if (path !== '/') {
+      const parent = path.slice(0, path.lastIndexOf('/')) || '/';
+      const up = document.createElement('a');
+      up.href = '/browse?path=' + encodeURIComponent(parent);
+      up.textContent = 'up';
+      h.appendChild(up);
+    }
+    t.before(h);
+    row(t, ['name','size','in-mem %','persistence','mode','owner',
+            'blocks'], true);
+    for (const e of d.entries) {
+      const tr = row(t, ['', String(e.length), e.folder ? '-' :
+                         String(e.in_memory_percentage),
+                         e.persistence_state, e.mode, e.owner,
+                         String(e.block_count)]);
+      const cell = tr.cells[0];
+      if (e.folder) {
+        const a = document.createElement('a');
+        a.href = '/browse?path=' + encodeURIComponent(e.path);
+        a.textContent = e.name + '/';
+        cell.appendChild(a);
+      } else cell.textContent = e.name;
+    }
+""")
+    if page == "config":
+        return render(
+            "alluxio-tpu configuration", "/api/v1/master",
+            sections=[("Effective configuration", "conf")],
+            raw_routes=["/api/v1/master/config"],
+            js_body="""
+    const d = await j('/config');
+    const t = document.getElementById('conf');
+    row(t, ['property','value','source'], true);
+    for (const [k, v] of Object.entries(d.config))
+      row(t, [k, v.value, v.source]);
+""")
+    return render(
+        "alluxio-tpu logs", "/api/v1/master",
+        sections=[("Recent log records", "logs")],
+        raw_routes=["/api/v1/master/logs?n=200&level=WARNING"],
+        js_body="""
+    const params = new URLSearchParams(location.search);
+    const d = await j('/logs?n=' + (params.get('n') || 200) +
+                      '&level=' + (params.get('level') || ''));
+    const t = document.getElementById('logs');
+    row(t, ['time','level','logger','message'], true);
+    for (const r of d.records.reverse())
+      row(t, [new Date(r.ts_ms).toISOString(), r.level, r.logger,
+              r.message]);
+""")
+
+
 class MasterWebServer:
     def __init__(self, master_process, port: int = 0,
                  bind_host: str = "0.0.0.0") -> None:
@@ -72,9 +152,18 @@ class MasterWebServer:
 
             def do_GET(self):  # noqa: N802 (stdlib API)
                 try:
-                    route = self.path.split("?", 1)[0].rstrip("/")
+                    from urllib.parse import parse_qs, urlsplit
+
+                    parts = urlsplit(self.path)
+                    route = parts.path.rstrip("/")
+                    self.query = {k: v[0] for k, v in
+                                  parse_qs(parts.query).items()}
                     if route == "":
                         self._send(200, _dashboard_html(),
+                                   "text/html; charset=utf-8")
+                        return
+                    if route in ("/browse", "/config", "/logs"):
+                        self._send(200, _page_html(route[1:]),
                                    "text/html; charset=utf-8")
                         return
                     if route == "/metrics":
@@ -157,6 +246,40 @@ class MasterWebServer:
 
                     return {"enabled": tracer().enabled,
                             "spans": tracer().snapshot()}
+                if route == "/api/v1/master/browse":
+                    path = self.query.get("path", "/") or "/"
+                    entries = mp.fs_master.list_status(path, wire=True)
+                    return {"path": path, "entries": [{
+                        "name": e["name"], "path": e["path"],
+                        "folder": e["folder"], "length": e["length"],
+                        "in_memory_percentage":
+                            e["in_memory_percentage"],
+                        "persistence_state": e["persistence_state"],
+                        "pinned": e["pinned"], "owner": e["owner"],
+                        "group": e["group"], "mode": oct(e["mode"]),
+                        "block_count": len(e["block_ids"]),
+                    } for e in entries]}
+                if route == "/api/v1/master/config":
+                    from alluxio_tpu.conf.property_key import REGISTRY
+
+                    conf = mp._conf
+                    # EFFECTIVE configuration: every registered key with
+                    # its default, overlaid by whatever is actually set
+                    # (reference: the webui Configuration page shows the
+                    # full resolved table, not just overrides)
+                    out = {name: {"value": str(pk.default),
+                                  "source": "DEFAULT"}
+                           for name, pk in REGISTRY.all_keys().items()}
+                    for k, v in conf.to_map().items():
+                        out[k] = {"value": str(v),
+                                  "source": conf.source(k).name}
+                    return {"config": dict(sorted(out.items()))}
+                if route == "/api/v1/master/logs":
+                    from alluxio_tpu.utils import weblog
+
+                    n = int(self.query.get("n", "200") or 200)
+                    return {"records": weblog.tail(
+                        n, level=self.query.get("level", ""))}
                 return None
 
         self._server = ThreadingHTTPServer((bind_host, port), Handler)
@@ -165,6 +288,9 @@ class MasterWebServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> int:
+        from alluxio_tpu.utils import weblog
+
+        weblog.install()  # /logs serves this in-process ring
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         name="master-web", daemon=True)
         self._thread.start()

@@ -152,7 +152,12 @@ class FastPathServer:
                                            f"fastpath handler"}})
                             continue
                         try:
-                            result = fn(request or {})
+                            from alluxio_tpu.utils.tracing import tracer
+
+                            # span parity with the gRPC wrapper: admin
+                            # tracing must see fastpath RPCs too
+                            with tracer().span(f"{service}.{method}"):
+                                result = fn(request or {})
                             _send_frame(self.connection, {"ok": result})
                         except AlluxioTpuError as e:
                             _send_frame(self.connection,

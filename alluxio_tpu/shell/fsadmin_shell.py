@@ -222,15 +222,32 @@ class JournalCommand(Command):
     description = "Journal operations: checkpoint | dump."
 
     def configure(self, p):
-        p.add_argument("op", choices=["checkpoint", "dump", "quorum"])
+        p.add_argument("op", choices=["checkpoint", "dump", "quorum",
+                                      "migrate"])
         p.add_argument("--folder", default=None,
-                       help="journal dir for dump (default: configured)")
+                       help="journal dir for dump/migrate "
+                            "(default: configured)")
         p.add_argument("--start", type=int, default=0)
         p.add_argument("--end", type=int, default=None)
         p.add_argument("--transfer", default="",
                        help="quorum: hand leadership to this member id")
+        p.add_argument("--to", default="", choices=["", "EMBEDDED", "LOCAL"],
+                       help="migrate: target journal flavor (OFFLINE — "
+                            "stop every master first)")
+        p.add_argument("--dest", default="",
+                       help="migrate: destination journal folder "
+                            "(default: same folder)")
+        p.add_argument("--addresses", default="",
+                       help="migrate to EMBEDDED: quorum member "
+                            "addresses, comma separated (default: "
+                            "atpu.master.embedded.journal.addresses)")
+        p.add_argument("--member", default="",
+                       help="migrate to LOCAL: source quorum member id "
+                            "(default: the freshest)")
 
     def run(self, args, ctx):
+        if args.op == "migrate":
+            return self._migrate(args, ctx)
         if args.op == "checkpoint":
             ctx.meta_client().checkpoint()
             ctx.print("Successfully took a checkpoint on the primary master")
@@ -258,6 +275,46 @@ class JournalCommand(Command):
         n = dump_journal(folder, ctx.out, start_seq=args.start,
                          end_seq=args.end)
         ctx.print(f"({n} entries)")
+        return 0
+
+    def _migrate(self, args, ctx):
+        """Offline LOCAL/UFS <-> EMBEDDED conversion (reference:
+        ``JournalUpgrader.java:61`` + JournalMigrationIntegrationTest)."""
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.journal import migrate as mig
+
+        folder = args.folder or str(ctx.conf.get(
+            Keys.MASTER_JOURNAL_FOLDER))
+        dest = args.dest or folder
+        try:
+            if args.to == "EMBEDDED":
+                configured = ctx.conf.get(
+                    Keys.MASTER_EMBEDDED_JOURNAL_ADDRESSES) or ""
+                if isinstance(configured, (list, tuple)):
+                    configured = ",".join(configured)
+                addresses = [a.strip() for a in
+                             (args.addresses or str(configured)).split(",")
+                             if a.strip()]
+                out = mig.local_to_embedded(folder, dest, addresses)
+                ctx.print(
+                    f"migrated LOCAL journal {folder} -> EMBEDDED "
+                    f"{dest} ({len(out['members'])} members, checkpoint "
+                    f"seq {out['checkpoint_seq']}, {out['entries']} "
+                    f"tail entries)")
+            elif args.to == "LOCAL":
+                out = mig.embedded_to_local(folder, dest,
+                                            node_id=args.member)
+                ctx.print(
+                    f"migrated EMBEDDED member {out['source_member']} "
+                    f"-> LOCAL {dest} (checkpoint seq "
+                    f"{out['checkpoint_seq']}, {out['entries']} tail "
+                    f"entries)")
+            else:
+                ctx.print("journal migrate needs --to EMBEDDED|LOCAL")
+                return 1
+        except mig.MigrationError as e:
+            ctx.print(f"migration failed: {e}")
+            return 1
         return 0
 
 

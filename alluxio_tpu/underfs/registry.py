@@ -63,7 +63,9 @@ _OPTIONAL_CONNECTORS = (
     ("alluxio_tpu.underfs.s3_compat", "OssUnderFileSystem", None),
     ("alluxio_tpu.underfs.s3_compat", "CosUnderFileSystem", None),
     ("alluxio_tpu.underfs.s3_compat", "KodoUnderFileSystem", None),
-    ("alluxio_tpu.underfs.s3_compat", "SwiftUnderFileSystem", None),
+    # swift dispatches by dialect: Keystone-native when swift.auth.url
+    # is set, S3-middleware gateway otherwise (underfs/swift.py)
+    ("alluxio_tpu.underfs.swift", "create_swift_ufs", ("swift",)),
     ("alluxio_tpu.underfs.s3_compat", "ObsUnderFileSystem", None),
     ("alluxio_tpu.underfs.azure", "WasbUnderFileSystem", None),
     ("alluxio_tpu.underfs.azure", "AdlsUnderFileSystem", None),

@@ -26,6 +26,7 @@ const row = (t, cells, th) => {
     el.textContent = c; tr.appendChild(el);
   }
   t.appendChild(tr);
+  return tr;
 };
 async function j(p){ const r = await fetch(API + p);
                      if(!r.ok) throw new Error(p+': '+r.status);

@@ -258,7 +258,8 @@ def main() -> None:
             for i in range(NUM_BLOCKS):
                 fs.write_all(f"/bench/shard-{i}", payloads[i],
                              write_type=WriteType.MUST_CACHE)
-            log(f"cold write: {total_bytes / (time.monotonic() - t0) / 1e9:.2f} GB/s")
+            cold_rate = total_bytes / (time.monotonic() - t0)
+            log(f"cold write: {cold_rate / 1e9:.2f} GB/s")
             del payloads[1:]  # worker holds the data now; free host RAM
 
             # -- raw tunnel h2d ceiling (environment baseline) -------------
@@ -438,6 +439,19 @@ def main() -> None:
 
             # -- e2e: decode -> train-step epoch over cached records -------
             _bench_e2e(jax, jnp, fs, device, rng)
+
+            # -- BASELINE configs #2-#5 on the device (round-3 verdict #2:
+            # every config measured on TPU with an explicit vs_baseline;
+            # rows go to stderr as TPU-CONFIG lines + BENCH_TPU.json) ----
+            if os.environ.get("BENCH_TPU_CONFIGS", "1") != "0":
+                from alluxio_tpu.stress import tpu_suite
+
+                tpu_suite.run_all(
+                    jax, fs, device, shard_bytes=BLOCK_BYTES,
+                    cold_write_rate=cold_rate,
+                    out_path=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU.json"))
 
             loader.close()
             fs.close()

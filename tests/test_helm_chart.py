@@ -41,11 +41,16 @@ class TestChartRendering:
         assert "atpu-master-$i.atpu-masters:29999" in args
         cm = _by_kind(docs, "ConfigMap")[0]
         assert "journal.type=EMBEDDED" in cm["data"]["site.properties"]
+        # masters set their OWN quorum identity from the pod ordinal
+        assert 'ATPU_MASTER_EMBEDDED_JOURNAL_ADDRESS="$HOSTNAME' in args
         ds = _by_kind(docs, "DaemonSet")[0]
         worker = ds["spec"]["template"]["spec"]["containers"][0]
         env = {e["name"]: e.get("value") for e in worker["env"]}
-        assert env["ATPU_MASTER_RPC_ADDRESSES"].startswith(
-            "atpu-master-0.atpu-masters:")
+        assert env["MASTER_COUNT"] == "3"
+        # workers derive the FULL failover list, not just master-0
+        wargs = worker["args"][0]
+        assert "ATPU_MASTER_RPC_ADDRESSES=\"$ADDRS\"" in wargs
+        assert "atpu-master-$i.atpu-masters" in wargs
         # no proxy by default
         assert not _by_kind(docs, "Deployment")
 

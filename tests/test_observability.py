@@ -134,6 +134,57 @@ class TestWebEndpoint:
             _get(cluster, "/api/v1/nope")
         assert ei.value.code == 404
 
+    def test_browse_route_shows_tier_residency(self, cluster):
+        """/browse?path= lists the namespace with residency + perms
+        (reference: webui/master Browse page)."""
+        fs = cluster.file_system()
+        fs.create_directory("/bw/sub", recursive=True)
+        fs.write_all("/bw/hot.bin", b"x" * 4096)
+        code, body = _get(cluster,
+                          "/api/v1/master/browse?path=/bw")
+        assert code == 200
+        d = json.loads(body)
+        assert d["path"] == "/bw"
+        by_name = {e["name"]: e for e in d["entries"]}
+        assert by_name["sub"]["folder"] is True
+        hot = by_name["hot.bin"]
+        assert hot["length"] == 4096
+        assert hot["in_memory_percentage"] == 100  # MUST_CACHE in MEM
+        assert hot["block_count"] == 1
+        assert hot["mode"].startswith("0o")
+        # the HTML page itself serves
+        code, page = _get(cluster, "/browse")
+        assert code == 200 and b"Namespace" in page
+
+    def test_config_route_reports_sources(self, cluster):
+        code, body = _get(cluster, "/api/v1/master/config")
+        assert code == 200
+        conf = json.loads(body)["config"]
+        web = conf["atpu.master.web.enabled"]
+        assert web["value"] == "True"
+        assert "RUNTIME" in web["source"]  # set by the test fixture
+        # an untouched key reports DEFAULT
+        assert any("DEFAULT" in v["source"] for v in conf.values())
+        code, page = _get(cluster, "/config")
+        assert code == 200 and b"Effective configuration" in page
+
+    def test_logs_route_tails_ring(self, cluster):
+        from alluxio_tpu.utils import weblog
+
+        weblog.mark("weblog-test-sentinel")
+        code, body = _get(cluster, "/api/v1/master/logs?n=50")
+        assert code == 200
+        records = json.loads(body)["records"]
+        assert any("weblog-test-sentinel" == r["message"]
+                   for r in records)
+        # level floor filters
+        code, body = _get(cluster,
+                          "/api/v1/master/logs?n=50&level=ERROR")
+        assert not any("weblog-test-sentinel" == r["message"]
+                       for r in json.loads(body)["records"])
+        code, page = _get(cluster, "/logs")
+        assert code == 200 and b"Recent log records" in page
+
 
 def _wget(cluster, route):
     port = cluster.workers[0].worker.web_port

@@ -1,0 +1,144 @@
+"""Swift native Keystone dialect + per-vendor S3-remap contract tests
+(reference: ``SwiftUnderFileSystem.java:59`` JOSS auth; ``underfs/{oss,
+cos,kodo}`` vendor connectors exercised through the shared
+UnderFileSystemContractTest surface)."""
+
+import pytest
+
+from alluxio_tpu.underfs.registry import create_ufs
+from alluxio_tpu.underfs.swift import (
+    KeystoneSession, SwiftNativeUnderFileSystem, create_swift_ufs,
+)
+from tests.testutils.fake_s3 import FakeS3Server
+from tests.testutils.fake_swift import FakeSwiftServer
+
+CREDS = {"swift.user": "u", "swift.password": "pw",
+         "swift.project": "proj"}
+
+
+@pytest.fixture()
+def swift():
+    with FakeSwiftServer() as srv:
+        yield srv
+
+
+def _native(srv, container="cont"):
+    return SwiftNativeUnderFileSystem(
+        f"swift://{container}/",
+        {"swift.auth.url": srv.auth_url, **CREDS})
+
+
+class TestKeystone:
+    def test_token_and_catalog(self, swift):
+        ks = KeystoneSession(swift.auth_url, "u", "pw", "proj")
+        token, storage = ks.credentials()
+        assert token and storage.endswith("/v1")
+        assert swift.state.auth_count == 1
+        # cached: no re-auth on second ask
+        ks.credentials()
+        assert swift.state.auth_count == 1
+
+    def test_bad_credentials_rejected(self, swift):
+        ks = KeystoneSession(swift.auth_url, "u", "WRONG", "proj")
+        with pytest.raises(Exception):
+            ks.credentials()
+
+    def test_expired_token_reauths(self, swift):
+        ufs = _native(swift)
+        with ufs.create("swift://cont/a") as w:
+            w.write(b"1")
+        swift.expire_all_tokens()
+        # transparent re-auth: the read still succeeds
+        assert ufs.read_range("swift://cont/a", 0, 1) == b"1"
+        assert swift.state.auth_count == 2
+        assert swift.state.bad_auth_count >= 1
+
+
+class TestSwiftNativeContract:
+    def test_create_read_delete(self, swift):
+        ufs = _native(swift)
+        with ufs.create("swift://cont/d/a.bin") as w:
+            w.write(b"swift native data")
+        st = ufs.get_status("swift://cont/d/a.bin")
+        assert st is not None and st.length == 17
+        assert ufs.read_range("swift://cont/d/a.bin", 6, 6) == b"native"
+        assert ufs.delete_file("swift://cont/d/a.bin")
+        assert ufs.get_status("swift://cont/d/a.bin") is None
+
+    def test_list_and_rename(self, swift):
+        ufs = _native(swift)
+        for name in ("l/f1", "l/f2", "m/f3"):
+            with ufs.create(f"swift://cont/{name}") as w:
+                w.write(b"x")
+        names = {s.name for s in ufs.list_status("swift://cont/l")}
+        assert names == {"f1", "f2"}
+        assert ufs.rename_file("swift://cont/l/f1", "swift://cont/l/g1")
+        assert ufs.get_status("swift://cont/l/f1") is None
+        assert ufs.read_range("swift://cont/l/g1", 0, 1) == b"x"
+
+    def test_listing_paginates(self, swift):
+        ufs = _native(swift)
+        # server caps pages at 1000; 1005 objects forces a second page
+        with swift.state.lock:
+            for i in range(1005):
+                swift.state.objects[f"cont/p/{i:05d}"] = b"x"
+        names = ufs._client.list_prefix("p/")
+        assert len(names) == 1005
+
+    def test_dialect_dispatch(self, swift):
+        native = create_swift_ufs(
+            "swift://c/", {"swift.auth.url": swift.auth_url, **CREDS})
+        assert isinstance(native, SwiftNativeUnderFileSystem)
+        from alluxio_tpu.underfs.s3_compat import SwiftUnderFileSystem
+
+        gateway = create_swift_ufs(
+            "swift://c/", {"swift.endpoint": "http://gw:9000",
+                           "swift.access.key": "a",
+                           "swift.secret.key": "s"})
+        assert isinstance(gateway, SwiftUnderFileSystem)
+
+    def test_registry_dispatches_scheme(self, swift):
+        ufs = create_ufs("swift://cont/",
+                         {"swift.auth.url": swift.auth_url, **CREDS})
+        assert ufs.get_underfs_type() == "swift"
+
+
+class TestVendorRemapContracts:
+    """Each vendor remap speaks real SigV4 against the fake S3 server:
+    one contract body, one test per scheme."""
+
+    SCHEMES = ("oss", "cos", "kodo", "obs")
+
+    def _contract(self, scheme: str) -> None:
+        with FakeS3Server() as srv:
+            ufs = create_ufs(f"{scheme}://bkt/", {
+                f"{scheme}.endpoint": srv.endpoint,
+                f"{scheme}.access.key": "ak",
+                f"{scheme}.secret.key": "sk"})
+            assert ufs.get_underfs_type() in (scheme, "s3", "cosn")
+            base = f"{scheme}://bkt"
+            with ufs.create(f"{base}/w/a.bin") as w:
+                w.write(b"vendor-data-123")
+            st = ufs.get_status(f"{base}/w/a.bin")
+            assert st is not None and st.length == 15
+            assert ufs.read_range(f"{base}/w/a.bin", 7, 4) == b"data"
+            names = {s.name for s in ufs.list_status(f"{base}/w")}
+            assert names == {"a.bin"}
+            assert ufs.rename_file(f"{base}/w/a.bin", f"{base}/w/b.bin")
+            assert ufs.get_status(f"{base}/w/a.bin") is None
+            assert ufs.delete_file(f"{base}/w/b.bin")
+            assert ufs.get_status(f"{base}/w/b.bin") is None
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_vendor_contract(self, scheme):
+        self._contract(scheme)
+
+    def test_swift_gateway_contract(self):
+        """The swift S3-middleware fallback dialect, same contract."""
+        with FakeS3Server() as srv:
+            ufs = create_ufs("swift://bkt/", {
+                "swift.endpoint": srv.endpoint,
+                "swift.access.key": "ak", "swift.secret.key": "sk"})
+            with ufs.create("swift://bkt/x") as w:
+                w.write(b"gw")
+            assert ufs.read_range("swift://bkt/x", 0, 2) == b"gw"

@@ -1,0 +1,205 @@
+"""In-process fake OpenStack stack: Keystone v3 token issuance + a Swift
+object API, enough to contract-test the native swift connector. Tokens
+are validated on every object request; an expiry knob exercises the
+re-auth path."""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _State:
+    def __init__(self, user: str, password: str, project: str) -> None:
+        self.user, self.password, self.project = user, password, project
+        self.objects: Dict[str, bytes] = {}  # "container/key" -> bytes
+        self.valid_tokens: set = set()
+        self.lock = threading.Lock()
+        self.auth_count = 0
+        self.bad_auth_count = 0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State = None
+    storage_base: str = ""
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code: int, body: bytes = b"",
+              headers: Dict[str, str] = None) -> None:
+        self.send_response(code)
+        if "Content-Length" not in (headers or {}):
+            self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _authed(self) -> bool:
+        tok = self.headers.get("X-Auth-Token", "")
+        with self.state.lock:
+            ok = tok in self.state.valid_tokens
+            if not ok:
+                self.state.bad_auth_count += 1
+        if not ok:
+            self._send(401)
+        return ok
+
+    # -- keystone ------------------------------------------------------------
+    def do_POST(self):  # noqa: N802
+        parts = urlsplit(self.path)
+        if parts.path.rstrip("/").endswith("/auth/tokens"):
+            body = json.loads(self._body() or b"{}")
+            pw = (((body.get("auth") or {}).get("identity") or {})
+                  .get("password") or {}).get("user") or {}
+            st = self.state
+            if pw.get("name") != st.user or \
+                    pw.get("password") != st.password:
+                return self._send(401, b'{"error": "bad credentials"}')
+            token = uuid.uuid4().hex
+            with st.lock:
+                st.valid_tokens.add(token)
+                st.auth_count += 1
+            catalog = [{"type": "object-store", "name": "swift",
+                        "endpoints": [{"interface": "public",
+                                       "region": "r1",
+                                       "url": self.storage_base}]}]
+            return self._send(
+                201, json.dumps({"token": {"catalog": catalog}}).encode(),
+                headers={"X-Subject-Token": token,
+                         "Content-Type": "application/json"})
+        self._send(404)
+
+    # -- swift object api ----------------------------------------------------
+    def _parse_object(self) -> Optional[Tuple[str, str, dict]]:
+        parts = urlsplit(self.path)
+        path = parts.path
+        if not path.startswith("/v1/"):
+            return None
+        rest = path[len("/v1/"):]
+        container, _, key = rest.partition("/")
+        q = {k: v[0] for k, v in parse_qs(parts.query).items()}
+        return unquote(container), unquote(key), q
+
+    def do_PUT(self):  # noqa: N802
+        po = self._parse_object()
+        if po is None or not self._authed():
+            return None if po is None else None
+        c, key, _ = po
+        data = self._body()
+        copy_from = self.headers.get("X-Copy-From")
+        with self.state.lock:
+            if copy_from:
+                src = unquote(copy_from.lstrip("/"))
+                if src not in self.state.objects:
+                    return self._send(404)
+                self.state.objects[f"{c}/{key}"] = self.state.objects[src]
+                return self._send(201)
+            self.state.objects[f"{c}/{key}"] = data
+        self._send(201)
+
+    def do_GET(self):  # noqa: N802
+        po = self._parse_object()
+        if po is None or not self._authed():
+            return
+        c, key, q = po
+        if not key:  # container listing
+            prefix = q.get("prefix", "")
+            marker = q.get("marker", "")
+            with self.state.lock:
+                names = sorted(
+                    k[len(c) + 1:] for k in self.state.objects
+                    if k.startswith(f"{c}/")
+                    and k[len(c) + 1:].startswith(prefix))
+            names = [n for n in names if n > marker][:1000]
+            body = json.dumps([
+                {"name": n,
+                 "bytes": len(self.state.objects[f"{c}/{n}"])}
+                for n in names]).encode()
+            return self._send(200, body,
+                              headers={"Content-Type": "application/json"})
+        with self.state.lock:
+            data = self.state.objects.get(f"{c}/{key}")
+        if data is None:
+            return self._send(404)
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            a, _, b = rng[len("bytes="):].partition("-")
+            start = int(a) if a else 0
+            end = int(b) + 1 if b else len(data)
+            if start >= len(data) and data:
+                return self._send(416)
+            return self._send(206, data[start:end])
+        self._send(200, data)
+
+    def do_HEAD(self):  # noqa: N802
+        po = self._parse_object()
+        if po is None or not self._authed():
+            return
+        c, key, _ = po
+        with self.state.lock:
+            data = self.state.objects.get(f"{c}/{key}")
+        if data is None:
+            return self._send(404)
+        self._send(200, headers={"Content-Length": str(len(data)),
+                                 "X-Timestamp": "1700000000.0",
+                                 "Etag": "fake"})
+
+    def do_DELETE(self):  # noqa: N802
+        po = self._parse_object()
+        if po is None or not self._authed():
+            return
+        c, key, _ = po
+        with self.state.lock:
+            if f"{c}/{key}" not in self.state.objects:
+                return self._send(404)
+            del self.state.objects[f"{c}/{key}"]
+        self._send(204)
+
+
+class FakeSwiftServer:
+    """Keystone + Swift in one server: auth at ``{endpoint}/v3``,
+    storage at ``{endpoint}/v1``."""
+
+    def __init__(self, user: str = "u", password: str = "pw",
+                 project: str = "proj") -> None:
+        self.state = _State(user, password, project)
+        outer = self
+
+        class H(_Handler):
+            state = self.state
+
+            @property
+            def storage_base(self):
+                return f"{outer.endpoint}/v1"
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self._httpd.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.auth_url = f"{self.endpoint}/v3"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def expire_all_tokens(self) -> None:
+        with self.state.lock:
+            self.state.valid_tokens.clear()
+
+    def __enter__(self) -> "FakeSwiftServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        return False

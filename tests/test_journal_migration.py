@@ -71,7 +71,7 @@ def _quorum(folder, ports):
     for a in addrs:
         j = EmbeddedJournalSystem(
             folder, node_id=a, address=a, addresses=",".join(addrs),
-            election_timeout_ms=(150, 300), heartbeat_interval_ms=50)
+            election_timeout_ms=(300, 600), heartbeat_interval_ms=100)
         kv = KV()
         j.register(kv)
         systems.append(j)
@@ -79,7 +79,7 @@ def _quorum(folder, ports):
     return systems, kvs, addrs
 
 
-def _wait(pred, timeout=30.0, msg=""):
+def _wait(pred, timeout=180.0, msg=""):  # 1-core CI: generous
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():
@@ -101,6 +101,7 @@ class TestLocalToEmbedded:
         assert out["entries"] > 0 or out["checkpoint_seq"] > 0
 
         systems, kvs, _ = _quorum(raft_dir, ports)
+        victim = -1
         try:
             for j in systems:
                 j.standby_start()
@@ -127,7 +128,7 @@ class TestLocalToEmbedded:
                     if k != "post-migrate"} == expect
         finally:
             for i, j in enumerate(systems):
-                if i != (victim if "victim" in dir() else -1):
+                if i != victim:
                     j.stop()
 
     def test_refuses_existing_quorum(self, tmp_path):

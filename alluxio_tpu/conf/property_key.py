@@ -142,6 +142,10 @@ class PropertyKey:
     aliases: tuple = ()
     choices: tuple = ()  # for ENUM
     dynamic: bool = False  # may be updated at runtime (live reconfiguration)
+    # Mirrors the reference's DisplayType.CREDENTIALS
+    # (conf/PropertyKey.java): values must be masked on every config
+    # display surface (web UI, REST, shell report).
+    credentials: bool = False
 
     def parse(self, raw: Any) -> Any:
         if raw is None:
@@ -195,11 +199,35 @@ REGISTRY = KeyRegistry()
 def _k(name: str, key_type: KeyType = KeyType.STRING, default: Any = None,
        description: str = "", scope: Scope = Scope.ALL,
        consistency: ConsistencyLevel = ConsistencyLevel.IGNORE,
-       aliases: tuple = (), choices: tuple = (), dynamic: bool = False) -> PropertyKey:
+       aliases: tuple = (), choices: tuple = (), dynamic: bool = False,
+       credentials: bool = False) -> PropertyKey:
     return REGISTRY.register(PropertyKey(
         name=name, key_type=key_type, default=default, description=description,
         scope=scope, consistency=consistency, aliases=aliases, choices=choices,
-        dynamic=dynamic))
+        dynamic=dynamic, credentials=credentials))
+
+
+# Defensive net for keys minted outside the registry (templates, mount
+# options echoed into config): anything that LOOKS like a secret is
+# treated as one on display surfaces.
+_CREDENTIAL_NAME_RE = re.compile(
+    r"(?i)(password|secret|token|credential|access[._-]?key|[._-]key$)")
+
+
+def is_credential_key(name: str) -> bool:
+    """True if ``name`` must be masked on config display surfaces."""
+    pk = REGISTRY.get(name)
+    if pk is not None and pk.credentials:
+        return True
+    return _CREDENTIAL_NAME_RE.search(name) is not None
+
+
+def mask_credential(name: str, value: Any) -> Any:
+    """Value as it may appear on a display surface (web UI, REST, shell):
+    credential keys come back as ``******`` unless unset."""
+    if is_credential_key(name) and value not in (None, "", "None"):
+        return "******"
+    return value
 
 
 @dataclass(frozen=True)
@@ -295,7 +323,8 @@ class Keys:
                     "CUSTOM auth (reference: AuthenticationProvider SPI).")
     SECURITY_LOGIN_TOKEN = _k(
         "atpu.security.login.token",
-        description="Opaque credential forwarded to a CUSTOM provider.")
+        description="Opaque credential forwarded to a CUSTOM provider.",
+        credentials=True)
 
     # --- master ---
     MASTER_HOSTNAME = _k("atpu.master.hostname", default="localhost", scope=Scope.ALL)

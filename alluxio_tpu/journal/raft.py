@@ -809,19 +809,28 @@ class RaftNode:
 
     def handle_timeout_now(self, req: dict) -> dict:
         """TimeoutNow from the leader: start a forced election NOW.
-        §3.10: TimeoutNow is LEADER-initiated only — the sender must
-        identify as the current leader at the current term, not merely
-        be term-fresh. This rejects honest-but-confused senders (a
-        stale candidate at an equal term, a buggy follower) whose
-        forced election would bypass pre-vote. Like all of Raft it is
-        crash-fault-tolerant only: a *malicious* peer forging the
-        leader's id is outside the model (peers are trusted)."""
+        §3.10: TimeoutNow is LEADER-initiated only — a sender that
+        CONTRADICTS a leader we already recognize at the current term is
+        rejected. When we have not yet recorded a leader for the term
+        (leader_id None right after a vote-driven term bump, before the
+        first AppendEntries) the request is accepted: the legitimate
+        leader's transfer must not silently abort in that window, at the
+        cost of also trusting an equal-term sender we cannot yet
+        disprove. Like all of Raft this is crash-fault-tolerant only: a
+        *malicious* peer forging the leader's id is outside the model
+        (peers are trusted)."""
         with self.lock:
             if self._stopped or self.state == LEADER or \
                     req.get("term", 0) < self.log.term:
                 return {"ok": False}
             sender = req.get("leader_id")
+            # Accept when we have not yet recorded a leader for this term
+            # (leader_id None right after a vote-driven term bump, before
+            # the first AppendEntries) — the legitimate leader's transfer
+            # must not silently abort then. Reject only a sender that
+            # CONTRADICTS a known leader.
             if req.get("term", 0) == self.log.term and \
+                    self.leader_id is not None and \
                     sender != self.leader_id:
                 return {"ok": False}
         threading.Thread(target=self._start_election,

@@ -320,15 +320,24 @@ class LocalJournalSystem(JournalSystem):
         @contextlib.contextmanager
         def scope():
             prev = getattr(self._deferred, "on", False)
+            # Nest-safe: an inner scope must not discard the outer scope's
+            # accumulated flush obligation — entries journaled in the outer
+            # scope before the inner one would otherwise be acknowledged
+            # but never fsynced at outer-scope exit.
+            prev_want = getattr(self._deferred, "want", 0)
             self._deferred.on = True
-            self._deferred.want = 0
+            self._deferred.want = prev_want
             try:
                 yield
             finally:
                 want = getattr(self._deferred, "want", 0)
                 self._deferred.on = prev
-                if want:
-                    self._ensure_durable(want)
+                if prev:
+                    self._deferred.want = max(want, prev_want)
+                else:
+                    self._deferred.want = 0  # don't seed later scopes
+                    if want:
+                        self._ensure_durable(want)
 
         return scope()
 

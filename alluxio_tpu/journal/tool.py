@@ -25,11 +25,12 @@ def _fmt_payload(payload: dict, max_len: int = 160) -> str:
     return s if len(s) <= max_len else s[:max_len] + "...}"
 
 
-def dump_journal(folder: str, out: TextIO = sys.stdout, *,
+def dump_journal(folder: str, out: Optional[TextIO] = None, *,
                  start_seq: int = 0,
                  end_seq: Optional[int] = None) -> int:
     """Print checkpoint + entries in [start_seq, end_seq]; returns the
     number of entries printed."""
+    out = out if out is not None else sys.stdout  # late-bind: honor redirects
     ckpt_dir = os.path.join(folder, CKPT_DIR)
     log_dir = os.path.join(folder, LOG_DIR)
     printed = 0

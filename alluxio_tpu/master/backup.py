@@ -78,10 +78,21 @@ class ScheduledBackup:
         return path
 
     # -- retention ----------------------------------------------------------
+    @staticmethod
+    def _age_key(name: str):
+        # atpu-backup-<YYYYMMDD-HHMMSS>-<seq>[.<n>].bak — the sequence and
+        # uniquifier are NOT zero-padded, so lexical order misranks two
+        # backups in the same wall-clock second (seq 10 < seq 9 lexically)
+        m = re.match(
+            r"^atpu-backup-(\d{8}-\d{6})-(\d+)(?:\.(\d+))?\.bak$", name)
+        if m is None:
+            return (name, 0, 0)
+        return (m.group(1), int(m.group(2)), int(m.group(3) or 0))
+
     def _existing(self) -> List[str]:
         try:
-            return sorted(f for f in os.listdir(self._dir)
-                          if _BACKUP_RE.match(f))
+            return sorted((f for f in os.listdir(self._dir)
+                           if _BACKUP_RE.match(f)), key=self._age_key)
         except FileNotFoundError:
             return []
 

@@ -260,19 +260,26 @@ class MasterWebServer:
                         "block_count": len(e["block_ids"]),
                     } for e in entries]}
                 if route == "/api/v1/master/config":
-                    from alluxio_tpu.conf.property_key import REGISTRY
+                    from alluxio_tpu.conf.property_key import (
+                        REGISTRY, mask_credential)
 
                     conf = mp._conf
                     # EFFECTIVE configuration: every registered key with
                     # its default, overlaid by whatever is actually set
                     # (reference: the webui Configuration page shows the
-                    # full resolved table, not just overrides)
+                    # full resolved table, not just overrides). Values of
+                    # credential-flagged keys — and anything that LOOKS
+                    # like a secret — are masked, never serialized
+                    # (reference DisplayType.CREDENTIALS masking on the
+                    # config webUI/REST endpoint).
                     out = {name: {"value": str(pk.default),
                                   "source": "DEFAULT"}
                            for name, pk in REGISTRY.all_keys().items()}
                     for k, v in conf.to_map().items():
                         out[k] = {"value": str(v),
                                   "source": conf.source(k).name}
+                    for k, row in out.items():
+                        row["value"] = mask_credential(k, row["value"])
                     return {"config": dict(sorted(out.items()))}
                 if route == "/api/v1/master/logs":
                     from alluxio_tpu.utils import weblog

@@ -25,16 +25,36 @@ class ShellContext:
     """Lazily-constructed clients shared by every command in one invocation."""
 
     def __init__(self, conf: Optional[Configuration] = None,
-                 out: TextIO = sys.stdout, err: TextIO = sys.stderr) -> None:
+                 out: Optional[TextIO] = None,
+                 err: Optional[TextIO] = None) -> None:
         self.conf = conf or Configuration()
-        self.out = out
-        self.err = err
+        # Late-bound: a default-constructed context must follow RUNTIME
+        # sys.stdout/sys.stderr swaps (capsys, supervisors), not whatever
+        # the streams were at import time.
+        self._out = out
+        self._err = err
         self._fs = None
         self._fs_client = None
         self._block_client = None
         self._meta_client = None
         self._job_client = None
         self._table_client = None
+
+    @property
+    def out(self) -> TextIO:
+        return self._out if self._out is not None else sys.stdout
+
+    @out.setter
+    def out(self, stream: Optional[TextIO]) -> None:
+        self._out = stream
+
+    @property
+    def err(self) -> TextIO:
+        return self._err if self._err is not None else sys.stderr
+
+    @err.setter
+    def err(self, stream: Optional[TextIO]) -> None:
+        self._err = stream
 
     @property
     def master_address(self) -> str:

@@ -345,7 +345,12 @@ class GetConfCommand(Command):
         p.add_argument("key", nargs="?")
 
     def run(self, args, ctx):
+        from alluxio_tpu.conf.property_key import mask_credential
+
         props = ctx.meta_client().get_configuration()["properties"]
+        # display surface: mask credential values (reference
+        # DisplayType.CREDENTIALS handling in GetConfCommand)
+        props = {k: mask_credential(k, v) for k, v in props.items()}
         if args.key:
             if args.key in props:
                 ctx.print(props[args.key])
@@ -357,7 +362,7 @@ class GetConfCommand(Command):
             if v is None:
                 ctx.eprint(f"{args.key} is not set")
                 return 1
-            ctx.print(v)
+            ctx.print(mask_credential(args.key, v))
             return 0
         for k in sorted(props):
             ctx.print(f"{k}={props[k]}")

@@ -168,6 +168,24 @@ class TestWebEndpoint:
         code, page = _get(cluster, "/config")
         assert code == 200 and b"Effective configuration" in page
 
+    def test_config_route_masks_credentials(self, tmp_path):
+        """Credential-flagged keys (and secret-looking names) must never
+        reach a network peer via /config (reference:
+        DisplayType.CREDENTIALS masking on the config webUI/REST)."""
+        with LocalCluster(str(tmp_path), num_workers=0,
+                          conf_overrides={
+                              Keys.MASTER_WEB_ENABLED: True,
+                              Keys.MASTER_WEB_PORT: 0,
+                              Keys.SECURITY_LOGIN_TOKEN:
+                                  "hunter2-cluster-credential"}) as c:
+            code, body = _get(c, "/api/v1/master/config")
+            assert code == 200
+            assert b"hunter2" not in body
+            conf = json.loads(body)["config"]
+            assert conf["atpu.security.login.token"]["value"] == "******"
+            # the source is still reported — only the value is masked
+            assert "RUNTIME" in conf["atpu.security.login.token"]["source"]
+
     def test_logs_route_tails_ring(self, cluster):
         from alluxio_tpu.utils import weblog
 

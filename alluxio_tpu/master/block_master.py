@@ -98,6 +98,10 @@ class BlockMaster(Journaled):
         self._address_to_id: Dict[str, int] = {}
         #: block id -> {worker id -> tier alias}
         self._locations: Dict[int, Dict[int, str]] = {}
+        #: bumped on any location/topology change; "unchanged" means
+        #: every derived per-file residency figure (in_memory_percentage,
+        #: top tiers) is still valid — consumed by the listing cache
+        self.location_version = 0
         #: block id -> {mesh position -> reporting host}: the HBM warm
         #: set reported by JAX clients (§2.11 device-mesh block map)
         self._device_locations: Dict[int, Dict[int, str]] = {}
@@ -245,11 +249,13 @@ class BlockMaster(Journaled):
     def _add_location(self, block_id: int, worker_id: int, tier: str) -> None:
         self._locations.setdefault(block_id, {})[worker_id] = tier
         self._lost_blocks.discard(block_id)
+        self.location_version += 1
 
     def _remove_location(self, block_id: int, worker_id: int) -> None:
         locs = self._locations.get(block_id)
         if locs is not None:
             locs.pop(worker_id, None)
+            self.location_version += 1
             if not locs:
                 del self._locations[block_id]
                 if block_id in self._blocks:
@@ -494,6 +500,7 @@ class BlockMaster(Journaled):
                 out.add(tier)
                 break  # first registered = top tier
         self._top_tiers = frozenset(out)
+        self.location_version += 1
 
     # ---------------------------------------------------- journal contract
     def process_entry(self, entry: JournalEntry) -> bool:

@@ -54,6 +54,15 @@ ROOT_MOUNT_ID = 1
 _DEFAULT_DEVICE_TIERS = frozenset(("HBM", "MEM"))
 
 
+def _transpose(rows: "List[dict]") -> dict:
+    """Row wire-dicts -> struct-of-arrays listing payload. Every row
+    comes from ``_file_info_dict`` so the field set is uniform."""
+    if not rows:
+        return {"n": 0, "cols": {}}
+    return {"n": len(rows),
+            "cols": {k: [r[k] for r in rows] for k in rows[0]}}
+
+
 class FileSystemMaster:
     def __init__(self, block_master: BlockMaster, journal: JournalSystem,
                  ufs_manager: Optional[UfsManager] = None,
@@ -90,6 +99,17 @@ class FileSystemMaster:
         self._sync_cache = UfsSyncPathCache()
         #: UFS paths known absent (reference: AsyncUfsAbsentPathCache)
         self._absent_cache = AbsentPathCache()
+        #: dir inode id -> (tree_version, location_version, wire dicts).
+        #: Directory listing is the #1 metadata op for training-data
+        #: discovery and re-lists the same (unchanged) dirs constantly;
+        #: entries are valid while BOTH coarse versions stand — every
+        #: namespace mutation takes the tree write lock (bumping
+        #: ``RWLock.version``) and every residency change bumps
+        #: ``BlockMaster.location_version`` (reference streams ListStatus
+        #: partials instead, ``file_system_master.proto:475-590``; a
+        #: version-guarded server cache is the cheaper design when the
+        #: whole tree sits in one process)
+        self._listing_cache: Dict[int, tuple] = {}
 
     # -------------------------------------------------------------- startup
     def start(self, root_ufs_uri: Optional[str] = None,
@@ -215,9 +235,17 @@ class FileSystemMaster:
     def list_status(self, path: "str | AlluxioURI", *, recursive: bool = False,
                     load_direct_children: bool = True,
                     sync_interval_ms: int = -1,
-                    wire: bool = False) -> List[FileInfo]:
+                    wire: bool = False,
+                    columnar: bool = False) -> "List[FileInfo] | dict":
         """``wire=True``: entries are returned as wire DICTS (what the
-        RPC handler ships) — N dataclass constructions skipped."""
+        RPC handler ships) — N dataclass constructions skipped.
+        ``columnar=True`` (implies wire, non-recursive only): the listing
+        comes back struct-of-arrays, ``{"n": N, "cols": {field: [N
+        values]}}`` — one msgpack map of 30 arrays instead of N 30-key
+        maps, cutting encode+decode cost ~in half at listing fan-out
+        (the reference streams ListStatus partials instead,
+        ``file_system_master.proto:475-590``). Transposed once per
+        directory version and memoized in the listing cache."""
         uri = AlluxioURI(path)
         synced = self._maybe_sync(uri, sync_interval_ms)
         status = self.get_status(uri)  # loads the inode itself if needed
@@ -225,6 +253,7 @@ class FileSystemMaster:
             return [status.to_wire()] if wire else [status]
         if load_direct_children:
             self._load_children_if_needed(uri, force=synced)
+        wire = wire or columnar
         info = self._file_info_dict if wire else self._file_info
         out: List[FileInfo] = []
         with self.inode_tree.lock.read_locked():
@@ -234,6 +263,21 @@ class FileSystemMaster:
             from alluxio_tpu.security.authorization import READ
 
             self._check_access(lookup, READ)
+            if wire and not recursive:
+                # per-caller access check done above; the emitted child
+                # entries themselves are caller-independent
+                dir_id = lookup.inode.id
+                tree_ver = self.inode_tree.lock.version
+                loc_ver = self._block_master.location_version
+                hit = self._listing_cache.get(dir_id)
+                if hit is not None and hit[0] == tree_ver and \
+                        hit[1] == loc_ver:
+                    if not columnar:
+                        return hit[2]
+                    if hit[3] is None:
+                        hit = hit[:3] + (_transpose(hit[2]),)
+                        self._listing_cache[dir_id] = hit
+                    return hit[3]
 
             def emit(dir_inode: Inode, dir_uri: AlluxioURI) -> None:
                 # resolve the directory's mount ONCE; children extend it
@@ -261,7 +305,19 @@ class FileSystemMaster:
                         emit(child, dir_uri.join(child.name))
 
             emit(lookup.inode, uri)
-        return out
+            if wire and not recursive and \
+                    self._block_master.location_version == loc_ver:
+                # tree_ver is stable while we hold the read lock; only a
+                # concurrent location change can invalidate mid-emit
+                if len(self._listing_cache) >= 1024:
+                    self._listing_cache.pop(
+                        next(iter(self._listing_cache)), None)
+                cols = _transpose(out) if columnar else None
+                self._listing_cache[lookup.inode.id] = (
+                    tree_ver, loc_ver, out, cols)
+                if columnar:
+                    return cols
+        return _transpose(out) if columnar else out
 
     def get_file_block_info_list(self, path: "str | AlluxioURI") -> List[FileBlockInfo]:
         uri = AlluxioURI(path)

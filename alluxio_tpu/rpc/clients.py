@@ -101,8 +101,16 @@ class FsMasterClient(_BaseClient):
                     sync_interval_ms: int = -1) -> List[FileInfo]:
         resp = self._call("list_status", {
             "path": str(path), "recursive": recursive,
-            "sync_interval_ms": sync_interval_ms})
-        return [FileInfo.from_wire(d) for d in resp["infos"]]
+            "sync_interval_ms": sync_interval_ms, "columnar": True})
+        col = resp.get("columnar")
+        if col is None:  # server predates the columnar listing format
+            return [FileInfo.from_wire(d) for d in resp["infos"]]
+        cols = col["cols"]
+        if not cols:
+            return []
+        keys = tuple(cols)
+        return [FileInfo.from_wire(dict(zip(keys, row)))
+                for row in zip(*(cols[k] for k in keys))]
 
     def create_file(self, path: str, **opts) -> FileInfo:
         return FileInfo.from_wire(self._call(

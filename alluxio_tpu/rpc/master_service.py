@@ -96,9 +96,15 @@ def fs_master_service(fsm: FileSystemMaster,
     u("get_status", lambda r: fsm.get_status(
         r["path"], sync_interval_ms=r.get("sync_interval_ms", -1)).to_wire())
     u("exists", lambda r: {"exists": fsm.exists(r["path"])})
-    u("list_status", lambda r: {"infos": fsm.list_status(
-        r["path"], recursive=r.get("recursive", False),
-        sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)})
+    u("list_status", lambda r: (
+        {"columnar": fsm.list_status(
+            r["path"], recursive=r.get("recursive", False),
+            sync_interval_ms=r.get("sync_interval_ms", -1),
+            columnar=True)}
+        if r.get("columnar") else
+        {"infos": fsm.list_status(
+            r["path"], recursive=r.get("recursive", False),
+            sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)}))
     u("create_file", lambda r: fsm.create_file(
         r["path"], block_size_bytes=r.get("block_size_bytes"),
         recursive=r.get("recursive", True), ttl=r.get("ttl", -1),

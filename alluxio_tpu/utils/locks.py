@@ -24,6 +24,12 @@ class RWLock:
         self._writer: "threading.Thread | None" = None
         self._writer_depth = 0
         self._waiting_writers = 0
+        # Monotonic write-acquisition counter: every mutation of the
+        # protected structure requires the write lock, so "version
+        # unchanged" == "structure unchanged" (conservative: bumps even
+        # for a no-op write section). Read it under the read lock for a
+        # coherent snapshot. Used by the master's listing cache.
+        self.version = 0
 
     def _my_holds(self) -> int:
         return getattr(self._holds, "depth", 0)
@@ -77,6 +83,7 @@ class RWLock:
                     return False
                 self._writer = me
                 self._writer_depth = 1
+                self.version += 1
                 return True
             finally:
                 self._waiting_writers -= 1

@@ -73,6 +73,24 @@ def _wire_dataclass(cls):
     def from_wire(klass, d: Dict[str, Any]):
         nested, _, plain_dicts = spec.get("s") or _specialize()
         known = klass._wire_names
+        if d.keys() == known:
+            # exact match (our own server over msgpack: the transport
+            # owns ``d``): adopt it as __dict__ in place — no filtered
+            # copy, no 30-kwarg __init__. Listing fan-out decodes N of
+            # these per call, so the copy was the client-side hot spot.
+            for n in nested:
+                v = d[n]
+                if v is None:
+                    continue
+                sub = _NESTED[(klass.__name__, n)]
+                if isinstance(v, list):
+                    d[n] = [sub.from_wire(x) if isinstance(x, dict)
+                            else x for x in v]
+                elif isinstance(v, dict) and n not in plain_dicts:
+                    d[n] = sub.from_wire(v)
+            obj = object.__new__(klass)
+            obj.__dict__ = d
+            return obj
         kwargs = {k: v for k, v in d.items() if k in known}
         for n in nested:
             v = kwargs.get(n)

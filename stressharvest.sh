@@ -1,0 +1,22 @@
+#!/bin/bash
+# Opportunistic TPU perf harvest (round-4 verdict #1): the axon tunnel
+# grants a device intermittently, so probe cheaply in a loop and run the
+# full bench only when a grant is live. Never kills a granted process.
+cd /root/repo
+for i in $(seq 1 "${HARVEST_TRIES:-40}"); do
+  echo "[harvest] probe $i $(date -u +%H:%M:%S)" >&2
+  if timeout 180 python -c 'import jax, jax.numpy as jnp; d=jax.devices()[0]; jnp.ones((4,)).sum().block_until_ready(); print("PROBE_OK", d)' 2>/dev/null | grep -q PROBE_OK; then
+    echo "[harvest] grant live — running full bench" >&2
+    BENCH_PROBE_TIMEOUT_S=170 python bench.py > /tmp/bench_harvest.json 2>/tmp/bench_harvest.log
+    rc=$?
+    echo "[harvest] bench rc=$rc" >&2
+    if [ $rc -eq 0 ] && grep -q '"vs_baseline"' /tmp/bench_harvest.json && ! grep -q tpu_wedged /tmp/bench_harvest.json; then
+      cp /tmp/bench_harvest.json BENCH_HEADLINE_r5.json
+      echo "[harvest] SUCCESS — BENCH_HEADLINE_r5.json + BENCH_TPU.json written" >&2
+      exit 0
+    fi
+  fi
+  sleep "${HARVEST_SLEEP_S:-600}"
+done
+echo "[harvest] no grant landed" >&2
+exit 3

@@ -76,6 +76,42 @@ class TestNamespaceOps:
         rec = [i.path for i in fsm.list_status("/d", recursive=True)]
         assert "/d/sub/z" in rec
 
+    def test_listing_cache_invalidation(self, fsm):
+        """The version-guarded listing cache must serve the same object
+        while the namespace is quiet and drop it on ANY mutation
+        (coarse: tree write-lock version + block location version)."""
+        fsm.create_file("/lc/a")
+        fsm.create_file("/lc/b")
+        first = fsm.list_status("/lc", wire=True)
+        assert fsm.list_status("/lc", wire=True) is first  # cache hit
+        fsm.create_file("/lc/c")  # tree mutation -> invalidate
+        after = fsm.list_status("/lc", wire=True)
+        assert after is not first
+        assert [e["name"] for e in after] == ["a", "b", "c"]
+        # block-location change (no tree mutation) also invalidates:
+        # residency figures (in_memory_percentage) depend on it
+        fsm._block_master.location_version += 1
+        assert fsm.list_status("/lc", wire=True) is not after
+        # a different caller's listing of another dir doesn't collide
+        fsm.create_file("/lc2/z")
+        assert [e["name"] for e in fsm.list_status("/lc2", wire=True)] == ["z"]
+
+    def test_listing_columnar_roundtrip(self, fsm):
+        """Struct-of-arrays listing carries the same data as row form
+        and memoizes the transpose per directory version."""
+        fsm.create_file("/col/a")
+        fsm.create_directory("/col/sub")
+        rows = fsm.list_status("/col", wire=True)
+        cols = fsm.list_status("/col", columnar=True)
+        assert cols["n"] == 2 and set(cols["cols"]) == set(rows[0])
+        for i, row in enumerate(rows):
+            for k, v in row.items():
+                assert cols["cols"][k][i] == v
+        assert fsm.list_status("/col", columnar=True) is cols  # memoized
+        fsm.create_directory("/col/empty")
+        empty = fsm.list_status("/col/empty", columnar=True)
+        assert empty == {"n": 0, "cols": {}}
+
     def test_delete_recursive(self, fsm):
         fsm.create_file("/d/x")
         with pytest.raises(DirectoryNotEmptyError):

@@ -381,6 +381,7 @@ class BlockMaster(Journaled):
                 for bid in bids:
                     self._device_locations.setdefault(
                         int(bid), {})[int(pos)] = host
+            self.location_version += 1
             if mesh_blocks:
                 self._device_report_ms[host] = self._clock.millis()
 
@@ -392,6 +393,9 @@ class BlockMaster(Journaled):
             if not entry:
                 del self._device_locations[bid]
         self._device_report_ms.pop(host, None)
+        # device (HBM) residency feeds listing wire dicts — stale cache
+        # entries would steer locality reads at hosts that dropped out
+        self.location_version += 1
 
     def prune_device_reports(self) -> List[str]:
         """Age out device reports from hosts that stopped renewing (a

@@ -110,6 +110,7 @@ class FileSystemMaster:
         #: version-guarded server cache is the cheaper design when the
         #: whole tree sits in one process)
         self._listing_cache: Dict[int, tuple] = {}
+        self._listing_cache_lock = threading.Lock()
 
     # -------------------------------------------------------------- startup
     def start(self, root_ufs_uri: Optional[str] = None,
@@ -247,13 +248,15 @@ class FileSystemMaster:
         ``file_system_master.proto:475-590``). Transposed once per
         directory version and memoized in the listing cache."""
         uri = AlluxioURI(path)
+        wire = wire or columnar
         synced = self._maybe_sync(uri, sync_interval_ms)
         status = self.get_status(uri)  # loads the inode itself if needed
         if not status.folder:
+            if columnar:
+                return _transpose([status.to_wire()])
             return [status.to_wire()] if wire else [status]
         if load_direct_children:
             self._load_children_if_needed(uri, force=synced)
-        wire = wire or columnar
         info = self._file_info_dict if wire else self._file_info
         out: List[FileInfo] = []
         with self.inode_tree.lock.read_locked():
@@ -276,7 +279,8 @@ class FileSystemMaster:
                         return hit[2]
                     if hit[3] is None:
                         hit = hit[:3] + (_transpose(hit[2]),)
-                        self._listing_cache[dir_id] = hit
+                        with self._listing_cache_lock:
+                            self._listing_cache[dir_id] = hit
                     return hit[3]
 
             def emit(dir_inode: Inode, dir_uri: AlluxioURI) -> None:
@@ -309,12 +313,15 @@ class FileSystemMaster:
                     self._block_master.location_version == loc_ver:
                 # tree_ver is stable while we hold the read lock; only a
                 # concurrent location change can invalidate mid-emit
-                if len(self._listing_cache) >= 1024:
-                    self._listing_cache.pop(
-                        next(iter(self._listing_cache)), None)
                 cols = _transpose(out) if columnar else None
-                self._listing_cache[lookup.inode.id] = (
-                    tree_ver, loc_ver, out, cols)
+                with self._listing_cache_lock:
+                    # multiple listing threads share the tree READ lock;
+                    # dict iteration for eviction needs its own mutex
+                    if len(self._listing_cache) >= 1024:
+                        self._listing_cache.pop(
+                            next(iter(self._listing_cache)), None)
+                    self._listing_cache[lookup.inode.id] = (
+                        tree_ver, loc_ver, out, cols)
                 if columnar:
                     return cols
         return _transpose(out) if columnar else out

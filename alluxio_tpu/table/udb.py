@@ -152,6 +152,10 @@ def udb_factory(udb_type: str, fs, connection: str, db_name: str = "",
         from alluxio_tpu.table.hive import HiveUnderDatabase
 
         return HiveUnderDatabase(fs, connection, db_name, options)
+    if udb_type == "glue":
+        from alluxio_tpu.table.glue import GlueUnderDatabase
+
+        return GlueUnderDatabase(fs, connection, db_name, options)
     raise NotFoundError(
         f"unknown under-database type {udb_type!r} "
-        f"(available: fs, hive)")
+        f"(available: fs, hive, glue)")

@@ -74,10 +74,16 @@ def _wire_dataclass(cls):
         nested, _, plain_dicts = spec.get("s") or _specialize()
         known = klass._wire_names
         if d.keys() == known:
-            # exact match (our own server over msgpack: the transport
-            # owns ``d``): adopt it as __dict__ in place — no filtered
-            # copy, no 30-kwarg __init__. Listing fan-out decodes N of
-            # these per call, so the copy was the client-side hot spot.
+            # exact match (the overwhelmingly common case: our own
+            # server's wire dict): one flat C-level copy, then adopt as
+            # __dict__ — no filtered comprehension, no 30-kwarg
+            # __init__. Listing fan-out decodes N of these per call, so
+            # the per-key copy was the client-side hot spot. The copy
+            # (not in-place adoption) keeps the CALLER's dict unmutated
+            # — callers may retain it (journal payloads, the master's
+            # listing cache), and rewriting nested dicts into dataclass
+            # objects inside it would corrupt it for re-serialization.
+            d = dict(d)
             for n in nested:
                 v = d[n]
                 if v is None:

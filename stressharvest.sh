@@ -12,7 +12,7 @@ for i in $(seq 1 "${HARVEST_TRIES:-40}"); do
     echo "[harvest] bench rc=$rc" >&2
     if [ $rc -eq 0 ] && grep -q '"vs_baseline"' /tmp/bench_harvest.json && ! grep -q tpu_wedged /tmp/bench_harvest.json; then
       cp /tmp/bench_harvest.json BENCH_HEADLINE_r5.json
-      echo "[harvest] SUCCESS — BENCH_HEADLINE_r5.json + BENCH_TPU.json written" >&2
+      echo "[harvest] SUCCESS — BENCH_HEADLINE_r5.json copied (bench.py writes BENCH_TPU.json itself when configs run)" >&2
       exit 0
     fi
   fi

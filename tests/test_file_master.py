@@ -111,6 +111,24 @@ class TestNamespaceOps:
         fsm.create_directory("/col/empty")
         empty = fsm.list_status("/col/empty", columnar=True)
         assert empty == {"n": 0, "cols": {}}
+        # a FILE path must come back columnar too (the client always
+        # requests columnar; a row/object response would not serialize)
+        fcols = fsm.list_status("/col/a", columnar=True)
+        assert fcols["n"] == 1 and fcols["cols"]["name"] == ["a"]
+
+    def test_from_wire_does_not_mutate_cached_rows(self, fsm):
+        """FileInfo.from_wire over a retained wire dict (e.g. a listing
+        cache row) must not rewrite its nested dicts into objects —
+        the master re-serializes cached rows for later callers."""
+        from alluxio_tpu.utils.wire import FileInfo
+
+        fsm.create_file("/fw/f")
+        rows = fsm.list_status("/fw", wire=True)
+        import copy
+        before = copy.deepcopy(rows[0])
+        info = FileInfo.from_wire(rows[0])
+        assert info.name == "f"
+        assert rows[0] == before  # unmutated
 
     def test_delete_recursive(self, fsm):
         fsm.create_file("/d/x")

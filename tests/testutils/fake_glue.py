@@ -1,0 +1,160 @@
+"""In-process fake AWS Glue catalog speaking the JSON-1.1 protocol the
+real service does: POST / with ``X-Amz-Target: AWSGlue.<Op>``.
+
+Verifies protocol discipline server-side (content type, target header,
+and — when constructed with keys — the SigV4 Authorization header), the
+same fake-server stance as ``fake_azure.py``/``fake_hms.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+
+class GlueTable:
+    def __init__(self, name: str, location: str,
+                 cols: Optional[List[tuple]] = None,
+                 partition_keys: Optional[List[str]] = None,
+                 partitions: Optional[Dict[str, str]] = None) -> None:
+        self.name = name
+        self.location = location
+        self.cols = cols or []
+        self.partition_keys = partition_keys or []
+        #: {"k=v[/k2=v2]": location}
+        self.partitions = partitions or {}
+
+    def to_json(self) -> dict:
+        return {
+            "Name": self.name,
+            "StorageDescriptor": {
+                "Columns": [{"Name": n, "Type": t} for n, t in self.cols],
+                "Location": self.location,
+            },
+            "PartitionKeys": [{"Name": k, "Type": "string"}
+                              for k in self.partition_keys],
+        }
+
+
+class FakeGlueServer:
+    def __init__(self, *, access_key: str = "", page_size: int = 0) -> None:
+        self._access_key = access_key
+        self._page_size = page_size
+        #: {db: {table_name: GlueTable}}
+        self.databases: Dict[str, Dict[str, GlueTable]] = {}
+        self.requests: List[str] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _fail(self, code: int, err_type: str, msg: str) -> None:
+                body = json.dumps({"__type": err_type,
+                                   "Message": msg}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "application/x-amz-json-1.1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                op = self.headers.get("X-Amz-Target", "")
+                outer.requests.append(op)
+                if not op.startswith("AWSGlue."):
+                    return self._fail(400, "UnknownOperationException", op)
+                if "amz-json" not in self.headers.get("Content-Type", ""):
+                    return self._fail(400, "SerializationException",
+                                      "bad content type")
+                if outer._access_key:
+                    auth = self.headers.get("Authorization", "")
+                    if (f"Credential={outer._access_key}/" not in auth
+                            or "/glue/aws4_request" not in auth
+                            or "Signature=" not in auth):
+                        return self._fail(
+                            403, "AccessDeniedException", "bad signature")
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                try:
+                    resp = outer._dispatch(op.split(".", 1)[1], body)
+                except KeyError as e:
+                    return self._fail(400, "EntityNotFoundException",
+                                      str(e))
+                out = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-amz-json-1.1")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- catalog state -------------------------------------------------------
+    def add_table(self, db: str, table: GlueTable) -> None:
+        self.databases.setdefault(db, {})[table.name] = table
+
+    # -- dispatch ------------------------------------------------------------
+    def _page(self, items: List[dict], token: str,
+              key: str) -> dict:
+        if not self._page_size:
+            return {key: items}
+        start = int(token or 0)
+        end = start + self._page_size
+        out = {key: items[start:end]}
+        if end < len(items):
+            out["NextToken"] = str(end)
+        return out
+
+    def _dispatch(self, op: str, body: dict) -> dict:
+        if op == "GetDatabase":
+            name = body["Name"]
+            if name not in self.databases:
+                raise KeyError(f"Database {name} not found")
+            return {"Database": {"Name": name}}
+        if op == "GetDatabases":
+            return {"DatabaseList": [{"Name": n}
+                                     for n in sorted(self.databases)]}
+        if op == "GetTables":
+            db = self.databases[body["DatabaseName"]]
+            items = [t.to_json() for t in db.values()]
+            return self._page(items, body.get("NextToken", ""),
+                              "TableList")
+        if op == "GetTable":
+            t = self.databases[body["DatabaseName"]][body["Name"]]
+            return {"Table": t.to_json()}
+        if op == "GetPartitions":
+            t = self.databases[body["DatabaseName"]][body["TableName"]]
+            items = [{
+                "Values": [kv.split("=", 1)[1]
+                           for kv in spec.split("/")],
+                "StorageDescriptor": {"Location": loc},
+            } for spec, loc in t.partitions.items()]
+            return self._page(items, body.get("NextToken", ""),
+                              "Partitions")
+        raise KeyError(f"operation {op}")
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self) -> "FakeGlueServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fake-glue")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False

@@ -71,6 +71,9 @@ _OPTIONAL_CONNECTORS = (
     ("alluxio_tpu.underfs.azure", "AdlsUnderFileSystem", None),
     ("alluxio_tpu.underfs.ozone", "OzoneUnderFileSystem", None),
     ("alluxio_tpu.underfs.hdfs", "HdfsUnderFileSystem", ("hdfs",)),
+    # REST dialect of the hdfs family: stdlib-only, always registers
+    ("alluxio_tpu.underfs.webhdfs", "WebHdfsUnderFileSystem",
+     ("webhdfs",)),
 )
 
 

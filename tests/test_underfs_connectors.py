@@ -164,6 +164,154 @@ class TestSleepingUfs:
         assert ufs.op_counts["create"] == 1
 
 
+@pytest.fixture()
+def webhdfs(tmp_path):
+    from tests.testutils.fake_webhdfs import FakeWebHdfsServer
+
+    with FakeWebHdfsServer(str(tmp_path / "hdfs-root")) as srv:
+        yield srv
+
+
+class TestWebHdfsConnector:
+    """The HDFS family's REST dialect against a fake NameNode
+    (reference: ``HdfsUnderFileSystem.java:80``; the libhdfs dialect in
+    ``underfs/hdfs.py`` shares the SPI surface but needs a Hadoop
+    native install this image lacks)."""
+
+    def _ufs(self, srv):
+        return create_ufs(srv.uri, {"hdfs.user": "atpu"})
+
+    def test_scheme_registered(self):
+        assert "webhdfs" in supported_schemes()
+
+    def test_create_follows_307_redirect_then_read(self, webhdfs):
+        ufs = self._ufs(webhdfs)
+        with ufs.create("/a/b/f.bin") as w:
+            w.write(b"hdfs-payload" * 10)
+        # the two-step CREATE dance happened: redirect PUT + data PUT
+        creates = [r for r in webhdfs.requests if "PUT CREATE" in r]
+        assert len(creates) == 2 and creates[1].endswith("[data]")
+        assert ufs.open("/a/b/f.bin").read() == b"hdfs-payload" * 10
+        assert ufs.read_range("/a/b/f.bin", 4, 5) == b"-payl"
+
+    def test_status_list_rename_delete(self, webhdfs):
+        ufs = self._ufs(webhdfs)
+        ufs.mkdirs("/d/sub")
+        with ufs.create("/d/f1") as w:
+            w.write(b"xyz")
+        st = ufs.get_status("/d/f1")
+        assert st is not None and not st.is_directory and st.length == 3
+        assert st.owner == "hdfs" and st.mode is not None
+        names = sorted(s.name for s in ufs.list_status("/d"))
+        assert names == ["f1", "sub"]
+        assert ufs.list_status("/d/f1") is None  # file: not listable
+        assert ufs.rename_file("/d/f1", "/d/f2")
+        assert ufs.get_status("/d/f1") is None
+        assert ufs.delete_file("/d/f2")
+        assert not ufs.delete_directory("/d")  # non-recursive, non-empty
+        assert ufs.delete_directory(
+            "/d", DeleteOptions(recursive=True))
+        assert ufs.get_status("/d") is None
+
+    def test_missing_file_maps_to_file_not_found(self, webhdfs):
+        ufs = self._ufs(webhdfs)
+        with pytest.raises(FileNotFoundError):
+            ufs.open("/nope")
+        assert ufs.get_status("/nope") is None
+        assert ufs.list_status("/nope") is None
+
+    def test_standby_errors_do_not_read_as_absent(self, webhdfs):
+        """A standby/safe-mode NameNode answers RemoteException — that
+        must RAISE, never read as 'file deleted': the metadata sync
+        deletes inodes whose UFS status comes back None."""
+        ufs = self._ufs(webhdfs)
+        with ufs.create("/keep") as w:
+            w.write(b"x")
+        webhdfs.fail_all = ("StandbyException",
+                            "Operation category READ is not supported "
+                            "in state standby")
+        try:
+            with pytest.raises(IOError) as ei:
+                ufs.get_status("/keep")
+            assert "StandbyException" in str(ei.value)
+            with pytest.raises(IOError):
+                ufs.list_status("/")
+            with pytest.raises(IOError):
+                ufs.open("/keep")
+        finally:
+            webhdfs.fail_all = None
+        assert ufs.get_status("/keep") is not None
+
+    def test_type_confusion_returns_false(self, webhdfs):
+        """SPI contract: delete_file(dir) / delete_directory(file) /
+        mkdirs(existing) all answer False, like every sibling dialect."""
+        ufs = self._ufs(webhdfs)
+        ufs.mkdirs("/td/dir")
+        with ufs.create("/td/f") as w:
+            w.write(b"x")
+        assert not ufs.delete_file("/td/dir")
+        assert not ufs.delete_directory("/td/f")
+        assert ufs.get_status("/td/f") is not None  # untouched
+        assert ufs.get_status("/td/dir") is not None
+        assert not ufs.mkdirs("/td/dir")  # pre-existing
+        assert not ufs.mkdirs("/no/parent/deep", create_parent=False)
+        assert ufs.mkdirs("/td/child", create_parent=False)
+
+    def test_user_name_forwarded(self, webhdfs):
+        ufs = self._ufs(webhdfs)
+        ufs.mkdirs("/u")
+        assert ufs.supports_active_sync()
+        # user.name rides every request (Hadoop simple auth)
+        assert webhdfs.users and all(u == "atpu" for u in webhdfs.users)
+        assert ufs.get_status("/u") is not None
+
+
+class TestHdfsActiveSync:
+    def test_external_write_detected_by_sync_point(self, tmp_path,
+                                                   webhdfs):
+        """An EXTERNAL writer (another HDFS client — here: a direct
+        touch of the fake's backing dir) becomes visible after the
+        ActiveSyncManager heartbeat re-syncs the registered sync point
+        (reference: SupportedHdfsActiveSyncProvider.java:28 — push via
+        iNotify there, poll-based diff here by design)."""
+        import os
+
+        from alluxio_tpu.journal import NoopJournalSystem
+        from alluxio_tpu.master import BlockMaster, FileSystemMaster
+        from alluxio_tpu.master.sync import ActiveSyncManager
+
+        journal = NoopJournalSystem()
+        bm = BlockMaster(journal)
+        fsm = FileSystemMaster(bm, journal)
+        root = tmp_path / "ufs_root"
+        os.makedirs(root)
+        fsm.start(str(root))
+        fsm.mount("/wh", webhdfs.uri, properties={"hdfs.user": "atpu"})
+        asm = ActiveSyncManager(fsm, journal)
+
+        ufs = create_ufs(webhdfs.uri)
+        ufs.mkdirs("/data")
+        with ufs.create("/data/seen") as w:
+            w.write(b"1")
+        assert [i.name for i in fsm.list_status("/wh/data")] == ["seen"]
+        asm.add_sync_point("/wh/data")
+
+        # external write, behind the connector's back
+        with open(os.path.join(webhdfs.root, "data", "unseen"),
+                  "wb") as f:
+            f.write(b"external-bytes")
+        # and an external delete
+        os.unlink(os.path.join(webhdfs.root, "data", "seen"))
+
+        asm.heartbeat()  # the ActiveSyncer tick
+        names = [i.name for i in fsm.list_status("/wh/data")]
+        assert names == ["unseen"]
+        assert fsm.get_status("/wh/data/unseen").length == 14
+        _, changed = asm.last_runs["/wh/data"]
+        assert changed  # the run reported a detected change
+        fsm.stop()
+
+
 class TestClusterMountS3:
     def test_mount_and_read_through(self, tmp_path, s3_server):
         """Cold read-through from the fake S3 into the worker cache, then

@@ -1,0 +1,215 @@
+"""In-process fake WebHDFS NameNode backed by a local directory.
+
+Speaks the REST protocol the real NameNode does, including the 307
+CREATE redirect dance (namenode answers 307 with a datanode Location;
+the client must re-PUT the data there). Errors come back as
+``{"RemoteException": ...}`` like Hadoop's. Backing the namespace with a
+plain directory lets tests simulate EXTERNAL writes (another HDFS
+client) by touching the directory behind the connector's back — the
+active-sync detection tests do exactly that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+
+class FakeWebHdfsServer:
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.requests: List[str] = []
+        self.users: List[str] = []  # user.name query param per request
+        #: set to ("StandbyException", "...") to fail every request —
+        #: simulates a standby/safe-mode NameNode
+        self.fail_all: Optional[Tuple[str, str]] = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            # -- helpers ---------------------------------------------------
+            def _parse(self) -> Tuple[str, dict]:
+                parsed = urllib.parse.urlsplit(self.path)
+                q = dict(urllib.parse.parse_qsl(parsed.query))
+                p = urllib.parse.unquote(parsed.path)
+                prefix = "/webhdfs/v1"
+                if p.startswith(prefix):
+                    p = p[len(prefix):] or "/"
+                outer.users.append(q.get("user.name", ""))
+                return p, q
+
+            def _maybe_fail(self) -> bool:
+                if outer.fail_all is not None:
+                    exc, msg = outer.fail_all
+                    self._remote_error(403, exc, msg)
+                    return True
+                return False
+
+            def _local(self, p: str) -> str:
+                return os.path.join(outer.root, p.lstrip("/"))
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _remote_error(self, code: int, exc: str,
+                              msg: str) -> None:
+                self._json(code, {"RemoteException": {
+                    "exception": exc, "javaClassName": f"org.x.{exc}",
+                    "message": msg}})
+
+            def _not_found(self, p: str) -> None:
+                self._remote_error(404, "FileNotFoundException",
+                                   f"File does not exist: {p}")
+
+            def _status_of(self, local: str, suffix: str) -> dict:
+                st = os.stat(local)
+                return {
+                    "pathSuffix": suffix,
+                    "type": "DIRECTORY" if os.path.isdir(local)
+                    else "FILE",
+                    "length": 0 if os.path.isdir(local) else st.st_size,
+                    "modificationTime": int(st.st_mtime * 1000),
+                    "permission": "%o" % (st.st_mode & 0o777),
+                    "owner": "hdfs", "group": "supergroup",
+                    "replication": 3, "blockSize": 128 << 20,
+                }
+
+            # -- verbs -----------------------------------------------------
+            def do_GET(self):  # noqa: N802
+                if self._maybe_fail():
+                    return
+                p, q = self._parse()
+                op = q.get("op", "")
+                outer.requests.append(f"GET {op} {p}")
+                local = self._local(p)
+                if op == "GETFILESTATUS":
+                    if not os.path.exists(local):
+                        return self._not_found(p)
+                    return self._json(200, {
+                        "FileStatus": self._status_of(local, "")})
+                if op == "LISTSTATUS":
+                    if not os.path.isdir(local):
+                        if not os.path.exists(local):
+                            return self._not_found(p)
+                        return self._json(200, {"FileStatuses": {
+                            "FileStatus": [self._status_of(local, "")]}})
+                    return self._json(200, {"FileStatuses": {
+                        "FileStatus": [
+                            self._status_of(os.path.join(local, n), n)
+                            for n in sorted(os.listdir(local))]}})
+                if op == "OPEN":
+                    if not os.path.isfile(local):
+                        return self._not_found(p)
+                    with open(local, "rb") as f:
+                        f.seek(int(q.get("offset", "0")))
+                        data = (f.read(int(q["length"]))
+                                if "length" in q else f.read())
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                self._remote_error(400, "UnsupportedOperationException",
+                                   op)
+
+            def do_PUT(self):  # noqa: N802
+                if self._maybe_fail():
+                    return
+                p, q = self._parse()
+                op = q.get("op", "")
+                outer.requests.append(f"PUT {op} {p}"
+                                      + (" [data]" if q.get("data") else ""))
+                local = self._local(p)
+                if op == "CREATE":
+                    if q.get("data") != "true":
+                        # step 1: redirect to the "datanode" (ourselves)
+                        self.send_response(307)
+                        sep = "&" if urllib.parse.urlsplit(
+                            self.path).query else "?"
+                        self.send_header(
+                            "Location",
+                            f"http://127.0.0.1:{outer.port}"
+                            f"{self.path}{sep}data=true")
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    if os.path.exists(local) and \
+                            q.get("overwrite") != "true":
+                        return self._remote_error(
+                            403, "FileAlreadyExistsException", p)
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = self.rfile.read(n)
+                    os.makedirs(os.path.dirname(local), exist_ok=True)
+                    with open(local, "wb") as f:
+                        f.write(body)
+                    self.send_response(201)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if op == "MKDIRS":
+                    os.makedirs(local, exist_ok=True)
+                    return self._json(200, {"boolean": True})
+                if op == "RENAME":
+                    dst = self._local(q.get("destination", ""))
+                    if not os.path.exists(local):
+                        return self._json(200, {"boolean": False})
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    os.rename(local, dst)
+                    return self._json(200, {"boolean": True})
+                self._remote_error(400, "UnsupportedOperationException",
+                                   op)
+
+            def do_DELETE(self):  # noqa: N802
+                if self._maybe_fail():
+                    return
+                p, q = self._parse()
+                outer.requests.append(f"DELETE {p}")
+                local = self._local(p)
+                if not os.path.exists(local):
+                    return self._json(200, {"boolean": False})
+                if os.path.isdir(local):
+                    if q.get("recursive") != "true" and os.listdir(local):
+                        return self._remote_error(
+                            403, "PathIsNotEmptyDirectoryException", p)
+                    shutil.rmtree(local)
+                else:
+                    os.unlink(local)
+                return self._json(200, {"boolean": True})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def uri(self) -> str:
+        return f"webhdfs://127.0.0.1:{self.port}/"
+
+    def __enter__(self) -> "FakeWebHdfsServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fake-webhdfs")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False

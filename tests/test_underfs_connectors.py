@@ -220,6 +220,33 @@ class TestWebHdfsConnector:
         assert ufs.get_status("/nope") is None
         assert ufs.list_status("/nope") is None
 
+    def test_aborted_create_uploads_nothing(self, webhdfs):
+        """A create aborted by an exception must not upload the partial
+        buffer — not even at GC time when IOBase.__del__ calls close."""
+        import gc
+
+        ufs = self._ufs(webhdfs)
+        with pytest.raises(RuntimeError):
+            with ufs.create("/partial") as w:
+                w.write(b"half-written")
+                raise RuntimeError("writer died")
+        gc.collect()  # a lingering __del__->close must not PUT either
+        assert ufs.get_status("/partial") is None
+
+    def test_open_streams_incrementally(self, webhdfs):
+        """open() hands back the HTTP body as a stream: partial read(n)
+        works and the object is closeable without slurping the rest."""
+        ufs = self._ufs(webhdfs)
+        with ufs.create("/big") as w:
+            w.write(b"ab" * 4096)
+        r = ufs.open("/big")
+        assert r.read(3) == b"aba"
+        assert r.read(2) == b"ba"
+        r.close()
+        r2 = ufs.open("/big", offset=8190)
+        assert r2.read() == b"ab"
+        r2.close()
+
     def test_standby_errors_do_not_read_as_absent(self, webhdfs):
         """A standby/safe-mode NameNode answers RemoteException — that
         must RAISE, never read as 'file deleted': the metadata sync

@@ -137,6 +137,13 @@ class FakeWebHdfsServer:
                 local = self._local(p)
                 if op == "CREATE":
                     if q.get("data") != "true":
+                        if int(self.headers.get("Content-Length",
+                                                "0") or 0):
+                            # protocol: step 1 carries NO file data — a
+                            # real NameNode may hang up mid-body
+                            return self._remote_error(
+                                400, "IllegalArgumentException",
+                                "CREATE step 1 must not carry a body")
                         # step 1: redirect to the "datanode" (ourselves)
                         self.send_response(307)
                         sep = "&" if urllib.parse.urlsplit(

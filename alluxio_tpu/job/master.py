@@ -44,6 +44,8 @@ class _PlanCoordinator:
                             last_updated_ms=clock.millis())
         self.tasks: Dict[int, TaskInfo] = {}
         self._task_ids = itertools.count()
+        #: task_id -> times re-dispatched after a worker loss
+        self._retries: Dict[int, int] = {}
         #: parent workflow, notified on completion
         self.parent: Optional["_WorkflowCoordinator"] = None
 
@@ -87,6 +89,60 @@ class _PlanCoordinator:
                     not Status.is_finished(task.status):
                 task.status = Status.FAILED
                 task.error_message = reason
+        self._maybe_finish()
+
+    MAX_TASK_RETRIES = 2
+
+    def reassign_tasks_of_worker(self, worker_id: int,
+                                 live_workers: List["RegisteredJobWorker"],
+                                 dispatch) -> None:
+        """Failover: a lost worker's unfinished tasks are re-dispatched
+        round-robin onto live workers instead of failing the job.
+        Departure from the reference (its ``PlanCoordinator`` fails the
+        job and leaves retry to the DistributedLoad CLI's outer loop,
+        ``LoadDefinition.java:65`` callers): a mid-load worker loss on a
+        training pod must not restart the whole prefetch — the retry
+        loop belongs in the framework. Per-task retries are capped; when
+        no live worker remains the tasks fail as before.
+
+        Targets are live workers with the FEWEST unfinished tasks of
+        this job — a reassigned load task landing on a worker that
+        already caches its blocks is a no-op, so spreading to
+        uninvolved workers first preserves the most replication. When
+        every live worker is involved (e.g. replication == cluster
+        size) some copies are simply gone with the dead worker; the
+        durable guarantee is ``replication_min`` + ReplicationChecker,
+        not the one-shot job."""
+        victims = [t for t in self.tasks.values()
+                   if t.worker_id == worker_id
+                   and not Status.is_finished(t.status)]
+        if not victims:
+            return
+        if not live_workers:
+            self.fail_tasks_of_worker(worker_id, "no live job workers "
+                                      "left to fail over to")
+            return
+        load = collections.Counter(
+            t.worker_id for t in self.tasks.values()
+            if not Status.is_finished(t.status))
+        targets = sorted(live_workers,
+                         key=lambda w: (load.get(w.worker_id, 0),
+                                        w.worker_id))
+        for i, task in enumerate(victims):
+            retries = self._retries.get(task.task_id, 0)
+            if retries >= self.MAX_TASK_RETRIES:
+                task.status = Status.FAILED
+                task.error_message = (
+                    f"task retried {retries}x after worker losses")
+                continue
+            self._retries[task.task_id] = retries + 1
+            new_wid = targets[i % len(targets)].worker_id
+            task.worker_id = new_wid
+            task.status = Status.CREATED
+            dispatch(new_wid, JobCommand(
+                kind="run", job_id=self.job_id, task_id=task.task_id,
+                job_config=self.config, task_args=task.args))
+        self.info.last_updated_ms = self._clock.millis()
         self._maybe_finish()
 
     def cancel(self) -> List[JobCommand]:
@@ -300,12 +356,20 @@ class JobMaster:
             now = self._clock.millis()
             dead = [wid for wid, t in self._last_contact_ms.items()
                     if now - t > self._worker_timeout_ms]
+            # drop EVERY dead worker first: a mass loss (rack partition)
+            # must not reassign one dead worker's tasks onto the next
+            # dead worker in the same pass, burning the retry cap
             for wid in dead:
                 self._workers.pop(wid, None)
                 self._last_contact_ms.pop(wid, None)
                 self._command_queues.pop(wid, None)
+            live = list(self._workers.values())
+            for wid in dead:
                 for coord in self._coordinators.values():
-                    if hasattr(coord, "fail_tasks_of_worker"):
+                    if hasattr(coord, "reassign_tasks_of_worker"):
+                        coord.reassign_tasks_of_worker(
+                            wid, live, self._dispatch)
+                    elif hasattr(coord, "fail_tasks_of_worker"):
                         coord.fail_tasks_of_worker(
                             wid, f"job worker {wid} lost")
 

@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-files", type=int, default=8)
     p.add_argument("--file-mb", type=int, default=16)
     p.add_argument("--replication", type=int, default=1)
+    p.add_argument("--pressure", action="store_true",
+                   help="size tiers so eviction fires mid-load")
+    p.add_argument("--kill-worker", action="store_true",
+                   help="stop a worker mid-job; plan must survive")
 
     t = sub.add_parser("table", help="column projection (config #4)")
     t.add_argument("--master", default=None)
@@ -98,6 +102,10 @@ SUITE = (
                            "--fixed-count", "2000"]),
     ("prefetch", ["prefetch", "--num-workers", "4", "--num-files", "8",
                   "--file-mb", "16"]),
+    ("prefetch-fault-drill", ["prefetch", "--num-workers", "4",
+                              "--num-files", "8", "--file-mb", "8",
+                              "--replication", "2", "--pressure",
+                              "--kill-worker"]),
     ("table-projection", ["table"]),
     ("write-eviction", ["write"]),
 )
@@ -178,7 +186,8 @@ def main(argv=None) -> int:
 
         r = run(num_workers=args.num_workers, num_files=args.num_files,
                 file_bytes=args.file_mb << 20,
-                replication=args.replication)
+                replication=args.replication, pressure=args.pressure,
+                kill_worker=args.kill_worker)
     elif args.bench == "table":
         from alluxio_tpu.stress.table_bench import run
 

@@ -21,7 +21,16 @@ from alluxio_tpu.stress.cluster import bench_cluster
 def run(*, master: Optional[str] = None, num_workers: int = 4,
         num_files: int = 8, file_bytes: int = 16 << 20,
         replication: int = 1, block_size: int = 4 << 20,
-        base_path: str = "/stress-prefetch") -> BenchResult:
+        base_path: str = "/stress-prefetch",
+        pressure: bool = False, kill_worker: bool = False,
+        rereplicate_timeout_s: float = 120.0) -> BenchResult:
+    """``pressure=True`` sizes worker tiers so eviction must fire
+    mid-load (tiers are pre-filled with MUST_CACHE filler the load then
+    evicts). ``kill_worker=True`` stops one worker (block + job) while
+    the load job runs; the plan must still COMPLETE (task failover) and
+    the replication checker must restore the killed worker's copies —
+    the failure envelope ``LoadDefinition.java:65``-style fan-out exists
+    to survive."""
     from alluxio_tpu.client.streams import WriteType
 
     if master:
@@ -31,20 +40,40 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
 
     rng = np.random.default_rng(0)
     total = num_files * file_bytes
+    per_worker_corpus = -(-total * max(replication, 1) // num_workers)
+    mem = (per_worker_corpus + 2 * block_size + (8 << 20)) if pressure \
+        else total + (128 << 20)
+    overrides = {Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL: "50ms"}
+    if pressure:
+        # single tier: MEM eviction must DROP blocks, not cascade-demote
+        # into the default 64MB SSD tier (which would absorb the whole
+        # pressure corpus and prove nothing)
+        overrides[Keys.WORKER_TIERED_STORE_LEVELS] = 1
+    if kill_worker:
+        # the master must notice the kill quickly: lost-worker
+        # detection drops its block locations, which is what arms the
+        # replication checker
+        overrides[Keys.MASTER_WORKER_TIMEOUT] = "2s"
+        overrides[Keys.JOB_MASTER_WORKER_TIMEOUT] = "2s"
     with bench_cluster(None, num_workers=num_workers,
                        block_size=block_size,
-                       worker_mem_bytes=total + (128 << 20),
+                       worker_mem_bytes=mem,
                        start_job_service=True,
                        start_worker_heartbeats=True,
-                       conf_overrides={
-                           Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL: "50ms",
-                       }) as (fs, cluster):
+                       conf_overrides=overrides) as (fs, cluster):
         # THROUGH: persisted to the UFS, cached nowhere — the cold corpus
         payload = rng.integers(0, 255, size=file_bytes, dtype=np.uint8
                                ).tobytes()
         for i in range(num_files):
             fs.write_all(f"{base_path}/f-{i:05d}", payload,
                          write_type=WriteType.THROUGH)
+            if kill_worker:
+                # durable replication is replication_min's contract —
+                # that's what arms the ReplicationChecker to re-create
+                # the killed worker's copies (the load job itself is a
+                # one-shot prefetch, reference ReplicationChecker.java:57)
+                fs.set_attribute(f"{base_path}/f-{i:05d}",
+                                 replication_min=max(replication, 1))
         # THROUGH frees the cached copy asynchronously (worker heartbeat
         # applies the Free command): wait until the corpus is truly cold
         deadline = time.monotonic() + 60.0
@@ -56,31 +85,127 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
                     if time.monotonic() > deadline:
                         raise RuntimeError("corpus never went cold")
                     time.sleep(0.02)
+        filler_paths = []
+        if pressure:
+            # fill ~the whole cluster capacity so the load can only
+            # proceed by EVICTING (MUST_CACHE filler; LRU/LRFU decides
+            # what goes; the last writes may already evict earlier
+            # filler — that's the point)
+            filler_each = max(block_size, mem // 2 - (1 << 20))
+            fill = rng.integers(0, 255, size=filler_each,
+                                dtype=np.uint8).tobytes()
+            for w in range(num_workers * 2):
+                p = f"{base_path}-fill/f-{w}"
+                try:
+                    fs.write_all(p, fill,
+                                 write_type=WriteType.MUST_CACHE)
+                    filler_paths.append(p)
+                except Exception:  # noqa: BLE001 tier genuinely full
+                    break
+
+        killed_mid_job = False
+        filler_prekill: dict = {}
+        if kill_worker:
+            # snapshot filler residency BEFORE the job: the post-kill
+            # eviction accounting compares against this to tell
+            # "evicted by pressure" from "lost with the worker" (a
+            # snapshot at kill time would miss blocks the job already
+            # evicted and under-count)
+            for p in filler_paths:
+                for fbi in fs.fs_master.get_file_block_info_list(p):
+                    hosts = {loc.address.tiered_identity.value("host")
+                             for loc in fbi.block_info.locations}
+                    filler_prekill[(p, fbi.block_info.block_id)] = hosts
+
         job_client = cluster.job_client()
         t0 = time.monotonic()
         job_id = job_client.run({"type": "load", "path": base_path,
                                  "replication": replication})
+        if kill_worker:
+            # gate the kill on the job being observed RUNNING with
+            # unfinished tasks — a fixed sleep races a fast load and
+            # the drill would pass without exercising failover
+            gate = time.monotonic() + 10.0
+            while time.monotonic() < gate:
+                ji = job_client.get_status(job_id)
+                unfinished = [t for t in ji.tasks
+                              if t.status not in ("COMPLETED", "FAILED",
+                                                  "CANCELED")]
+                if ji.status == "RUNNING" and unfinished:
+                    killed_mid_job = True
+                    break
+                if ji.status != "RUNNING" and ji.status != "CREATED":
+                    break  # job already finished: kill is post-job
+                time.sleep(0.002)
+            cluster.workers[0].stop()
+            cluster.job_workers[0].stop()
         info = job_client.wait_for_job(job_id, timeout_s=300.0)
         wall = time.monotonic() - t0
         if info.status != "COMPLETED":
             raise RuntimeError(
                 f"load job {job_id} ended {info.status}: "
                 f"{info.error_message}")
-        # verify every block is cached with the requested replication
-        blocks = cached = 0
-        for i in range(num_files):
-            for fbi in fs.fs_master.get_file_block_info_list(
-                    f"{base_path}/f-{i:05d}"):
-                blocks += 1
-                if len(fbi.block_info.locations) >= replication:
-                    cached += 1
+
+        def replication_counts():
+            blocks = cached = 0
+            for i in range(num_files):
+                for fbi in fs.fs_master.get_file_block_info_list(
+                        f"{base_path}/f-{i:05d}"):
+                    blocks += 1
+                    if len(fbi.block_info.locations) >= replication:
+                        cached += 1
+            return blocks, cached
+
+        blocks, cached = replication_counts()
+        rerepl_wait = 0.0
+        if kill_worker:
+            # the killed worker's copies must come back: lost-worker
+            # detection drops its locations, the ReplicationChecker
+            # re-issues replicate jobs until the target holds again
+            t1 = time.monotonic()
+            deadline = t1 + rereplicate_timeout_s
+            while cached < blocks and time.monotonic() < deadline:
+                time.sleep(0.25)
+                blocks, cached = replication_counts()
+            rerepl_wait = time.monotonic() - t1
+            if cached < blocks:
+                raise RuntimeError(
+                    f"re-replication never converged: {cached}/{blocks} "
+                    f"blocks at replication {replication} after "
+                    f"{rereplicate_timeout_s:.0f}s")
+        evicted_filler = 0
+        if pressure:
+            for p in filler_paths:
+                dropped_by_live = False
+                for fbi in fs.fs_master.get_file_block_info_list(p):
+                    cur = {loc.address.tiered_identity.value("host")
+                           for loc in fbi.block_info.locations}
+                    pre = filler_prekill.get(
+                        (p, fbi.block_info.block_id))
+                    if pre is None:  # no kill: any miss is an eviction
+                        if not cur:
+                            dropped_by_live = True
+                    elif (pre - {"localhost-w0"}) - cur:
+                        # a host OTHER than the killed one dropped the
+                        # block -> genuine pressure eviction, not loss
+                        dropped_by_live = True
+                if dropped_by_live:
+                    evicted_filler += 1
+            if not evicted_filler:
+                raise RuntimeError(
+                    "pressure drill never forced an eviction — tier "
+                    "sizing is wrong, the drill proved nothing")
         moved = total * replication
         return BenchResult(
             bench="distributed-prefetch",
             params={"num_workers": num_workers, "num_files": num_files,
                     "file_bytes": file_bytes, "replication": replication,
-                    "block_size": block_size},
+                    "block_size": block_size, "pressure": pressure,
+                    "worker_killed": kill_worker},
             metrics={"gb_per_s": round(moved / wall / 1e9, 3),
                      "mb_per_s": round(moved / wall / 1e6, 2),
-                     "blocks": blocks, "blocks_at_replication": cached},
+                     "blocks": blocks, "blocks_at_replication": cached,
+                     "evicted_filler_files": evicted_filler,
+                     "killed_mid_job": killed_mid_job,
+                     "rereplication_wait_s": round(rerepl_wait, 2)},
             errors=blocks - cached, duration_s=wall)

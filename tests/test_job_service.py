@@ -257,6 +257,109 @@ class TestReplicationControl:
             timeout_s=15.0)
 
 
+def _jw(wid):
+    from alluxio_tpu.job.master import RegisteredJobWorker
+    from alluxio_tpu.job.wire import JobWorkerHealth
+
+    return RegisteredJobWorker(
+        worker_id=wid, hostname=f"h{wid}",
+        health=JobWorkerHealth(worker_id=wid, hostname=f"h{wid}"))
+
+
+def _fake_plan(executors, join=lambda results: None):
+    class _Plan:
+        name = "fake"
+
+        def select_executors(self, config, workers, ctx):
+            return executors
+
+        def join(self, config, results):
+            return join(results)
+
+    return _Plan()
+
+
+def _coordinator(job_id, plan, workers, dispatch=lambda *a: None):
+    from alluxio_tpu.job.master import _PlanCoordinator
+    from alluxio_tpu.utils.clock import ManualClock
+
+    coord = _PlanCoordinator(job_id, {}, plan, ManualClock())
+    coord.start(workers, None, dispatch)
+    return coord
+
+
+class TestTaskFailover:
+    def test_reassign_tasks_of_lost_worker(self):
+        """A lost worker's unfinished tasks re-dispatch onto live
+        workers (capped retries) instead of failing the job."""
+        sent = []
+        plan = _fake_plan([(1, {"n": 0}), (1, {"n": 1}), (2, {"n": 2})],
+                          join=lambda rs: {"joined": sorted(rs)})
+        coord = _coordinator(7, plan, [_jw(1), _jw(2)],
+                             lambda wid, cmd: sent.append((wid, cmd)))
+        assert len(sent) == 3 and coord.info.status == Status.RUNNING
+
+        # worker 1 dies with both its tasks unfinished
+        coord.reassign_tasks_of_worker(
+            1, [_jw(2)], lambda wid, cmd: sent.append((wid, cmd)))
+        redispatched = sent[3:]
+        assert [w for w, _ in redispatched] == [2, 2]
+        assert all(t.worker_id == 2 for t in coord.tasks.values())
+        assert coord.info.status == Status.RUNNING  # NOT failed
+
+        # finishing the re-dispatched tasks completes the job
+        for cmd_wid, cmd in redispatched:
+            coord.on_task_update(cmd.task_id, Status.COMPLETED,
+                                 cmd.task_args["n"], "")
+        coord.on_task_update(2, Status.COMPLETED, 2, "")
+        assert coord.info.status == Status.COMPLETED
+        assert coord.info.result == {"joined": [0, 1, 2]}
+
+    def test_retry_cap_fails_task(self):
+        from alluxio_tpu.job.master import _PlanCoordinator
+
+        coord = _coordinator(8, _fake_plan([(1, {})]), [_jw(1)])
+        for _loss in range(_PlanCoordinator.MAX_TASK_RETRIES + 1):
+            wid = coord.tasks[0].worker_id
+            coord.reassign_tasks_of_worker(
+                wid, [_jw(wid + 1)], lambda *a: None)
+        assert coord.info.status == Status.FAILED
+        assert "retried" in coord.tasks[0].error_message
+
+    def test_no_live_workers_fails_job(self):
+        coord = _coordinator(9, _fake_plan([(1, {})]), [_jw(1)])
+        coord.reassign_tasks_of_worker(1, [], lambda *a: None)
+        assert coord.info.status == Status.FAILED
+
+    def test_reassignment_prefers_uninvolved_workers(self):
+        """Targets spread to the live worker with the fewest unfinished
+        tasks of this job — it's likeliest NOT to already hold the
+        blocks (a verbatim re-run there is a no-op)."""
+        sent = []
+        plan = _fake_plan([(1, {"n": 0}), (2, {"n": 1})])
+        coord = _coordinator(10, plan, [_jw(1), _jw(2), _jw(3)],
+                             lambda wid, cmd: sent.append(wid))
+        coord.reassign_tasks_of_worker(1, [_jw(2), _jw(3)],
+                                       lambda wid, cmd: sent.append(wid))
+        # w3 has no task of this job; w2 already has one -> w3 chosen
+        assert sent[2:] == [3]
+
+    def test_fault_drill_end_to_end(self, tmp_path):
+        """The full drill at tiny scale: replication 2 + eviction
+        pressure + a worker killed mid-load; the plan completes and
+        every block ends at replication (round-3/4 verdict ask #7)."""
+        from alluxio_tpu.stress.prefetch_bench import run
+
+        r = run(num_workers=3, num_files=4, file_bytes=2 << 20,
+                block_size=1 << 20, replication=2, pressure=True,
+                kill_worker=True)
+        assert r.errors == 0
+        assert r.metrics["blocks_at_replication"] == r.metrics["blocks"]
+        assert r.metrics["evicted_filler_files"] > 0
+        assert r.metrics["killed_mid_job"] is True  # failover exercised
+        assert r.params["worker_killed"] is True
+
+
 class TestJobMasterBehaviors:
     def test_cancel_unknown_job(self, cluster):
         from alluxio_tpu.utils.exceptions import JobDoesNotExistError

@@ -122,6 +122,13 @@ class _PlanCoordinator:
             self.fail_tasks_of_worker(worker_id, "no live job workers "
                                       "left to fail over to")
             return
+        if not getattr(self.plan, "relocatable", False):
+            # host-affine tasks (evict and friends) must not run on a
+            # different worker — they'd act on the wrong replica
+            self.fail_tasks_of_worker(
+                worker_id, f"job worker {worker_id} lost "
+                f"({self.plan.name} tasks are host-affine)")
+            return
         load = collections.Counter(
             t.worker_id for t in self.tasks.values()
             if not Status.is_finished(t.status))

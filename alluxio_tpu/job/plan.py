@@ -56,6 +56,11 @@ class RunTaskContext:
 class PlanDefinition:
     #: registry key; job configs carry {"type": name, ...}
     name = ""
+    #: True when a task's effect is the same on ANY worker (cache/copy/
+    #: persist work), letting the coordinator re-dispatch a lost
+    #: worker's tasks. Host-AFFINE tasks (evict: "remove MY copy") must
+    #: stay False — run elsewhere they'd destroy a healthy replica.
+    relocatable = False
 
     def select_executors(self, config: Dict[str, Any],
                          workers: List[RegisteredJobWorker],

@@ -32,6 +32,7 @@ def _expand_files(ctx: SelectContext, path: str, recursive: bool) -> List:
 
 class LoadDefinition(PlanDefinition):
     name = "load"
+    relocatable = True  # caching a block is valid on any worker
 
     def select_executors(self, config: Dict[str, Any],
                          workers: List[RegisteredJobWorker],

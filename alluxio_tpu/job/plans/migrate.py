@@ -23,6 +23,7 @@ from alluxio_tpu.utils.uri import AlluxioURI
 
 class MigrateDefinition(PlanDefinition):
     name = "migrate"
+    relocatable = True  # copy/move work is worker-agnostic
 
     def select_executors(self, config: Dict[str, Any],
                          workers: List[RegisteredJobWorker],

@@ -22,6 +22,7 @@ from alluxio_tpu.utils.exceptions import (
 
 class PersistDefinition(PlanDefinition):
     name = "persist"
+    relocatable = True  # any worker can write the UFS copy
 
     def select_executors(self, config: Dict[str, Any],
                          workers: List[RegisteredJobWorker],

@@ -30,6 +30,9 @@ def _local_block_worker(ctx: RunTaskContext):
 
 class ReplicateDefinition(PlanDefinition):
     name = "replicate"
+    # receiving a new copy is valid on any non-holder; a holder re-run
+    # is a no-op the checker cleans up next tick
+    relocatable = True
 
     def select_executors(self, config: Dict[str, Any],
                          workers: List[RegisteredJobWorker],

@@ -257,6 +257,32 @@ class FileSystemMaster:
             return [status.to_wire()] if wire else [status]
         if load_direct_children:
             self._load_children_if_needed(uri, force=synced)
+            if recursive:
+                # DescendantType.ALL semantics (reference
+                # ``InodeSyncStream``): a recursive listing must surface
+                # UNLOADED UFS subtrees too — walk each directory's
+                # children before the locked emit (UFS IO cannot run
+                # under the tree lock). The child inode's
+                # ``direct_children_loaded`` flag is read in the same
+                # lock pass as the traversal, so a warm subtree costs
+                # one lookup per directory and zero load calls.
+                queue = [uri]
+                while queue:
+                    d = queue.pop()
+                    with self.inode_tree.lock.read_locked():
+                        lk = self.inode_tree.lookup(d)
+                        if not lk.exists or not lk.inode.is_directory:
+                            continue
+                        subdirs = [(c.name, c.direct_children_loaded)
+                                   for c in
+                                   self.inode_tree.children(lk.inode)
+                                   if c.is_directory]
+                    for name, loaded in subdirs:
+                        child = d.join(name)
+                        if synced or not loaded:
+                            self._load_children_if_needed(child,
+                                                          force=synced)
+                        queue.append(child)
         info = self._file_info_dict if wire else self._file_info
         out: List[FileInfo] = []
         with self.inode_tree.lock.read_locked():

@@ -67,13 +67,6 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
         for i in range(num_files):
             fs.write_all(f"{base_path}/f-{i:05d}", payload,
                          write_type=WriteType.THROUGH)
-            if kill_worker:
-                # durable replication is replication_min's contract —
-                # that's what arms the ReplicationChecker to re-create
-                # the killed worker's copies (the load job itself is a
-                # one-shot prefetch, reference ReplicationChecker.java:57)
-                fs.set_attribute(f"{base_path}/f-{i:05d}",
-                                 replication_min=max(replication, 1))
         # THROUGH frees the cached copy asynchronously (worker heartbeat
         # applies the Free command): wait until the corpus is truly cold
         deadline = time.monotonic() + 60.0
@@ -121,10 +114,20 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
         t0 = time.monotonic()
         job_id = job_client.run({"type": "load", "path": base_path,
                                  "replication": replication})
+        killed_host = ""
         if kill_worker:
+            # arm durable-replication recovery NOW (not at write time:
+            # a replication_min on a still-cold corpus would have the
+            # 0.1s-tick checker churn failing replicate jobs for the
+            # whole cold-wait, and race the measured load)
+            for i in range(num_files):
+                fs.set_attribute(f"{base_path}/f-{i:05d}",
+                                 replication_min=max(replication, 1))
             # gate the kill on the job being observed RUNNING with
             # unfinished tasks — a fixed sleep races a fast load and
-            # the drill would pass without exercising failover
+            # the drill would pass without exercising failover. 20ms:
+            # tasks take at least one 50ms worker heartbeat to be
+            # pulled, and get_status serializes the task list.
             gate = time.monotonic() + 10.0
             while time.monotonic() < gate:
                 ji = job_client.get_status(job_id)
@@ -136,8 +139,11 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
                     break
                 if ji.status != "RUNNING" and ji.status != "CREATED":
                     break  # job already finished: kill is post-job
-                time.sleep(0.002)
-            cluster.workers[0].stop()
+                time.sleep(0.02)
+            victim = cluster.workers[0]
+            killed_host = victim.worker.address.tiered_identity.value(
+                "host")
+            victim.stop()
             cluster.job_workers[0].stop()
         info = job_client.wait_for_job(job_id, timeout_s=300.0)
         wall = time.monotonic() - t0
@@ -185,7 +191,7 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
                     if pre is None:  # no kill: any miss is an eviction
                         if not cur:
                             dropped_by_live = True
-                    elif (pre - {"localhost-w0"}) - cur:
+                    elif (pre - {killed_host}) - cur:
                         # a host OTHER than the killed one dropped the
                         # block -> genuine pressure eviction, not loss
                         dropped_by_live = True

@@ -266,7 +266,8 @@ def _jw(wid):
         health=JobWorkerHealth(worker_id=wid, hostname=f"h{wid}"))
 
 
-def _fake_plan(executors, join=lambda results: None):
+def _fake_plan(executors, join=lambda results: None,
+               relocatable=True):
     class _Plan:
         name = "fake"
 
@@ -276,6 +277,7 @@ def _fake_plan(executors, join=lambda results: None):
         def join(self, config, results):
             return join(results)
 
+    _Plan.relocatable = relocatable
     return _Plan()
 
 
@@ -330,6 +332,31 @@ class TestTaskFailover:
         coord = _coordinator(9, _fake_plan([(1, {})]), [_jw(1)])
         coord.reassign_tasks_of_worker(1, [], lambda *a: None)
         assert coord.info.status == Status.FAILED
+
+    def test_host_affine_plans_fail_instead_of_relocating(self):
+        """Evict-style tasks act on the RUNNING worker's own replica —
+        re-running one elsewhere would destroy a healthy copy, so
+        non-relocatable plans fail their lost tasks (old behavior)."""
+        sent = []
+        coord = _coordinator(
+            11, _fake_plan([(1, {})], relocatable=False), [_jw(1)],
+            lambda wid, cmd: sent.append(wid))
+        coord.reassign_tasks_of_worker(
+            1, [_jw(2)], lambda wid, cmd: sent.append(wid))
+        assert coord.info.status == Status.FAILED
+        assert "host-affine" in coord.tasks[0].error_message
+        assert sent == [1]  # nothing re-dispatched
+
+    def test_real_plan_relocatability_flags(self):
+        from alluxio_tpu.job.plans.load import LoadDefinition
+        from alluxio_tpu.job.plans.replicate import (
+            EvictDefinition, MoveDefinition, ReplicateDefinition,
+        )
+
+        assert LoadDefinition.relocatable
+        assert ReplicateDefinition.relocatable
+        assert not EvictDefinition.relocatable
+        assert not MoveDefinition.relocatable
 
     def test_reassignment_prefers_uninvolved_workers(self):
         """Targets spread to the live worker with the fewest unfinished

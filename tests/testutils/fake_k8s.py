@@ -1,0 +1,164 @@
+"""In-process fake Kubernetes API server for the Dataset CRD: list,
+merge-PATCH on the object and its status subresource, and the
+finalizer/deletionTimestamp dance (delete with finalizers pends; the
+object vanishes once the controller strips its finalizer)."""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from alluxio_tpu.operator.controller import GROUP, PLURAL, VERSION
+
+
+class FakeK8sApiServer:
+    def __init__(self, namespace: str = "default") -> None:
+        self.namespace = namespace
+        #: name -> CR dict
+        self.objects: Dict[str, dict] = {}
+        self.requests: List[str] = []
+        self._lock = threading.Lock()
+        outer = self
+        prefix = (f"/apis/{GROUP}/{VERSION}/namespaces/{namespace}"
+                  f"/{PLURAL}")
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self):
+                p = urllib.parse.urlsplit(self.path).path
+                if not p.startswith(prefix):
+                    return None
+                rest = p[len(prefix):].strip("/")
+                return rest.split("/") if rest else []
+
+            def do_GET(self):  # noqa: N802
+                parts = self._route()
+                outer.requests.append(f"GET {self.path}")
+                if parts is None:
+                    return self._json(404, {"message": "not found"})
+                with outer._lock:
+                    if not parts:
+                        return self._json(200, {
+                            "apiVersion": f"{GROUP}/{VERSION}",
+                            "kind": "DatasetList",
+                            "items": [copy.deepcopy(o) for o in
+                                      outer.objects.values()]})
+                    obj = outer.objects.get(parts[0])
+                    if obj is None:
+                        return self._json(404, {"message": parts[0]})
+                    return self._json(200, copy.deepcopy(obj))
+
+            def do_PATCH(self):  # noqa: N802
+                parts = self._route()
+                outer.requests.append(f"PATCH {self.path}")
+                if not parts:
+                    return self._json(404, {"message": "bad path"})
+                n = int(self.headers.get("Content-Length", "0"))
+                patch = json.loads(self.rfile.read(n) or b"{}")
+                with outer._lock:
+                    obj = outer.objects.get(parts[0])
+                    if obj is None:
+                        return self._json(404, {"message": parts[0]})
+                    if len(parts) > 1 and parts[1] == "status":
+                        obj.setdefault("status", {}).update(
+                            patch.get("status", {}))
+                    else:
+                        md = dict(patch.get("metadata", {}))
+                        # optimistic concurrency, like the real API
+                        # server: a stale resourceVersion conflicts
+                        rv = md.pop("resourceVersion", None)
+                        if rv is not None and str(rv) != str(
+                                obj["metadata"].get(
+                                    "resourceVersion", "")):
+                            return self._json(409, {
+                                "message": "the object has been "
+                                           "modified"})
+                        obj["metadata"].update(md)
+                        obj["metadata"]["resourceVersion"] = str(
+                            int(obj["metadata"].get(
+                                "resourceVersion", "0")) + 1)
+                        # k8s GC: deletion pending + no finalizers
+                        # -> object goes away
+                        if obj["metadata"].get("deletionTimestamp") \
+                                and not obj["metadata"].get(
+                                    "finalizers"):
+                            del outer.objects[parts[0]]
+                    return self._json(200, copy.deepcopy(obj))
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- test-side CR management --------------------------------------------
+    def create(self, name: str, spec: dict, generation: int = 1) -> None:
+        with self._lock:
+            self.objects[name] = {
+                "apiVersion": f"{GROUP}/{VERSION}", "kind": "Dataset",
+                "metadata": {"name": name,
+                             "namespace": self.namespace,
+                             "generation": generation,
+                             "resourceVersion": "1"},
+                "spec": spec, "status": {}}
+
+    def update_spec(self, name: str, spec: dict) -> None:
+        with self._lock:
+            obj = self.objects[name]
+            obj["spec"] = spec
+            obj["metadata"]["generation"] = \
+                obj["metadata"].get("generation", 1) + 1
+            obj["metadata"]["resourceVersion"] = str(
+                int(obj["metadata"].get("resourceVersion", "0")) + 1)
+
+    def delete(self, name: str) -> None:
+        """kubectl delete: sets deletionTimestamp; with finalizers the
+        object pends until the controller strips them."""
+        with self._lock:
+            obj = self.objects.get(name)
+            if obj is None:
+                return
+            if obj["metadata"].get("finalizers"):
+                obj["metadata"]["deletionTimestamp"] = \
+                    "2026-01-01T00:00:00Z"
+                obj["metadata"]["resourceVersion"] = str(
+                    int(obj["metadata"].get(
+                        "resourceVersion", "0")) + 1)
+            else:
+                del self.objects[name]
+
+    def status_of(self, name: str) -> dict:
+        with self._lock:
+            return copy.deepcopy(
+                self.objects.get(name, {}).get("status", {}))
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self) -> "FakeK8sApiServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fake-k8s")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False

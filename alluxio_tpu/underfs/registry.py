@@ -60,9 +60,11 @@ _OPTIONAL_CONNECTORS = (
     ("alluxio_tpu.underfs.web", "WebUnderFileSystem", ("http", "https")),
     ("alluxio_tpu.underfs.s3", "S3UnderFileSystem", ("s3", "s3a")),
     ("alluxio_tpu.underfs.gcs", "GcsUnderFileSystem", ("gs", "gcs")),
-    ("alluxio_tpu.underfs.s3_compat", "OssUnderFileSystem", None),
-    ("alluxio_tpu.underfs.s3_compat", "CosUnderFileSystem", None),
-    ("alluxio_tpu.underfs.s3_compat", "KodoUnderFileSystem", None),
+    # oss/cos/kodo dispatch by dialect: the vendor's NATIVE auth when
+    # <vendor>.dialect=native, the S3-compatible gateway otherwise
+    ("alluxio_tpu.underfs.s3_compat", "create_oss_ufs", None),
+    ("alluxio_tpu.underfs.s3_compat", "create_cos_ufs", None),
+    ("alluxio_tpu.underfs.s3_compat", "create_kodo_ufs", None),
     # swift dispatches by dialect: Keystone-native when swift.auth.url
     # is set, S3-middleware gateway otherwise (underfs/swift.py)
     ("alluxio_tpu.underfs.swift", "create_swift_ufs", ("swift",)),

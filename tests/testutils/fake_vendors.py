@@ -1,0 +1,458 @@
+"""In-process fakes for the native vendor dialects: Alibaba OSS header
+signing, Tencent COS q-signature, Qiniu Kodo QBox/uptoken/private-URL.
+Each fake RECOMPUTES the signature server-side from the known secret and
+rejects mismatches — the tests prove the wire auth, not just the ops
+(the ``fake_azure``/``fake_glue`` stance)."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+def _hmac_sha1(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha1).digest()
+
+
+def _xml_escape(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+class _Store:
+    """bucket-level object map shared by a fake server."""
+
+    def __init__(self) -> None:
+        self.objects: Dict[str, bytes] = {}
+        self.lock = threading.Lock()
+
+    def listing_xml(self, prefix: str, marker: str,
+                    max_keys: int) -> bytes:
+        with self.lock:
+            keys = sorted(k for k in self.objects
+                          if k.startswith(prefix) and k > marker)
+        page, rest = keys[:max_keys], keys[max_keys:]
+        items = "".join(
+            f"<Contents><Key>{_xml_escape(k)}</Key>"
+            f"<Size>{len(self.objects[k])}</Size></Contents>"
+            for k in page)
+        trunc = "true" if rest else "false"
+        nm = f"<NextMarker>{_xml_escape(page[-1])}</NextMarker>" \
+            if rest else ""
+        return (f"<?xml version='1.0'?><ListBucketResult>"
+                f"<IsTruncated>{trunc}</IsTruncated>{nm}{items}"
+                f"</ListBucketResult>").encode()
+
+
+class _XmlVendorHandlerBase(BaseHTTPRequestHandler):
+    """Path-style S3-shaped ops; subclass hooks do the vendor auth."""
+
+    server_ref = None  # set by the server factory
+
+    def log_message(self, *a):  # noqa: N802
+        pass
+
+    # -- helpers -------------------------------------------------------------
+    def _split(self) -> Tuple[str, str, Dict[str, str]]:
+        parsed = urllib.parse.urlsplit(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query,
+                                        keep_blank_values=True))
+        parts = urllib.parse.unquote(parsed.path).lstrip("/").split(
+            "/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key, q
+
+    def _send(self, code: int, body: bytes = b"",
+              headers: Optional[Dict[str, str]] = None) -> None:
+        headers = dict(headers or {})
+        self.send_response(code)
+        # an explicit Content-Length (HEAD advertising the object size)
+        # wins; emitting both would be a malformed double header
+        explicit_len = headers.pop("Content-Length", None)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length",
+                         explicit_len if explicit_len is not None
+                         else str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _verify(self, body: bytes) -> bool:
+        raise NotImplementedError
+
+    def _handle(self) -> None:
+        srv = self.server_ref
+        body = self._body()
+        if not self._verify(body):
+            srv.auth_failures += 1
+            return self._send(403, b"<Error>SignatureDoesNotMatch"
+                                   b"</Error>")
+        bucket, key, q = self._split()
+        store = srv.store
+        m = self.command
+        if m == "GET" and not key:
+            return self._send(200, store.listing_xml(
+                q.get("prefix", ""), q.get("marker", ""),
+                int(q.get("max-keys", "1000"))))
+        with store.lock:
+            if m == "PUT" and srv.copy_header in self.headers:
+                src = urllib.parse.unquote(
+                    self.headers[srv.copy_header]).lstrip("/")
+                src_key = src.split("/", 1)[1]
+                if src_key not in store.objects:
+                    return self._send(404)
+                store.objects[key] = store.objects[src_key]
+                return self._send(200, b"<CopyObjectResult/>")
+            if m == "PUT":
+                store.objects[key] = body
+                return self._send(200)
+            if m in ("GET", "HEAD"):
+                data = store.objects.get(key)
+                if data is None:
+                    return self._send(404)
+                rng = self.headers.get("Range", "")
+                code = 200
+                if rng:
+                    mm = re.match(r"bytes=(\d+)-(\d*)", rng)
+                    if mm:
+                        start = int(mm.group(1))
+                        end = int(mm.group(2)) if mm.group(2) else \
+                            len(data) - 1
+                        data = data[start:end + 1]
+                        code = 206
+                return self._send(code, data if m == "GET" else b"", {
+                    "Content-Length": str(len(data)),
+                    "ETag": '"%s"' % hashlib.md5(data).hexdigest(),
+                    "Last-Modified":
+                        "Wed, 01 Jan 2025 00:00:00 GMT"})
+            if m == "DELETE":
+                store.objects.pop(key, None)
+                return self._send(204)
+        self._send(400)
+
+    do_GET = do_PUT = do_DELETE = do_HEAD = _handle  # noqa: N815
+
+
+class _VendorServerBase:
+    copy_header = ""
+
+    def __init__(self, handler_cls, access_key: str,
+                 secret_key: str) -> None:
+        self.access_key, self.secret_key = access_key, secret_key
+        self.store = _Store()
+        self.auth_failures = 0
+        handler = type("H", (handler_cls,), {"server_ref": self})
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False
+
+
+# ---------------------------------------------------------------- OSS ----
+class _OssHandler(_XmlVendorHandlerBase):
+    def _verify(self, body: bytes) -> bool:
+        srv = self.server_ref
+        auth = self.headers.get("Authorization", "")
+        m = re.match(r"OSS ([^:]+):(.+)$", auth)
+        if not m or m.group(1) != srv.access_key:
+            return False
+        bucket, key, q = self._split()
+        oss_headers = "".join(
+            f"{k.lower()}:{self.headers[k]}\n"
+            for k in sorted(self.headers.keys(), key=str.lower)
+            if k.lower().startswith("x-oss-"))
+        resource = f"/{bucket}/{key}"
+        sub = sorted((k, v) for k, v in q.items()
+                     if k in ("uploads", "uploadId", "partNumber"))
+        if sub:
+            resource += "?" + urllib.parse.urlencode(sub)
+        canonical = "\n".join([
+            self.command, self.headers.get("Content-MD5", ""),
+            self.headers.get("Content-Type", ""),
+            self.headers.get("Date", ""), oss_headers + resource])
+        want = base64.b64encode(_hmac_sha1(
+            srv.secret_key.encode(), canonical.encode())).decode()
+        return hmac.compare_digest(want, m.group(2))
+
+
+class FakeOssServer(_VendorServerBase):
+    copy_header = "x-oss-copy-source"
+
+    def __init__(self, access_key="oss-ak", secret_key="oss-sk"):
+        super().__init__(_OssHandler, access_key, secret_key)
+
+
+# ---------------------------------------------------------------- COS ----
+class _CosHandler(_XmlVendorHandlerBase):
+    def _verify(self, body: bytes) -> bool:
+        srv = self.server_ref
+        auth = dict(p.split("=", 1) for p in
+                    self.headers.get("Authorization", "").split("&")
+                    if "=" in p)
+        if auth.get("q-ak") != srv.access_key or \
+                auth.get("q-sign-algorithm") != "sha1":
+            return False
+        key_time = auth.get("q-key-time", "")
+        sign_key = hmac.new(srv.secret_key.encode(),
+                            key_time.encode(), hashlib.sha1).hexdigest()
+        _, _, q = self._split()
+        header_list = auth.get("q-header-list", "")
+        signed_headers = header_list.split(";") if header_list else []
+        h_items = sorted(
+            (k, urllib.parse.quote(self.headers.get(k, ""), safe=""))
+            for k in signed_headers)
+        p_items = sorted((k.lower(),
+                          urllib.parse.quote(str(v), safe=""))
+                         for k, v in q.items())
+        # UriPathname is the path ON THE WIRE (bucket segment included
+        # for path-style) — signing anything else must fail here
+        wire_path = urllib.parse.unquote(
+            urllib.parse.urlsplit(self.path).path)
+        http_string = "\n".join([
+            self.command.lower(), wire_path,
+            "&".join(f"{k}={v}" for k, v in p_items),
+            "&".join(f"{k}={v}" for k, v in h_items), ""])
+        string_to_sign = "\n".join([
+            "sha1", auth.get("q-sign-time", ""),
+            hashlib.sha1(http_string.encode()).hexdigest(), ""])
+        want = hmac.new(sign_key.encode(), string_to_sign.encode(),
+                        hashlib.sha1).hexdigest()
+        return hmac.compare_digest(want, auth.get("q-signature", ""))
+
+
+class FakeCosServer(_VendorServerBase):
+    copy_header = "x-cos-copy-source"
+
+    def __init__(self, access_key="cos-ak", secret_key="cos-sk"):
+        super().__init__(_CosHandler, access_key, secret_key)
+
+
+# --------------------------------------------------------------- Kodo ----
+class FakeKodoServer:
+    """One HTTP server playing all four Kodo roles (rs, rsf, up,
+    download domain), dispatching on path shape; QBox tokens and
+    uptokens verified against the known secret."""
+
+    def __init__(self, access_key="kodo-ak", secret_key="kodo-sk",
+                 bucket="bkt"):
+        self.access_key, self.secret_key = access_key, secret_key
+        self.bucket = bucket
+        self.store = _Store()
+        self.auth_failures = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _send(self, code: int, body: bytes = b"",
+                      ctype="application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _fail(self):
+                outer.auth_failures += 1
+                self._send(401, b'{"error":"bad token"}')
+
+            def _check_qbox(self, body: bytes = b"") -> bool:
+                auth = self.headers.get("Authorization", "")
+                m = re.match(r"QBox ([^:]+):(.+)$", auth)
+                if not m or m.group(1) != outer.access_key:
+                    return False
+                want = base64.urlsafe_b64encode(_hmac_sha1(
+                    outer.secret_key.encode(),
+                    self.path.encode() + b"\n" + body)).decode()
+                return hmac.compare_digest(want, m.group(2))
+
+            @staticmethod
+            def _entry(encoded: str) -> Tuple[str, str]:
+                raw = base64.urlsafe_b64decode(encoded).decode()
+                b, _, k = raw.partition(":")
+                return b, k
+
+            def do_POST(self):  # noqa: N802
+                path = urllib.parse.urlsplit(self.path).path
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                body = self.rfile.read(n) if n else b""
+                # ---- upload (multipart form with uptoken) ----------
+                if path == "/":
+                    ctype = self.headers.get("Content-Type", "")
+                    mb = re.search(r"boundary=([^;]+)", ctype)
+                    fields = _parse_multipart(body, mb.group(1)) \
+                        if mb else {}
+                    token = fields.get("token", b"").decode()
+                    if not outer._check_uptoken(token):
+                        return self._fail()
+                    key = fields.get("key", b"").decode()
+                    with outer.store.lock:
+                        outer.store.objects[key] = fields.get(
+                            "file", b"")
+                    return self._send(200, json.dumps(
+                        {"key": key, "hash": "h"}).encode())
+                # ---- rs/rsf management (QBox) ----------------------
+                if not self._check_qbox(body):
+                    return self._fail()
+                if path.startswith("/stat/"):
+                    _, k = self._entry(path[len("/stat/"):])
+                    with outer.store.lock:
+                        data = outer.store.objects.get(k)
+                    if data is None:
+                        return self._send(612, b'{"error":"no entry"}')
+                    return self._send(200, json.dumps({
+                        "fsize": len(data),
+                        "putTime": int(time.time() * 1e7),
+                        "hash": hashlib.md5(data).hexdigest(),
+                    }).encode())
+                if path.startswith("/delete/"):
+                    _, k = self._entry(path[len("/delete/"):])
+                    with outer.store.lock:
+                        if outer.store.objects.pop(k, None) is None:
+                            return self._send(612, b"{}")
+                    return self._send(200, b"{}")
+                if path.startswith("/copy/"):
+                    rest = path[len("/copy/"):].split("/")
+                    _, src = self._entry(rest[0])
+                    _, dst = self._entry(rest[1])
+                    with outer.store.lock:
+                        if src not in outer.store.objects:
+                            return self._send(612, b"{}")
+                        outer.store.objects[dst] = \
+                            outer.store.objects[src]
+                    return self._send(200, b"{}")
+                if path == "/list":
+                    q = dict(urllib.parse.parse_qsl(
+                        urllib.parse.urlsplit(self.path).query))
+                    with outer.store.lock:
+                        keys = sorted(
+                            k for k in outer.store.objects
+                            if k.startswith(q.get("prefix", "")))
+                    marker = q.get("marker", "")
+                    if marker:
+                        keys = [k for k in keys if k > marker]
+                    limit = int(q.get("limit", "1000"))
+                    page, rest2 = keys[:limit], keys[limit:]
+                    return self._send(200, json.dumps({
+                        "items": [{"key": k, "fsize":
+                                   len(outer.store.objects[k])}
+                                  for k in page],
+                        "marker": page[-1] if rest2 else "",
+                    }).encode())
+                return self._send(400, b"{}")
+
+            def do_GET(self):  # noqa: N802
+                # download domain: private URL e=&token=
+                parsed = urllib.parse.urlsplit(self.path)
+                q = dict(urllib.parse.parse_qsl(parsed.query))
+                token = q.get("token", "")
+                base_url = (f"http://127.0.0.1:{outer.port}"
+                            f"{parsed.path}?e={q.get('e', '')}")
+                m = re.match(r"([^:]+):(.+)$", token)
+                ok = (m and m.group(1) == outer.access_key and
+                      hmac.compare_digest(
+                          base64.urlsafe_b64encode(_hmac_sha1(
+                              outer.secret_key.encode(),
+                              base_url.encode())).decode(),
+                          m.group(2)))
+                if not ok:
+                    return self._fail()
+                if int(q.get("e", "0")) < time.time():
+                    return self._fail()
+                key = urllib.parse.unquote(parsed.path.lstrip("/"))
+                with outer.store.lock:
+                    data = outer.store.objects.get(key)
+                if data is None:
+                    return self._send(404, b"{}")
+                rng = self.headers.get("Range", "")
+                code = 200
+                if rng:
+                    mm = re.match(r"bytes=(\d+)-(\d*)", rng)
+                    if mm:
+                        s = int(mm.group(1))
+                        e = int(mm.group(2)) if mm.group(2) else \
+                            len(data) - 1
+                        data = data[s:e + 1]
+                        code = 206
+                self._send(code, data, "application/octet-stream")
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _check_uptoken(self, token: str) -> bool:
+        parts = token.split(":")
+        if len(parts) != 3 or parts[0] != self.access_key:
+            return False
+        want = base64.urlsafe_b64encode(_hmac_sha1(
+            self.secret_key.encode(), parts[2].encode())).decode()
+        if not hmac.compare_digest(want, parts[1]):
+            return False
+        policy = json.loads(base64.urlsafe_b64decode(parts[2]))
+        return policy.get("scope", "").split(":")[0] == self.bucket \
+            and policy.get("deadline", 0) > time.time()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False
+
+
+def _parse_multipart(body: bytes, boundary: str) -> Dict[str, bytes]:
+    """Tiny multipart/form-data parser for the upload fake."""
+    out: Dict[str, bytes] = {}
+    sep = b"--" + boundary.strip('"').encode()
+    for part in body.split(sep):
+        part = part.strip(b"\r\n")
+        if not part or part == b"--":
+            continue
+        head, _, payload = part.partition(b"\r\n\r\n")
+        m = re.search(rb'name="([^"]+)"', head)
+        if m:
+            out[m.group(1).decode()] = payload.rstrip(b"\r\n")
+    return out

@@ -123,7 +123,10 @@ class TestLocalToEmbedded:
                   msg="re-election after leader kill")
             new_leader = next(j for j in rest if j.is_primary())
             kv2 = kvs[systems.index(new_leader)]
-            assert kv2.data["post-migrate"] == 99
+            # leader completeness puts the entry in the new leader's
+            # LOG at election; APPLICATION to the kv is async — wait
+            _wait(lambda: kv2.data.get("post-migrate") == 99,
+                  msg="post-migrate entry applied on new leader")
             assert {k: v for k, v in kv2.data.items()
                     if k != "post-migrate"} == expect
         finally:

@@ -428,6 +428,19 @@ class Keys:
         scope=Scope.MASTER,
         description="Poll interval for active sync points (reference: "
                     "ActiveSyncManager.java:81; polling replaces iNotify).")
+    MASTER_UPDATE_CHECK_ENABLED = _k(
+        "atpu.master.update.check.enabled", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="Periodically probe for a newer release (reference "
+                    "UpdateChecker.java; OFF by default here — "
+                    "phone-home is opt-in).")
+    MASTER_UPDATE_CHECK_URL = _k(
+        "atpu.master.update.check.url", scope=Scope.MASTER,
+        description="JSON document with {\"latest\": \"x.y.z\"}; point "
+                    "at an internal mirror.")
+    MASTER_UPDATE_CHECK_INTERVAL = _k(
+        "atpu.master.update.check.interval", KeyType.DURATION,
+        default="1d", scope=Scope.MASTER)
     MASTER_REPLICATION_CHECK_INTERVAL = _k(
         "atpu.master.replication.check.interval", KeyType.DURATION, default="1min",
         scope=Scope.MASTER)

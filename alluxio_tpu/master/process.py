@@ -9,6 +9,7 @@ re-register (reference: ``DefaultSafeModeManager``).
 
 from __future__ import annotations
 
+import logging
 import time
 import uuid
 from typing import List, Optional
@@ -26,6 +27,8 @@ from alluxio_tpu.rpc.master_service import (
     block_master_service, fs_master_service, meta_master_service,
 )
 from alluxio_tpu.utils.clock import Clock, SystemClock
+
+LOG = logging.getLogger(__name__)
 
 
 class _Exec(HeartbeatExecutor):
@@ -129,6 +132,7 @@ class MasterProcess:
             conf.get(Keys.HOME) + "/underFSStorage"
         self.rpc_server: Optional[RpcServer] = None
         self.web_server = None
+        self.update_checker = None
         self.web_port: Optional[int] = None
         self._threads: List[HeartbeatThread] = []
         self.cluster_id = str(uuid.uuid4())
@@ -255,6 +259,22 @@ class MasterProcess:
                 _Exec(self.ufs_cleaner.heartbeat),
                 conf.get_duration_s(Keys.MASTER_UFS_CLEANUP_INTERVAL)),
         ]
+        if conf.get_bool(Keys.MASTER_UPDATE_CHECK_ENABLED):
+            url = conf.get(Keys.MASTER_UPDATE_CHECK_URL) or ""
+            if not url:
+                LOG.warning(
+                    "%s is enabled but %s is unset — update checking "
+                    "is a no-op", Keys.MASTER_UPDATE_CHECK_ENABLED,
+                    Keys.MASTER_UPDATE_CHECK_URL)
+            else:
+                from alluxio_tpu.master.update_check import UpdateChecker
+
+                self.update_checker = UpdateChecker(url)
+                self._threads.append(HeartbeatThread(
+                    HeartbeatContext.MASTER_UPDATE_CHECK,
+                    self.update_checker,
+                    conf.get_duration_s(
+                        Keys.MASTER_UPDATE_CHECK_INTERVAL)))
         if conf.get_bool(Keys.MASTER_DAILY_BACKUP_ENABLED):
             from alluxio_tpu.master.backup import ScheduledBackup
 

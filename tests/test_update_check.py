@@ -1,0 +1,69 @@
+"""Update checker (reference ``master/meta/UpdateChecker.java``):
+version probe against a fake release endpoint; off by default."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.master.update_check import UpdateChecker, _parse_version
+
+
+class _FakeReleases:
+    def __init__(self, latest: str) -> None:
+        self.latest = latest
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = json.dumps({"latest": outer.latest}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._srv.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}/"
+
+    def __enter__(self):
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self._srv.shutdown()
+        self._srv.server_close()
+        return False
+
+
+def test_version_parse_orders_correctly():
+    assert _parse_version("0.10.0") > _parse_version("0.9.9")
+    assert _parse_version("1.0.0rc1") == (1, 0, 0, 0)
+    assert _parse_version("2") > _parse_version("1.9")
+    # fewer components zero-pad: "1.0" IS "1.0.0"
+    assert _parse_version("1.0") == _parse_version("1.0.0")
+
+
+def test_newer_release_detected_and_equal_is_quiet():
+    with _FakeReleases("9.9.9") as srv:
+        c = UpdateChecker(srv.url, current_version="0.1.0")
+        c.heartbeat()
+        assert c.update_available and c.latest_version == "9.9.9"
+        srv.latest = "0.1.0"
+        c.heartbeat()
+        assert not c.update_available
+
+
+def test_endpoint_failure_is_ignored():
+    c = UpdateChecker("http://127.0.0.1:1/", current_version="0.1.0")
+    c.heartbeat()  # connection refused: no raise
+    assert c.latest_version is None and not c.update_available
+
+
+def test_disabled_by_default():
+    conf = Configuration(load_env=False)
+    assert conf.get_bool(Keys.MASTER_UPDATE_CHECK_ENABLED) is False

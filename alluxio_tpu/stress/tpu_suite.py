@@ -43,11 +43,19 @@ def log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
 
+#: merged into every row (and its TPU-CONFIG stderr line) — set by
+#: ``run_all(row_extra=...)`` so a host-fallback run's rows are
+#: labelled AT EMIT TIME, not post-hoc (an unlabelled stderr line
+#: would read as device evidence to anyone grepping logs)
+_ROW_EXTRA: Dict = {}
+
+
 def _row(config: str, metric: str, value: float, unit: str,
          vs_baseline: float, **extra) -> Dict:
     row = {"config": config, "metric": metric,
            "value": round(value, 3), "unit": unit,
-           "vs_baseline": round(vs_baseline, 3), **extra}
+           "vs_baseline": round(vs_baseline, 3), **extra,
+           **_ROW_EXTRA}
     log("TPU-CONFIG " + json.dumps(row, sort_keys=True))
     return row
 
@@ -234,11 +242,15 @@ def config5_write_eviction(*, cold_write_rate: float) -> Dict:
 
 
 def run_all(jax, fs, device, *, shard_bytes: int,
-            cold_write_rate: float, out_path: str = "") -> List[Dict]:
+            cold_write_rate: float, out_path: str = "",
+            row_extra: Dict = None) -> List[Dict]:
     """Run the four stages, tolerating per-stage failure (a wedged stage
     must not cost the headline metric its stdout line). ``fs`` is the
     headline cluster's client (configs #2/#4 reuse its warm worker);
-    configs #3/#5 provision their own clusters."""
+    configs #3/#5 provision their own clusters. ``row_extra`` is merged
+    into every row + stderr line (host-fallback labelling)."""
+    global _ROW_EXTRA
+    _ROW_EXTRA = dict(row_extra or {})
     rows: List[Dict] = []
     stages: List[Callable[[], Dict]] = [
         lambda: config2_random_4k(jax, fs, device,
@@ -248,11 +260,14 @@ def run_all(jax, fs, device, *, shard_bytes: int,
         lambda: config4_projection(jax, fs, device),
         lambda: config5_write_eviction(cold_write_rate=cold_write_rate),
     ]
-    for stage in stages:
-        try:
-            rows.append(stage())
-        except Exception as e:  # noqa: BLE001
-            log(f"TPU-CONFIG stage failed: {type(e).__name__}: {e}")
+    try:
+        for stage in stages:
+            try:
+                rows.append(stage())
+            except Exception as e:  # noqa: BLE001
+                log(f"TPU-CONFIG stage failed: {type(e).__name__}: {e}")
+    finally:
+        _ROW_EXTRA = {}
     if out_path and rows:
         try:
             with open(out_path, "w") as f:

@@ -150,7 +150,7 @@ def _spawn_host_fallback(diagnosis: str) -> None:
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
-             "--host-fallback", diagnosis], env=env, timeout=900)
+             "--host-fallback", diagnosis], env=env, timeout=1800)
         if r.returncode != 0:
             failure = f"fallback bench failed rc={r.returncode}"
     except Exception as e:  # noqa: BLE001 incl. TimeoutExpired
@@ -178,6 +178,7 @@ def _host_fallback(diagnosis: str) -> None:
                             dir="/dev/shm" if os.path.isdir("/dev/shm")
                             else None)
     value = 0.0
+    printed = False
     try:
         with LocalCluster(base, num_workers=1, block_size=BLOCK_BYTES,
                           worker_mem_bytes=total_bytes + (256 << 20)) as c:
@@ -202,11 +203,44 @@ def _host_fallback(diagnosis: str) -> None:
             log(f"host fallback: cold write {cold:.2f} GB/s, warm "
                 f"host-tier read {', '.join(f'{r:.2f}' for r in rates)} "
                 f"GB/s")
+            # the guaranteed stdout line goes out BEFORE the config
+            # sweep: a slow stage must never cost the driver its one
+            # parseable line
+            _print_host_diag(value, diagnosis)
+            printed = True
+            # configs #2-#5 in HOST mode (round-4 verdict #1: a fully
+            # wedged round must still ship structured diagnostic rows
+            # per config, clearly labelled at emit time — the 'device'
+            # is the CPU backend, so these measure the host half of
+            # each config's path, never the HBM target). Distinct file:
+            # BENCH_TPU.json stays reserved for real device evidence.
+            if os.environ.get("BENCH_TPU_CONFIGS", "1") != "0":
+                try:
+                    import jax
+
+                    from alluxio_tpu.stress import tpu_suite
+
+                    tpu_suite.run_all(
+                        jax, fs, jax.devices()[0],
+                        shard_bytes=BLOCK_BYTES,
+                        cold_write_rate=cold * 1e9,  # bytes/s contract
+                        out_path=os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TPU_HOST.json"),
+                        row_extra={"host_fallback": True,
+                                   "diagnosis": diagnosis})
+                except Exception as e:  # noqa: BLE001 diagnostic only
+                    log(f"host-mode config rows failed: {e!r}")
             fs.close()
     except Exception as e:  # noqa: BLE001 never lose the diagnosis
         log(f"host fallback bench itself failed: {e!r}")
     finally:
         shutil.rmtree(base, ignore_errors=True)
+    if not printed:  # exactly ONE stdout line, whatever happened
+        _print_host_diag(value, diagnosis)
+
+
+def _print_host_diag(value: float, diagnosis: str) -> None:
     print(json.dumps({
         "metric": "HOST-ONLY DIAGNOSTIC warm host-tier read GB/s "
                   "(TPU unavailable: no HBM evidence this run)",

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Build self-contained bootstrap artifacts for upload (reference:
+# integration/{dataproc,emr}/build.sh): the per-cloud scripts source
+# bootstrap-common.sh in the repo; cloud init actions download ONE
+# file, so this inlines the common core between the >>> <<< sentinels.
+set -eu
+HERE="$(cd "$(dirname "$0")" && pwd)"
+DEPLOY="$(dirname "${HERE}")"
+DIST="${DEPLOY}/dist"
+mkdir -p "${DIST}"
+
+inline() {
+  # $1: source script, $2: output
+  awk -v common="${HERE}/bootstrap-common.sh" '
+    /^# >>> bootstrap-common.sh/ {
+      print "# ---- inlined deploy/cloud/bootstrap-common.sh ----";
+      while ((getline line < common) > 0) print line;
+      close(common); skipping = 1; next
+    }
+    /^# <<< bootstrap-common.sh/ { skipping = 0; next }
+    !skipping { print }
+  ' "$1" > "$2"
+  chmod +x "$2"
+  echo "built $2"
+}
+
+inline "${DEPLOY}/dataproc/alluxio-tpu-dataproc.sh" \
+       "${DIST}/alluxio-tpu-dataproc.sh"
+inline "${DEPLOY}/emr/alluxio-tpu-emr.sh" \
+       "${DIST}/alluxio-tpu-emr.sh"

@@ -112,6 +112,48 @@ class FsMasterClient(_BaseClient):
         return [FileInfo.from_wire(dict(zip(keys, row)))
                 for row in zip(*(cols[k] for k in keys))]
 
+    def iter_status(self, path: str, recursive: bool = False,
+                    sync_interval_ms: int = -1,
+                    batch_size: int = 500):
+        """Streamed listing (reference: partial-response ListStatus):
+        yields FileInfo in server-side batches — constant client
+        memory per batch however large the directory.
+
+        Stream ESTABLISHMENT (up to the first chunk) rides the same
+        retry + HA-rotation machinery as the unary calls; a failure
+        mid-stream propagates — entries already yielded cannot be
+        transparently replayed without a resume cursor."""
+        from alluxio_tpu.utils.exceptions import UnavailableError
+
+        request = {"path": str(path), "recursive": recursive,
+                   "sync_interval_ms": sync_interval_ms,
+                   "batch_size": batch_size}
+
+        def attempt():
+            it = self._channel.call_stream(
+                self.service, "list_status_stream", request)
+            try:
+                first = next(it)
+            except StopIteration:
+                return None, it
+            except UnavailableError:
+                if len(self._channels) > 1:
+                    self._rotate()
+                raise
+            return first, it
+
+        first, it = retry(
+            attempt,
+            ExponentialTimeBoundedRetry(self._retry_duration_s,
+                                        self._base_sleep_s,
+                                        self._max_sleep_s))
+        for chunk in ([first] if first is not None else []):
+            for d in chunk.get("infos", []):
+                yield FileInfo.from_wire(d)
+        for chunk in it:
+            for d in chunk.get("infos", []):
+                yield FileInfo.from_wire(d)
+
     def create_file(self, path: str, **opts) -> FileInfo:
         return FileInfo.from_wire(self._call(
             "create_file", {"path": str(path), **opts}))

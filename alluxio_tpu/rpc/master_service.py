@@ -49,11 +49,16 @@ def fs_master_service(fsm: FileSystemMaster,
                       audit_writer=None) -> ServiceDefinition:
     svc = ServiceDefinition(FS_SERVICE)
 
-    def u(name, fn):
+    def u(name, fn, register=True):
+        """Wrap ``fn`` with timing + audit; ``register=False`` returns
+        the wrapped callable instead of registering a unary method
+        (stream handlers reuse the same discipline for their resolve
+        step)."""
         timed = _timed(name, fn, journal=fsm._journal)
         if audit_writer is None:
-            svc.unary(name, timed)
-            return
+            if register:
+                svc.unary(name, timed)
+            return timed
 
         def audited(req):
             from alluxio_tpu.security.audit import AuditContext
@@ -77,7 +82,9 @@ def fs_master_service(fsm: FileSystemMaster,
             finally:
                 audit_writer.append(ctx)
 
-        svc.unary(name, audited)
+        if register:
+            svc.unary(name, audited)
+        return audited
 
     u("set_acl", lambda r: (fsm.set_acl(
         r["path"], r.get("entries", []),
@@ -96,6 +103,28 @@ def fs_master_service(fsm: FileSystemMaster,
     u("get_status", lambda r: fsm.get_status(
         r["path"], sync_interval_ms=r.get("sync_interval_ms", -1)).to_wire())
     u("exists", lambda r: {"exists": fsm.exists(r["path"])})
+    def _list_status_stream(r: dict):
+        """Partial-response listing (reference: the streamed ListStatus
+        of ``file_system_master.proto:475-590``): the full listing
+        resolves once against the version-guarded cache, then ships in
+        batches so a million-entry directory never rides one frame.
+        Timed + audited like the unary RPCs: the listing resolves (and
+        is audited) before the first chunk goes out; batching itself is
+        transport work."""
+        rows = _audited_resolve(r)
+        batch = max(1, int(r.get("batch_size", 500)))
+        for i in range(0, len(rows), batch):
+            yield {"infos": rows[i:i + batch],
+                   "offset": i, "total": len(rows)}
+
+    def _resolve(r: dict):
+        return fsm.list_status(
+            r["path"], recursive=r.get("recursive", False),
+            sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)
+
+    _audited_resolve = u("list_status_stream.resolve", _resolve,
+                         register=False)
+    svc.stream_out("list_status_stream", _list_status_stream)
     u("list_status", lambda r: (
         {"columnar": fsm.list_status(
             r["path"], recursive=r.get("recursive", False),

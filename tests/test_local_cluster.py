@@ -30,6 +30,27 @@ def fs(cluster):
     f.close()
 
 
+class TestStreamedListing:
+    def test_iter_status_batches_whole_directory(self, fs):
+        """Partial-response listing (reference: streamed ListStatus,
+        ``file_system_master.proto:475-590``): a directory larger than
+        the batch size arrives complete, in order, over several
+        server-side batches."""
+        fs.create_directory("/stream-ls", recursive=True)
+        for i in range(23):
+            fs.create_directory(f"/stream-ls/d-{i:03d}")
+        got = [i.name for i in
+               fs.fs_master.iter_status("/stream-ls", batch_size=5)]
+        assert got == [f"d-{i:03d}" for i in range(23)]
+        # empty dir still terminates cleanly
+        fs.create_directory("/stream-ls-empty")
+        assert list(fs.fs_master.iter_status("/stream-ls-empty")) == []
+        # a file path yields its own status, like list_status
+        fs.write_all("/stream-one", b"x")
+        one = list(fs.fs_master.iter_status("/stream-one"))
+        assert len(one) == 1 and one[0].name == "stream-one"
+
+
 class TestEndToEnd:
     def test_write_read_roundtrip(self, fs):
         payload = bytes(range(256)) * 1000  # 256000 B -> 4 blocks

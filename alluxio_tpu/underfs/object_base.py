@@ -25,6 +25,13 @@ FOLDER_SUFFIX = "/"  # breadcrumb marker key suffix
 
 
 class ObjectStoreClient:
+    #: True when the client implements the multipart quartet
+    #: (initiate_multipart / upload_part / complete_multipart /
+    #: abort_multipart) + ``multipart_size`` — ``create()`` then
+    #: streams large writes via :class:`MultipartWriter`. An explicit
+    #: capability flag, not hasattr duck-guessing: a stray attribute
+    #: must not route writes to a half-implemented surface.
+    supports_multipart = False
     """Minimal blob-store protocol concrete stores implement."""
 
     def put(self, key: str, data: bytes) -> None:
@@ -133,6 +140,78 @@ class _ObjectWriter(io.BytesIO):
         return False
 
 
+class MultipartWriter(io.RawIOBase):
+    """Streaming writer over any client exposing the multipart quartet
+    (``initiate_multipart``/``upload_part``/``complete_multipart``/
+    ``abort_multipart`` + ``multipart_size``): buffers one part then
+    ships; small files fall back to a single PUT (reference:
+    S3ALowLevelOutputStream's short-circuit). Shared by the s3 client
+    and the native OSS/COS dialects — their multipart wire protocols
+    are S3-shaped."""
+
+    def __init__(self, client, key: str) -> None:
+        super().__init__()
+        self._client = client
+        self._key = key
+        self._buf = bytearray()
+        self._upload_id = None
+        self._etags: List[tuple] = []
+        self._part = 0
+        self._closed = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._buf.extend(b)
+        while len(self._buf) >= self._client.multipart_size:
+            self._ship(self._client.multipart_size)
+        return len(b)
+
+    def _ship(self, n: int) -> None:
+        if self._upload_id is None:
+            self._upload_id = self._client.initiate_multipart(self._key)
+        self._part += 1
+        chunk = bytes(self._buf[:n])
+        del self._buf[:n]
+        self._etags.append(
+            (self._part,
+             self._client.upload_part(self._key, self._upload_id,
+                                      self._part, chunk)))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._upload_id is None:
+                self._client.put(self._key, bytes(self._buf))
+            else:
+                if self._buf:
+                    self._ship(len(self._buf))
+                self._client.complete_multipart(self._key,
+                                                self._upload_id,
+                                                self._etags)
+        except Exception:
+            if self._upload_id is not None:
+                self._client.abort_multipart(self._key, self._upload_id)
+            raise
+        finally:
+            super().close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            if self._upload_id is not None:
+                self._client.abort_multipart(self._key, self._upload_id)
+            self._closed = True
+        return False
+
+
 class ObjectUnderFileSystem(UnderFileSystem):
     """Filesystem semantics over an ObjectStoreClient."""
 
@@ -159,6 +238,9 @@ class ObjectUnderFileSystem(UnderFileSystem):
 
     # -- IO -----------------------------------------------------------------
     def create(self, path: str, options: Optional[CreateOptions] = None) -> BinaryIO:
+        if getattr(self._client, "supports_multipart", False):
+            # large writes stream in parts instead of buffering whole
+            return MultipartWriter(self._client, self._key(path))
         return _ObjectWriter(self._client, self._key(path))
 
     def open(self, path: str, offset: int = 0) -> BinaryIO:

@@ -22,7 +22,6 @@ from __future__ import annotations
 import datetime
 import hashlib
 import hmac
-import io
 import os
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -101,6 +100,8 @@ class S3Client(ObjectStoreClient):
     """REST client over one bucket (reference: the jets3t/AWS-SDK calls in
     ``S3AUnderFileSystem``); speaks SigV4 when keys are configured and
     anonymous otherwise (fake servers / public buckets)."""
+
+    supports_multipart = True
 
     def __init__(self, bucket: str,
                  properties: Optional[Dict[str, str]] = None) -> None:
@@ -211,7 +212,10 @@ class S3Client(ObjectStoreClient):
         root = ET.fromstring(r.content)
         ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
         upload_id = root.find(f"{ns}UploadId")
-        return upload_id.text if upload_id is not None else ""
+        if upload_id is None or not upload_id.text:
+            raise IOError(f"multipart initiate for {key!r}: response "
+                          "carried no UploadId")
+        return upload_id.text
 
     def upload_part(self, key: str, upload_id: str, part_number: int,
                     data: bytes) -> str:
@@ -234,72 +238,6 @@ class S3Client(ObjectStoreClient):
         self._request("DELETE", key, params={"uploadId": upload_id})
 
 
-class _MultipartWriter(io.RawIOBase):
-    """Streaming writer: buffers part_size then ships parts; small files fall
-    back to one PUT (reference: S3ALowLevelOutputStream's short-circuit)."""
-
-    def __init__(self, client: S3Client, key: str) -> None:
-        super().__init__()
-        self._client = client
-        self._key = key
-        self._buf = bytearray()
-        self._upload_id: Optional[str] = None
-        self._etags: List[Tuple[int, str]] = []
-        self._part = 0
-        self._closed = False
-
-    def writable(self) -> bool:
-        return True
-
-    def write(self, b) -> int:
-        self._buf.extend(b)
-        while len(self._buf) >= self._client.multipart_size:
-            self._ship(self._client.multipart_size)
-        return len(b)
-
-    def _ship(self, n: int) -> None:
-        if self._upload_id is None:
-            self._upload_id = self._client.initiate_multipart(self._key)
-        self._part += 1
-        chunk = bytes(self._buf[:n])
-        del self._buf[:n]
-        self._etags.append(
-            (self._part,
-             self._client.upload_part(self._key, self._upload_id,
-                                      self._part, chunk)))
-
-    def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
-        try:
-            if self._upload_id is None:
-                self._client.put(self._key, bytes(self._buf))
-            else:
-                if self._buf:
-                    self._ship(len(self._buf))
-                self._client.complete_multipart(self._key, self._upload_id,
-                                                self._etags)
-        except Exception:
-            if self._upload_id is not None:
-                self._client.abort_multipart(self._key, self._upload_id)
-            raise
-        finally:
-            super().close()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, exc_type, exc, tb):
-        if exc_type is None:
-            self.close()
-        else:
-            if self._upload_id is not None:
-                self._client.abort_multipart(self._key, self._upload_id)
-            self._closed = True
-        return False
-
-
 class S3UnderFileSystem(ObjectUnderFileSystem):
     """``s3://bucket/...`` (reference: S3AUnderFileSystem)."""
 
@@ -316,6 +254,6 @@ class S3UnderFileSystem(ObjectUnderFileSystem):
                      properties: Optional[Dict[str, str]]) -> S3Client:
         return S3Client(bucket, properties)
 
-    def create(self, path: str,
-               options: Optional[CreateOptions] = None) -> BinaryIO:
-        return _MultipartWriter(self._client, self._key(path))
+    # create() comes from ObjectUnderFileSystem: S3Client advertises
+    # supports_multipart, so large writes stream via the shared
+    # MultipartWriter

@@ -117,7 +117,9 @@ def create_oss_ufs(root_uri: str,
         _vendor_prop(p, "oss", "endpoint",
                      OssUnderFileSystem.default_endpoint),
         ak, sk,
-        _vendor_prop(p, "oss", "path.style", "false") == "true")
+        _vendor_prop(p, "oss", "path.style", "false") == "true",
+        multipart_size=int(
+            _vendor_prop(p, "oss", "multipart.size", str(8 << 20))))
     return ObjectUnderFileSystem(root_uri, client, properties)
 
 
@@ -140,7 +142,9 @@ def create_cos_ufs(root_uri: str,
         _vendor_prop(p, "cos", "endpoint",
                      CosUnderFileSystem.default_endpoint),
         ak, sk,
-        _vendor_prop(p, "cos", "path.style", "false") == "true")
+        _vendor_prop(p, "cos", "path.style", "false") == "true",
+        multipart_size=int(
+            _vendor_prop(p, "cos", "multipart.size", str(8 << 20))))
     return ObjectUnderFileSystem(root_uri, client, properties)
 
 

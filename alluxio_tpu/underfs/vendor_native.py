@@ -71,12 +71,14 @@ class _XmlVendorClient(ObjectStoreClient):
     match S3's shapes; only auth and the copy header differ."""
 
     copy_header = ""
+    supports_multipart = True
 
     def __init__(self, bucket: str, endpoint: str, ak: str, sk: str,
-                 path_style: bool) -> None:
+                 path_style: bool, multipart_size: int = 8 << 20) -> None:
         self._bucket = bucket
         self._ak, self._sk = ak, sk
         self._path_style = path_style
+        self.multipart_size = multipart_size
         endpoint = endpoint.rstrip("/")
         self._base = (f"{endpoint}/{bucket}" if path_style else
                       endpoint.replace("://", f"://{bucket}."))
@@ -157,6 +159,41 @@ class _XmlVendorClient(ObjectStoreClient):
             if not truncated or not marker:
                 return keys
 
+    # -- multipart (both vendors' native multipart APIs are S3-shaped;
+    # feeds the shared object_base.MultipartWriter) ----------------------
+    def initiate_multipart(self, key: str) -> str:
+        r = self._request("POST", key, params={"uploads": ""})
+        r.raise_for_status()
+        root = ET.fromstring(r.content)
+        ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+        upload_id = root.find(f"{ns}UploadId")
+        if upload_id is None or not upload_id.text:
+            # fail HERE, not with an opaque 404 on the first part (or a
+            # nonsense abort with an empty id)
+            raise IOError(f"multipart initiate for {key!r}: response "
+                          "carried no UploadId")
+        return upload_id.text
+
+    def upload_part(self, key: str, upload_id: str, part_number: int,
+                    data: bytes) -> str:
+        r = self._request("PUT", key, params={
+            "partNumber": str(part_number), "uploadId": upload_id},
+            data=data)
+        r.raise_for_status()
+        return r.headers.get("ETag", "").strip('"')
+
+    def complete_multipart(self, key: str, upload_id: str,
+                           etags: List[Tuple[int, str]]) -> None:
+        body = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+            for n, e in etags) + "</CompleteMultipartUpload>"
+        r = self._request("POST", key, params={"uploadId": upload_id},
+                          data=body.encode())
+        r.raise_for_status()
+
+    def abort_multipart(self, key: str, upload_id: str) -> None:
+        self._request("DELETE", key, params={"uploadId": upload_id})
+
 
 class OssNativeClient(_XmlVendorClient):
     """Alibaba OSS header signing (SDK analogue:
@@ -182,7 +219,11 @@ class OssNativeClient(_XmlVendorClient):
         sub = sorted((k, v) for k, v in params.items()
                      if k in self._SIGNED_SUBRESOURCES)
         if sub:
-            resource += "?" + urllib.parse.urlencode(sub)
+            # OSS V1 canonicalization: valueless subresources render
+            # BARE ("?uploads", no '='), values unencoded — urlencode
+            # here would sign a string the server never sees
+            resource += "?" + "&".join(
+                k if v == "" else f"{k}={v}" for k, v in sub)
         canonical = "\n".join([
             method, headers.get("Content-MD5", ""),
             headers.get("Content-Type", ""), date,

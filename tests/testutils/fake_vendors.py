@@ -32,6 +32,9 @@ class _Store:
 
     def __init__(self) -> None:
         self.objects: Dict[str, bytes] = {}
+        #: upload_id -> {part_number: bytes}
+        self.uploads: Dict[str, Dict[int, bytes]] = {}
+        self.upload_keys: Dict[str, str] = {}
         self.lock = threading.Lock()
 
     def listing_xml(self, prefix: str, marker: str,
@@ -109,6 +112,39 @@ class _XmlVendorHandlerBase(BaseHTTPRequestHandler):
                 q.get("prefix", ""), q.get("marker", ""),
                 int(q.get("max-keys", "1000"))))
         with store.lock:
+            # ---- multipart (S3-shaped, as both vendors' native APIs)
+            if m == "POST" and "uploads" in q:
+                uid = f"up-{len(store.uploads) + 1}"
+                store.uploads[uid] = {}
+                store.upload_keys[uid] = key
+                return self._send(200, (
+                    "<?xml version='1.0'?>"
+                    "<InitiateMultipartUploadResult>"
+                    f"<UploadId>{uid}</UploadId>"
+                    "</InitiateMultipartUploadResult>").encode())
+            if m == "PUT" and "uploadId" in q:
+                uid = q["uploadId"]
+                if uid not in store.uploads or \
+                        store.upload_keys.get(uid) != key:
+                    return self._send(404)
+                n = int(q.get("partNumber", "0"))
+                store.uploads[uid][n] = body
+                return self._send(200, b"", {
+                    "ETag": '"%s"' % hashlib.md5(body).hexdigest()})
+            if m == "POST" and "uploadId" in q:
+                uid = q["uploadId"]
+                parts = store.uploads.pop(uid, None)
+                store.upload_keys.pop(uid, None)
+                if parts is None:
+                    return self._send(404)
+                store.objects[key] = b"".join(
+                    parts[n] for n in sorted(parts))
+                return self._send(
+                    200, b"<CompleteMultipartUploadResult/>")
+            if m == "DELETE" and "uploadId" in q:
+                store.uploads.pop(q["uploadId"], None)
+                store.upload_keys.pop(q["uploadId"], None)
+                return self._send(204)
             if m == "PUT" and srv.copy_header in self.headers:
                 src = urllib.parse.unquote(
                     self.headers[srv.copy_header]).lstrip("/")
@@ -144,7 +180,7 @@ class _XmlVendorHandlerBase(BaseHTTPRequestHandler):
                 return self._send(204)
         self._send(400)
 
-    do_GET = do_PUT = do_DELETE = do_HEAD = _handle  # noqa: N815
+    do_GET = do_PUT = do_DELETE = do_HEAD = do_POST = _handle  # noqa: N815
 
 
 class _VendorServerBase:
@@ -196,7 +232,9 @@ class _OssHandler(_XmlVendorHandlerBase):
         sub = sorted((k, v) for k, v in q.items()
                      if k in ("uploads", "uploadId", "partNumber"))
         if sub:
-            resource += "?" + urllib.parse.urlencode(sub)
+            # mirror the OSS spec, not the client: bare valueless keys
+            resource += "?" + "&".join(
+                k if v == "" else f"{k}={v}" for k, v in sub)
         canonical = "\n".join([
             self.command, self.headers.get("Content-MD5", ""),
             self.headers.get("Content-Type", ""),

@@ -147,10 +147,10 @@ class FsMasterClient(_BaseClient):
             ExponentialTimeBoundedRetry(self._retry_duration_s,
                                         self._base_sleep_s,
                                         self._max_sleep_s))
-        for chunk in ([first] if first is not None else []):
-            for d in chunk.get("infos", []):
-                yield FileInfo.from_wire(d)
-        for chunk in it:
+        from itertools import chain
+
+        chunks = it if first is None else chain([first], it)
+        for chunk in chunks:
             for d in chunk.get("infos", []):
                 yield FileInfo.from_wire(d)
 

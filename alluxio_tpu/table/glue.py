@@ -32,7 +32,7 @@ import urllib.request
 from typing import Dict, Iterator, List, Optional
 
 from alluxio_tpu.table.hive import PathTranslator, mount_translations
-from alluxio_tpu.table.udb import UdbPartition, UdbTable, UnderDatabase
+from alluxio_tpu.table.udb import UdbTable, UnderDatabase
 from alluxio_tpu.utils.exceptions import NotFoundError, UnavailableError
 
 
@@ -177,17 +177,10 @@ class GlueUnderDatabase(UnderDatabase):
                   for c in sd.get("Columns", [])]
         pkeys = [c.get("Name", "") for c in t.get("PartitionKeys", [])]
         location = self._translate(sd.get("Location", ""))
-        partitions: List[UdbPartition] = []
+        rows = []
         if pkeys:
-            for p in self._client.get_partitions(db, name):
-                values = p.get("Values", [])
-                ploc = self._translate(
-                    (p.get("StorageDescriptor", {}) or {}).get(
-                        "Location", ""))
-                spec = "/".join(f"{k}={v}" for k, v in zip(pkeys, values))
-                partitions.append(UdbPartition(
-                    spec, ploc, dict(zip(pkeys, values))))
-        return UdbTable(name=name, schema=schema, location=location,
-                        partition_keys=pkeys,
-                        partitions=partitions or
-                        [UdbPartition("", location, {})])
+            rows = [(p.get("Values", []),
+                     self._translate((p.get("StorageDescriptor", {})
+                                      or {}).get("Location", "")))
+                    for p in self._client.get_partitions(db, name)]
+        return UdbTable.build(name, schema, location, pkeys, rows)

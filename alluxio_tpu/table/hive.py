@@ -29,7 +29,7 @@ from typing import Dict, List, Optional
 from alluxio_tpu.table.thrift_proto import (
     I16, STRING, ThriftClient, ThriftError,
 )
-from alluxio_tpu.table.udb import UdbPartition, UdbTable, UnderDatabase
+from alluxio_tpu.table.udb import UdbTable, UnderDatabase
 from alluxio_tpu.utils.exceptions import NotFoundError
 
 
@@ -187,16 +187,9 @@ class HiveUnderDatabase(UnderDatabase):
                       for f in sd.get(1, [])]
             pkeys = [f.get(1, "") for f in t.get(8, [])]
             location = self._translate(sd.get(2, ""))
-            partitions: List[UdbPartition] = []
+            rows = []
             if pkeys:
-                for p in c.get_partitions(db, name):
-                    values = p.get(1, [])
-                    ploc = self._translate(p.get(6, {}).get(2, ""))
-                    spec = "/".join(f"{k}={v}"
-                                    for k, v in zip(pkeys, values))
-                    partitions.append(UdbPartition(
-                        spec, ploc, dict(zip(pkeys, values))))
-        return UdbTable(name=name, schema=schema, location=location,
-                        partition_keys=pkeys,
-                        partitions=partitions or
-                        [UdbPartition("", location, {})])
+                rows = [(p.get(1, []),
+                         self._translate(p.get(6, {}).get(2, "")))
+                        for p in c.get_partitions(db, name)]
+        return UdbTable.build(name, schema, location, pkeys, rows)

@@ -60,6 +60,23 @@ class UdbTable:
                                      dict(p.get("values", {})))
                         for p in w.get("partitions", [])])
 
+    @staticmethod
+    def build(name: str, schema: List[Dict[str, str]], location: str,
+              partition_keys: List[str],
+              value_rows: "List[tuple]") -> "UdbTable":
+        """Assemble a snapshot table the way every UDB does: each
+        ``(values, location)`` row becomes a ``k=v/k2=v2`` partition;
+        an unpartitioned table gets the single root partition."""
+        partitions = [
+            UdbPartition("/".join(f"{k}={v}" for k, v in
+                                  zip(partition_keys, values)),
+                         loc, dict(zip(partition_keys, values)))
+            for values, loc in value_rows]
+        return UdbTable(name=name, schema=schema, location=location,
+                        partition_keys=partition_keys,
+                        partitions=partitions or
+                        [UdbPartition("", location, {})])
+
 
 class UnderDatabase:
     """SPI (reference: ``UnderDatabase.java``)."""

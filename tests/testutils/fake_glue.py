@@ -9,9 +9,10 @@ same fake-server stance as ``fake_azure.py``/``fake_hms.py``.
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional
+
+from tests.testutils.httpfake import HttpFakeServer
 
 
 class GlueTable:
@@ -38,7 +39,7 @@ class GlueTable:
         }
 
 
-class FakeGlueServer:
+class FakeGlueServer(HttpFakeServer):
     def __init__(self, *, access_key: str = "", page_size: int = 0) -> None:
         self._access_key = access_key
         self._page_size = page_size
@@ -91,10 +92,7 @@ class FakeGlueServer:
                 self.end_headers()
                 self.wfile.write(out)
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._init_server(Handler)
 
     # -- catalog state -------------------------------------------------------
     def add_table(self, db: str, table: GlueTable) -> None:
@@ -139,22 +137,3 @@ class FakeGlueServer:
             return self._page(items, body.get("NextToken", ""),
                               "Partitions")
         raise KeyError(f"operation {op}")
-
-    # -- lifecycle -----------------------------------------------------------
-    @property
-    def endpoint(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
-
-    def __enter__(self) -> "FakeGlueServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="fake-glue")
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        return False

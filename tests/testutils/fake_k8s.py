@@ -9,13 +9,15 @@ import copy
 import json
 import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional
+
+from tests.testutils.httpfake import HttpFakeServer
 
 from alluxio_tpu.operator.controller import GROUP, PLURAL, VERSION
 
 
-class FakeK8sApiServer:
+class FakeK8sApiServer(HttpFakeServer):
     def __init__(self, namespace: str = "default") -> None:
         self.namespace = namespace
         #: name -> CR dict
@@ -99,10 +101,7 @@ class FakeK8sApiServer:
                             del outer.objects[parts[0]]
                     return self._json(200, copy.deepcopy(obj))
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._init_server(Handler)
 
     # -- test-side CR management --------------------------------------------
     def create(self, name: str, spec: dict, generation: int = 1) -> None:
@@ -144,21 +143,3 @@ class FakeK8sApiServer:
         with self._lock:
             return copy.deepcopy(
                 self.objects.get(name, {}).get("status", {}))
-
-    @property
-    def endpoint(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
-
-    def __enter__(self) -> "FakeK8sApiServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="fake-k8s")
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        return False

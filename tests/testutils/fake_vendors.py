@@ -14,8 +14,10 @@ import re
 import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional, Tuple
+
+from tests.testutils.httpfake import HttpFakeServer
 
 
 def _hmac_sha1(key: bytes, msg: bytes) -> bytes:
@@ -183,7 +185,7 @@ class _XmlVendorHandlerBase(BaseHTTPRequestHandler):
     do_GET = do_PUT = do_DELETE = do_HEAD = do_POST = _handle  # noqa: N815
 
 
-class _VendorServerBase:
+class _VendorServerBase(HttpFakeServer):
     copy_header = ""
 
     def __init__(self, handler_cls, access_key: str,
@@ -191,28 +193,8 @@ class _VendorServerBase:
         self.access_key, self.secret_key = access_key, secret_key
         self.store = _Store()
         self.auth_failures = 0
-        handler = type("H", (handler_cls,), {"server_ref": self})
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def endpoint(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
-
-    def __enter__(self):
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        return False
+        self._init_server(type("H", (handler_cls,),
+                               {"server_ref": self}))
 
 
 # ---------------------------------------------------------------- OSS ----
@@ -297,7 +279,7 @@ class FakeCosServer(_VendorServerBase):
 
 
 # --------------------------------------------------------------- Kodo ----
-class FakeKodoServer:
+class FakeKodoServer(HttpFakeServer):
     """One HTTP server playing all four Kodo roles (rs, rsf, up,
     download domain), dispatching on path shape; QBox tokens and
     uptokens verified against the known secret."""
@@ -446,10 +428,7 @@ class FakeKodoServer:
                         code = 206
                 self._send(code, data, "application/octet-stream")
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._init_server(Handler)
 
     def _check_uptoken(self, token: str) -> bool:
         parts = token.split(":")
@@ -463,22 +442,7 @@ class FakeKodoServer:
         return policy.get("scope", "").split(":")[0] == self.bucket \
             and policy.get("deadline", 0) > time.time()
 
-    @property
-    def endpoint(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
 
-    def __enter__(self):
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        return False
 
 
 def _parse_multipart(body: bytes, boundary: str) -> Dict[str, bytes]:

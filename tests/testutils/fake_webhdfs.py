@@ -14,13 +14,14 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import List, Optional, Tuple
 
+from tests.testutils.httpfake import HttpFakeServer
 
-class FakeWebHdfsServer:
+
+class FakeWebHdfsServer(HttpFakeServer):
     def __init__(self, root: str) -> None:
         self.root = root
         os.makedirs(root, exist_ok=True)
@@ -198,25 +199,8 @@ class FakeWebHdfsServer:
                     os.unlink(local)
                 return self._json(200, {"boolean": True})
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._init_server(Handler)
 
     @property
     def uri(self) -> str:
         return f"webhdfs://127.0.0.1:{self.port}/"
-
-    def __enter__(self) -> "FakeWebHdfsServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="fake-webhdfs")
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        return False

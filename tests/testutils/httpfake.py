@@ -1,0 +1,39 @@
+"""Shared lifecycle for in-process fake HTTP servers: ephemeral-port
+ThreadingHTTPServer + daemon serve thread + context manager. The fakes
+(Glue, WebHDFS, K8s API, vendor object stores, ...) differ only in
+their handler; this owns the plumbing they were each copying."""
+
+from __future__ import annotations
+
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Optional
+
+
+class HttpFakeServer:
+    """Subclasses build their handler class and pass it to
+    ``_init_server``; ``with`` runs the serve loop on a daemon thread."""
+
+    def _init_server(self, handler_cls) -> None:
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def __enter__(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=type(self).__name__)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return False

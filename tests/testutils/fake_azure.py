@@ -9,9 +9,11 @@ import hashlib
 import hmac
 import json
 import threading
+
+from tests.testutils.httpfake import HttpFakeServer
 import time
 from email.utils import formatdate
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, Optional
 from urllib.parse import parse_qs, unquote, unquote_plus, urlsplit
 from xml.sax.saxutils import escape
@@ -254,7 +256,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(200, json.dumps({"paths": paths}).encode())
 
 
-class FakeAzureServer:
+class FakeAzureServer(HttpFakeServer):
     """``with FakeAzureServer() as srv: srv.endpoint``."""
 
     def __init__(self, verify_key_b64: str = None) -> None:
@@ -267,17 +269,4 @@ class FakeAzureServer:
         class H(_Handler):
             state = self.state
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
-        self.port = self._httpd.server_address[1]
-        self.endpoint = f"http://127.0.0.1:{self.port}"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
-
-    def __enter__(self) -> "FakeAzureServer":
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        return False
+        self._init_server(H)

@@ -10,7 +10,7 @@ import json
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from tests.testutils.httpfake import HttpFakeServer
 

@@ -10,9 +10,11 @@ shape, not cryptographic verification).
 from __future__ import annotations
 
 import threading
+
+from tests.testutils.httpfake import HttpFakeServer
 import urllib.parse
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Tuple
 
 
@@ -182,23 +184,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(400)
 
 
-class FakeS3Server:
+class FakeS3Server(HttpFakeServer):
     """Context manager: ``with FakeS3Server() as srv: srv.endpoint``."""
 
     def __init__(self) -> None:
         self.state = _State()
-        handler = type("BoundHandler", (_Handler,), {"state": self.state})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
-        self.port = self._httpd.server_port
-        self.endpoint = f"http://127.0.0.1:{self.port}"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
-
-    def __enter__(self) -> "FakeS3Server":
-        self._thread.start()
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        return False
+        self._init_server(
+            type("BoundHandler", (_Handler,), {"state": self.state}))

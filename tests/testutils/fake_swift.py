@@ -7,11 +7,13 @@ from __future__ import annotations
 
 import json
 import threading
+
+from tests.testutils.httpfake import HttpFakeServer
 import uuid
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
 
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 
 
 class _State:
@@ -168,7 +170,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(204)
 
 
-class FakeSwiftServer:
+class FakeSwiftServer(HttpFakeServer):
     """Keystone + Swift in one server: auth at ``{endpoint}/v3``,
     storage at ``{endpoint}/v1``."""
 
@@ -184,22 +186,11 @@ class FakeSwiftServer:
             def storage_base(self):
                 return f"{outer.endpoint}/v1"
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
-        self.port = self._httpd.server_address[1]
-        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._init_server(H)
         self.auth_url = f"{self.endpoint}/v3"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
 
     def expire_all_tokens(self) -> None:
         with self.state.lock:
             self.state.valid_tokens.clear()
 
-    def __enter__(self) -> "FakeSwiftServer":
-        self._thread.start()
-        return self
 
-    def __exit__(self, *exc) -> bool:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        return False

@@ -443,8 +443,6 @@ class FakeKodoServer(HttpFakeServer):
             and policy.get("deadline", 0) > time.time()
 
 
-
-
 def _parse_multipart(body: bytes, boundary: str) -> Dict[str, bytes]:
     """Tiny multipart/form-data parser for the upload fake."""
     out: Dict[str, bytes] = {}

@@ -82,29 +82,29 @@ class FileInStream:
     def read(self, n: int = -1) -> bytes:
         if n < 0:
             n = self.length - self._pos
-        out = bytearray()
-        while n > 0 and self._pos < self.length:
-            chunk = self._read_from_block(self._pos, n)
-            if not chunk:
-                break
-            out.extend(chunk)
-            self._pos += len(chunk)
-            n -= len(chunk)
-        return bytes(out)
+        self._pos, out = self._read_at(self._pos, n)
+        return out
 
     def pread(self, offset: int, n: int) -> bytes:
         """Positioned read without moving the cursor
         (reference: positioned read, ``block_worker.proto:68``)."""
-        out = bytearray()
-        pos = offset
+        return self._read_at(offset, n)[1]
+
+    def _read_at(self, pos: int, n: int) -> "tuple[int, bytes]":
+        # chunk list + single join: the block streams hand back
+        # freshly-owned bytes (mmap slice / gRPC frame), a one-chunk
+        # read returns them as-is, and a spanning read pays exactly one
+        # assembly pass — the old bytearray.extend + bytes() pair cost
+        # two extra full passes over the data
+        chunks = []
         while n > 0 and pos < self.length:
             chunk = self._read_from_block(pos, n)
             if not chunk:
                 break
-            out.extend(chunk)
+            chunks.append(chunk)
             pos += len(chunk)
             n -= len(chunk)
-        return bytes(out)
+        return pos, chunks[0] if len(chunks) == 1 else b"".join(chunks)
 
     _MAX_READ_ATTEMPTS = 3
 

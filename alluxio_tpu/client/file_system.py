@@ -273,9 +273,12 @@ class FileSystem:
     # ----------------------------------------------------------------- data
     def open_file(self, path: "str | AlluxioURI", *,
                   cache: Optional[bool] = None,
-                  info: Optional[FileInfo] = None) -> FileInStream:
+                  info: Optional[FileInfo] = None,
+                  max_open_streams: Optional[int] = None) -> FileInStream:
         """``info``: a FileInfo the caller already holds (skips the
-        get_status round-trip — the loader's first-batch path)."""
+        get_status round-trip — the loader's first-batch path).
+        ``max_open_streams``: cap on cached per-block streams (worker
+        pins) — long-lived many-file holders pass 1."""
         if info is None:
             info = self.get_status(path)
         if info.folder:
@@ -285,7 +288,9 @@ class FileSystem:
         if cache is None:
             cache = self._conf.get(Keys.USER_FILE_READ_TYPE_DEFAULT) != \
                 "NO_CACHE"
-        stream = FileInStream(self.fs_master, self.store, info, cache=cache)
+        stream = FileInStream(self.fs_master, self.store, info,
+                              cache=cache,
+                              max_open_streams=max_open_streams)
         if self._page_cache is not None:
             from alluxio_tpu.client.cache.stream import CachingFileInStream
 

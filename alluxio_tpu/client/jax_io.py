@@ -111,7 +111,11 @@ class DeviceBlockLoader:
             streams = self._tls.streams = {}
         f = streams.get(path)
         if f is None:
-            f = self._fs.open_file(path, info=self._infos.get(path))
+            # one cached block stream per file: the loader holds a
+            # stream per (thread, path) for its whole life, so a larger
+            # cache would multiply worker-side block pins
+            f = self._fs.open_file(path, info=self._infos.get(path),
+                                   max_open_streams=1)
             streams[path] = f
             with self._streams_lock:
                 self._all_streams.append(f)

@@ -39,16 +39,29 @@ class ReadType:
 class FileInStream:
     """Seekable whole-file reader (reference: AlluxioFileInStream)."""
 
+    #: cap on cached open per-block streams. Each open short-circuit
+    #: stream holds a worker-side PIN (eviction can't unlink a mapped
+    #: block), so the cap bounds unevictable blocks per stream:
+    #: ``max_open_streams * open_streams_per_worker``. Workloads holding
+    #: many long-lived FileInStreams (the JAX loader) pass 1.
+    MAX_OPEN_STREAMS = 4
+
     def __init__(self, fs_master: FsMasterClient, store: BlockStoreClient,
-                 info: FileInfo, *, cache: bool = True) -> None:
+                 info: FileInfo, *, cache: bool = True,
+                 max_open_streams: Optional[int] = None) -> None:
         self._fs = fs_master
         self._store = store
         self.info = info
         self._cache = cache
         self._pos = 0
         self._block_infos: Optional[List[FileBlockInfo]] = None
-        self._current: Optional[BlockInStream] = None
-        self._current_index = -1
+        #: small LRU of OPEN per-block streams keyed by block index: a
+        #: positioned-read workload hopping between blocks (random-4k
+        #: over a multi-block file) must not pay a lease+mmap reopen on
+        #: every block switch (reference keeps positioned-read streams
+        #: cached per block the same way)
+        self._streams: "dict[int, BlockInStream]" = {}
+        self._max_open_streams = max_open_streams or self.MAX_OPEN_STREAMS
 
     # -- metadata ------------------------------------------------------------
     @property
@@ -137,7 +150,15 @@ class FileInStream:
                 # :94-95)
                 last_err = e
                 self._store.mark_failed(stream.address)
-                self._drop_current_stream()
+                # every cached stream to the dead worker is equally
+                # doomed: drop them all, or blocks cached there would
+                # each burn a failed attempt + backoff before failover
+                dead = stream.address.key() if stream.address else None
+                for i in [i for i, s2 in self._streams.items()
+                          if s2.address is not None
+                          and s2.address.key() == dead]:
+                    self._drop_stream(i)
+                self._drop_stream(index)
                 self._block_infos = None
             except BlockDoesNotExistError as e:
                 # stale location (evicted since the master's last heartbeat):
@@ -146,32 +167,36 @@ class FileInStream:
                 last_err = e
                 if stream.address is not None:
                     excluded.add(stream.address.key())
-                self._drop_current_stream()
+                self._drop_stream(index)
                 self._block_infos = None
         raise last_err  # type: ignore[misc]
 
-    def _drop_current_stream(self) -> None:
-        if self._current is not None:
+    def _drop_stream(self, index: int) -> None:
+        stream = self._streams.pop(index, None)
+        if stream is not None:
             try:
-                self._current.close()
+                stream.close()
             except Exception:  # noqa: BLE001 - already broken
                 pass
-            self._current = None
-            self._current_index = -1
 
     def _block_stream(self, index: int,
                       exclude: Optional[Set[str]] = None) -> BlockInStream:
-        if index == self._current_index and self._current is not None:
-            return self._current
-        if self._current is not None:
-            self._current.close()
-            self._current = None
+        cached = self._streams.get(index)
+        if cached is not None:
+            if not exclude or (cached.address is None or
+                               cached.address.key() not in exclude):
+                # LRU touch
+                self._streams[index] = self._streams.pop(index)
+                return cached
+            self._drop_stream(index)
+        while len(self._streams) >= self._max_open_streams:
+            self._drop_stream(next(iter(self._streams)))
         fbi = self._blocks()[index]
-        self._current = self._store.open_block(
+        stream = self._store.open_block(
             fbi, ufs_info=self._ufs_info_for(index),
             cache_cold_reads=self._cache, exclude=exclude)
-        self._current_index = index
-        return self._current
+        self._streams[index] = stream
+        return stream
 
     def block_stream(self, index: int) -> BlockInStream:
         """Expose the per-block stream — the zero-copy JAX path uses this to
@@ -179,9 +204,8 @@ class FileInStream:
         return self._block_stream(index)
 
     def close(self) -> None:
-        if self._current is not None:
-            self._current.close()
-            self._current = None
+        for index in list(self._streams):
+            self._drop_stream(index)
 
     def __enter__(self):
         return self

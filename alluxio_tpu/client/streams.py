@@ -252,7 +252,10 @@ class FileOutStream:
                 self._current_written = 0
             room = self._block_size - self._current_written
             chunk = view[:room]
-            self._current.write(bytes(chunk))
+            # writers take buffers: the local path hands the view to
+            # BufferedWriter as-is, the gRPC path re-chunks and owns its
+            # copies — a bytes() here would re-copy every written byte
+            self._current.write(chunk)
             self._current_written += len(chunk)
             self.written += len(chunk)
             view = view[len(chunk):]

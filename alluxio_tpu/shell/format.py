@@ -32,11 +32,12 @@ def _wipe_dir(path: str, out) -> None:
         print(f"Created {path}", file=out)
 
 
-def format_master(conf: Configuration, out=sys.stdout) -> None:
+def format_master(conf: Configuration, out=None) -> None:
+    # out=None late-binds: print(file=None) writes to the CURRENT sys.stdout.
     _wipe_dir(conf.get(Keys.MASTER_JOURNAL_FOLDER), out)
 
 
-def format_worker(conf: Configuration, out=sys.stdout) -> None:
+def format_worker(conf: Configuration, out=None) -> None:
     levels = conf.get_int(Keys.WORKER_TIERED_STORE_LEVELS)
     for lvl in range(levels):
         for p in conf.get_list(Templates.WORKER_TIER_DIRS_PATH.format(lvl)):

@@ -75,7 +75,7 @@ def make_quorum(tmp_path, ports, **kw):
     return systems, kvs
 
 
-def wait_for(pred, timeout=10.0, msg="condition"):
+def wait_for(pred, timeout=30.0, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():
@@ -255,7 +255,7 @@ class TestQuorum:
             leader.stop()  # hard kill
             rest = [j for j in systems if j is not leader]
             wait_for(lambda: leader_of(rest) is not None,
-                     msg="re-election", timeout=15)
+                     msg="re-election", timeout=45)
             new_leader = leader_of(rest)
             assert new_leader is not leader
             # all acked entries present on the new leader
@@ -311,7 +311,7 @@ class TestQuorum:
             for j in systems2:
                 j.start()
             wait_for(lambda: leader_of(systems2) is not None,
-                     msg="re-election after restart", timeout=15)
+                     msg="re-election after restart", timeout=45)
             for kv in kvs2:
                 wait_for(lambda kv=kv: len(kv.data) == 10,
                          msg="replay convergence")
@@ -343,7 +343,7 @@ class TestQuorum:
             systems[li] = j2
             kvs[li] = kv2
             j2.start()
-            wait_for(lambda: len(kv2.data) >= 25, msg="catch-up", timeout=15)
+            wait_for(lambda: len(kv2.data) >= 25, msg="catch-up", timeout=45)
             assert kv2.data["c24"] == 24
         finally:
             for j in systems:
@@ -382,7 +382,7 @@ class TestQuorum:
             kvs[li] = kv2
             j2.start()
             wait_for(lambda: len(kv2.data) >= 40,
-                     msg="snapshot install", timeout=15)
+                     msg="snapshot install", timeout=45)
             assert kv2.data["s39"] == 39
         finally:
             for j in systems:
@@ -538,7 +538,7 @@ class TestPartitions:
                     return False
 
             wait_for(can_write, msg="writes resume at quorum",
-                     timeout=15)
+                     timeout=45)
             wait_for(lambda: kv2.data.get("healed") == 2,
                      msg="restarted node replicates")
         finally:
@@ -605,7 +605,7 @@ class TestPartitions:
             # after the writer stops, full convergence
             leader_kv = kvs[systems.index(leader)]
             wait_for(lambda: kv2.data == leader_kv.data,
-                     msg="final convergence", timeout=15)
+                     msg="final convergence", timeout=45)
         finally:
             for j in systems:
                 try:

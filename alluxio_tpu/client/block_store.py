@@ -21,6 +21,7 @@ from alluxio_tpu.client.policy import BlockLocationPolicy
 from alluxio_tpu.rpc.clients import BlockMasterClient, WorkerClient
 from alluxio_tpu.utils import ids as id_utils
 from alluxio_tpu.utils.exceptions import UnavailableError
+from alluxio_tpu.utils.retry import ExponentialTimeBoundedRetry
 from alluxio_tpu.utils.wire import (
     BlockInfo, FileBlockInfo, FileInfo, TieredIdentity, WorkerInfo,
     WorkerNetAddress,
@@ -34,7 +35,8 @@ class BlockStoreClient:
                  write_policy: Optional[BlockLocationPolicy] = None,
                  ufs_read_policy: Optional[BlockLocationPolicy] = None,
                  short_circuit: bool = True,
-                 passive_cache: bool = True) -> None:
+                 passive_cache: bool = True,
+                 write_unavailable_window_s: float = 15.0) -> None:
         self._bm = block_master
         self._identity = identity or TieredIdentity.from_spec(
             None, hostname=socket.gethostname())
@@ -46,6 +48,7 @@ class BlockStoreClient:
             "DETERMINISTIC_HASH", shards=1)
         self._short_circuit = short_circuit
         self._passive_cache = passive_cache
+        self._write_unavailable_window_s = write_unavailable_window_s
         self.session_id = id_utils.create_session_id()
         #: worker that served the most recent write (sync-persist targets it;
         #: LOCAL_FIRST keeps one file's blocks on one worker)
@@ -178,21 +181,39 @@ class BlockStoreClient:
                 return
 
     # -- write ---------------------------------------------------------------
-    def open_block_writer(self, block_id: int, *, size_hint: int,
-                          tier: str = "", pinned: bool = False,
-                          preferred: Optional[WorkerNetAddress] = None
-                          ) -> BlockOutStream:
-        workers = self._live_workers()
-        address = None
+    def _pick_writable(self, block_id: int, size_hint: int,
+                       preferred: Optional[WorkerNetAddress]
+                       ) -> Optional[WorkerNetAddress]:
+        # Unfiltered list: the failed memory records READ errors (30s
+        # TTL); a worker that botched one read is still a valid write
+        # target, and filtering it here could starve the retry window.
+        workers = list(self._bm.get_worker_infos())
         if preferred is not None and any(
                 w.address.key() == preferred.key() for w in workers):
             # one file's blocks stay on one worker so worker-side persist
             # can stream them out locally (reference: LocalFirstPolicy
             # stickiness within a FileOutStream)
-            address = preferred
-        else:
-            address = self._write_policy.pick(workers, block_id=block_id,
-                                              block_size=size_hint)
+            return preferred
+        return self._write_policy.pick(workers, block_id=block_id,
+                                       block_size=size_hint)
+
+    def open_block_writer(self, block_id: int, *, size_hint: int,
+                          tier: str = "", pinned: bool = False,
+                          preferred: Optional[WorkerNetAddress] = None
+                          ) -> BlockOutStream:
+        address = self._pick_writable(block_id, size_hint, preferred)
+        if address is None and self._write_unavailable_window_s > 0:
+            # Transient unavailability: a worker that missed heartbeats
+            # under host overload is marked lost, empties the live set,
+            # then re-registers seconds later. Wait out that window with
+            # jittered backoff instead of failing the stream (reference:
+            # client write retry on UnavailableException).
+            policy = ExponentialTimeBoundedRetry(
+                max_duration_s=self._write_unavailable_window_s,
+                base_sleep_s=0.05, max_sleep_s=1.0)
+            policy.attempt()  # first attempt already happened above
+            while address is None and policy.attempt():
+                address = self._pick_writable(block_id, size_hint, preferred)
         if address is None:
             raise UnavailableError("no live workers to write to")
         client = self.worker_client(address)

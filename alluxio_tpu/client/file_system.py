@@ -97,7 +97,9 @@ class FileSystem:
                 identity=identity),
             short_circuit=self._conf.get_bool(Keys.USER_SHORT_CIRCUIT_ENABLED),
             passive_cache=self._conf.get_bool(
-                Keys.USER_FILE_PASSIVE_CACHE_ENABLED))
+                Keys.USER_FILE_PASSIVE_CACHE_ENABLED),
+            write_unavailable_window_s=self._conf.get_duration_s(
+                Keys.USER_BLOCK_WRITE_UNAVAILABLE_WINDOW))
         # pull cluster defaults once at start (reference: clients load
         # cluster-default config via the meta master on first connect)
         self._path_conf: Dict[str, Dict[str, str]] = {}

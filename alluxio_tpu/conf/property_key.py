@@ -721,6 +721,14 @@ class Keys:
         scope=Scope.CLIENT,
         description="-1 = never sync on access, 0 = always, >0 = min interval "
                     "(reference: common options sync interval, InodeSyncStream).")
+    USER_BLOCK_WRITE_UNAVAILABLE_WINDOW = _k(
+        "atpu.user.block.write.unavailable.window", KeyType.DURATION,
+        default="15s", scope=Scope.CLIENT,
+        description="How long a block write waits for a live worker before "
+                    "failing. Covers the transient window where the only "
+                    "worker missed heartbeats (host overload) and is "
+                    "re-registering; 0 fails immediately (reference: client "
+                    "UnavailableException retry on write).")
     USER_RPC_RETRY_MAX_DURATION = _k("atpu.user.rpc.retry.max.duration",
                                      KeyType.DURATION, default="2min",
                                      scope=Scope.CLIENT)

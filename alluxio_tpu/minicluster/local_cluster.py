@@ -57,6 +57,13 @@ class LocalCluster:
         self.conf.set(Keys.MASTER_RPC_PORT, 0)  # ephemeral
         self.conf.set(Keys.USER_BLOCK_SIZE_BYTES_DEFAULT, block_size)
         self.conf.set(Keys.MASTER_SAFEMODE_WAIT, "0s")
+        if not start_worker_heartbeats:
+            # No heartbeat loop means worker liveness is unknowable: the
+            # lost-worker detector would silently expire a healthy worker
+            # after the default timeout (and with no heartbeat to carry
+            # the re-register command it can never come back). Overrides
+            # below still win for tests that drive detection explicitly.
+            self.conf.set(Keys.MASTER_WORKER_TIMEOUT, "10000min")
         for k, v in (conf_overrides or {}).items():
             self.conf.set(k, v)
         self.master: Optional[MasterProcess] = None

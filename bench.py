@@ -181,7 +181,8 @@ def _host_fallback(diagnosis: str) -> None:
     printed = False
     try:
         with LocalCluster(base, num_workers=1, block_size=BLOCK_BYTES,
-                          worker_mem_bytes=total_bytes + (256 << 20)) as c:
+                          worker_mem_bytes=total_bytes + (256 << 20),
+                          start_worker_heartbeats=True) as c:
             fs = c.file_system()
             rng = np.random.default_rng(0)
             n = total_bytes // BLOCK_BYTES
@@ -278,7 +279,8 @@ def main() -> None:
                             if os.path.isdir("/dev/shm") else None)
     try:
         with LocalCluster(base, num_workers=1, block_size=BLOCK_BYTES,
-                          worker_mem_bytes=total_bytes + (256 << 20)) as cluster:
+                          worker_mem_bytes=total_bytes + (256 << 20),
+                          start_worker_heartbeats=True) as cluster:
             fs = cluster.file_system()
             rng = np.random.default_rng(0)
             # DISTINCT content per shard: the tunnel dedupes repeated

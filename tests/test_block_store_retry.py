@@ -1,0 +1,94 @@
+"""Write-path behavior during a transient worker-lost window.
+
+The client must wait out the window where the live-worker set is empty
+(a worker that missed heartbeats under host overload re-registers seconds
+later) instead of failing the write stream immediately — the reference
+client retries UnavailableException on write RPCs rather than surfacing
+the first empty snapshot (``AlluxioFileOutStream`` retry discipline).
+"""
+
+import time
+
+import pytest
+
+from alluxio_tpu.client.block_store import BlockStoreClient
+from alluxio_tpu.utils.exceptions import UnavailableError
+from alluxio_tpu.utils.wire import WorkerInfo, WorkerNetAddress
+
+
+class _FlappingBlockMaster:
+    """get_worker_infos() returns [] for the first ``empty_calls`` calls,
+    then one live worker — the shape of a lost→re-registered worker."""
+
+    def __init__(self, empty_calls: int) -> None:
+        self.calls = 0
+        self.empty_calls = empty_calls
+        self.worker = WorkerInfo(
+            id=1, address=WorkerNetAddress(host="w1", rpc_port=29999,
+                                           data_port=29998))
+
+    def get_worker_infos(self):
+        self.calls += 1
+        if self.calls <= self.empty_calls:
+            return []
+        return [self.worker]
+
+
+class _StubWriter:
+    def __init__(self, address):
+        self.address = address
+
+
+def _make_store(bm, window_s):
+    store = BlockStoreClient(bm, short_circuit=False,
+                             write_unavailable_window_s=window_s)
+    # Keep the unit test off the network: capture the picked address
+    # instead of opening a real gRPC stream.
+    store.worker_client = lambda address: address
+    return store
+
+
+def test_write_waits_out_worker_lost_window(monkeypatch):
+    bm = _FlappingBlockMaster(empty_calls=3)
+    store = _make_store(bm, window_s=10.0)
+    monkeypatch.setattr("alluxio_tpu.client.block_store.GrpcBlockOutStream",
+                        lambda client, session_id, block_id, tier, pinned:
+                        _StubWriter(client))
+    t0 = time.monotonic()
+    writer = store.open_block_writer(7, size_hint=1 << 20)
+    waited = time.monotonic() - t0
+    assert writer.address.host == "w1"
+    assert bm.calls >= 4  # retried through the empty snapshots
+    assert waited < 5.0  # backoff stays small while the window is short
+
+
+def test_write_fails_after_window_expires():
+    bm = _FlappingBlockMaster(empty_calls=10 ** 9)
+    store = _make_store(bm, window_s=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(UnavailableError):
+        store.open_block_writer(7, size_hint=1 << 20)
+    assert time.monotonic() - t0 >= 0.2
+
+
+def test_failed_read_memory_does_not_affect_writes(monkeypatch):
+    """A worker in the failed-READ memory (30s TTL) is still a valid write
+    target: the write path never applies that filter, even with window=0."""
+    bm = _FlappingBlockMaster(empty_calls=0)
+    store = _make_store(bm, window_s=0.0)
+    store.mark_failed(bm.worker.address)
+    monkeypatch.setattr("alluxio_tpu.client.block_store.GrpcBlockOutStream",
+                        lambda client, session_id, block_id, tier, pinned:
+                        _StubWriter(client))
+    t0 = time.monotonic()
+    writer = store.open_block_writer(7, size_hint=1 << 20)
+    assert writer.address.host == "w1"
+    assert time.monotonic() - t0 < 1.0  # no backoff sleeps on this path
+
+
+def test_zero_window_fails_immediately():
+    bm = _FlappingBlockMaster(empty_calls=10 ** 9)
+    store = _make_store(bm, window_s=0.0)
+    with pytest.raises(UnavailableError):
+        store.open_block_writer(7, size_hint=1 << 20)
+    assert bm.calls == 1

@@ -251,30 +251,36 @@ def _print_host_diag(value: float, diagnosis: str) -> None:
         "tpu_wedged": True,
         "diagnosis": diagnosis,
     }
-    # Point at real-device evidence captured earlier in the round, if
-    # any run got a grant before the tunnel wedged. Values are parsed
-    # from the committed raw log at emit time (never duplicated here),
-    # and deliberately carry NO vs_baseline key: this run produced no
-    # device evidence and must not read as a pass to a JSON walker.
-    evidence = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "bench_logs", "r05_device_run1.txt")
+    # Point at the newest committed real-device log, if any run ever
+    # got a grant before a wedge. Values are parsed from that log at
+    # emit time (never duplicated here), and deliberately carry NO
+    # vs_baseline key: this run produced no device evidence and must
+    # not read as a pass to a JSON walker.
+    log_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_logs")
     try:
-        with open(evidence) as f:
-            for line in f:
-                if line.startswith("warm HBM-tier read epochs GB/s:"):
-                    nums = line.split(":", 1)[1].split("(")[0]
-                    row["earlier_device_evidence_this_round"] = {
-                        "warm_hbm_read_gbps_epochs":
-                            [float(x) for x in nums.split(",")],
-                        "log": "bench_logs/r05_device_run1.txt",
-                        "note": "partial earlier run: grant landed, warm "
-                                "phase measured on TPU v5 lite, then the "
-                                "run crashed in the later e2e phase "
-                                "(worker-expiry bug, since fixed in-tree)",
-                    }
-                    break
-    except (OSError, ValueError):
-        pass
+        logs = sorted(f for f in os.listdir(log_dir) if "device" in f)
+    except OSError:
+        logs = []
+    for name in reversed(logs):
+        try:
+            with open(os.path.join(log_dir, name)) as f:
+                for line in f:
+                    if line.startswith("warm HBM-tier read epochs GB/s:"):
+                        nums = line.split(":", 1)[1].split("(")[0]
+                        row["device_evidence_on_record"] = {
+                            "warm_hbm_read_gbps_epochs":
+                                [float(x) for x in nums.split(",")],
+                            "log": f"bench_logs/{name}",
+                            "note": "parsed from the newest committed "
+                                    "device-run log; see that file for "
+                                    "the run's full context and date",
+                        }
+                        break
+        except (OSError, ValueError):
+            continue
+        if "device_evidence_on_record" in row:
+            break
     print(json.dumps(row), flush=True)
 
 

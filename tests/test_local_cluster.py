@@ -217,3 +217,28 @@ class TestFailedWorkerRetry:
             assert fs2.read_all("/fo") == payload
             fs2.close()
             fs.close()
+
+
+class TestHeartbeatlessWorkerTimeout:
+    """Regression for the bench worker-expiry bug: a heartbeat-less
+    LocalCluster must not let the lost-worker detector expire a healthy
+    worker (no heartbeat loop means liveness is unknowable, and no
+    re-register command can ever be delivered)."""
+
+    # conf is fully decided in __init__ — no cluster boot needed
+
+    def test_hb_off_cluster_defaults_to_unexpiring_workers(self, tmp_path):
+        c = LocalCluster(str(tmp_path), num_workers=1)
+        assert c.conf.get_ms(Keys.MASTER_WORKER_TIMEOUT) >= \
+            1000 * 60 * 10_000
+
+    def test_explicit_timeout_override_still_wins(self, tmp_path):
+        c = LocalCluster(str(tmp_path), num_workers=1,
+                         conf_overrides={Keys.MASTER_WORKER_TIMEOUT: "2s"})
+        assert c.conf.get_ms(Keys.MASTER_WORKER_TIMEOUT) == 2000
+
+    def test_hb_on_cluster_keeps_normal_timeout(self, tmp_path):
+        c = LocalCluster(str(tmp_path), num_workers=1,
+                         start_worker_heartbeats=True)
+        # the 5-minute reference default, not the hb-off guard value
+        assert c.conf.get_ms(Keys.MASTER_WORKER_TIMEOUT) == 5 * 60 * 1000

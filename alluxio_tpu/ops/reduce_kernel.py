@@ -17,6 +17,12 @@ from __future__ import annotations
 
 _LANES = 1024  # 8x128 VPU tile multiples
 _ROWS = 512    # rows per grid step: 512x1024 int32 = 2 MiB VMEM/block
+# Candidate block heights for calibration: at 819 GB/s a 2 MiB block is
+# only ~2.6 us of DMA, so fixed per-grid-step cost can be a few percent;
+# taller blocks amortize it (16 MiB = ~20 us/step, 2x16 MiB double
+# buffer = 32 MiB of ~128 MiB VMEM). bench.py times each and keeps the
+# winner rather than guessing the sweet spot for this chip stepping.
+CALIBRATION_ROWS = (512, 1024, 2048, 4096)
 
 
 def available() -> bool:
@@ -42,9 +48,9 @@ def _kernel(x_ref, s_ref, o_ref):
     o_ref[0, 0] += jnp.sum(x_ref[:] * s_ref[0, 0])
 
 
-def scaled_sum(x, scale, *, interpret: bool = False):
+def scaled_sum(x, scale, *, rows: int = _ROWS, interpret: bool = False):
     """``sum(x * scale)`` for int32 ``x`` of size divisible by
-    ``_ROWS * _LANES`` (use ``pad_to_kernel_shape`` otherwise — zeros
+    ``rows * _LANES`` (use ``pad_to_kernel_shape`` otherwise — zeros
     are reduction-neutral). Trace-time shapes, so calling this inside
     the consumer's ``jit`` compiles it once; no module-level jax import
     (``available()`` must stay checkable on jax-less hosts)."""
@@ -53,17 +59,17 @@ def scaled_sum(x, scale, *, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if x.size % (_ROWS * _LANES):
+    if x.size % (rows * _LANES):
         raise ValueError(
             f"input size {x.size} is not a multiple of "
-            f"{_ROWS * _LANES}; pad with pad_to_kernel_shape() — "
+            f"{rows * _LANES}; pad with pad_to_kernel_shape() — "
             f"flooring would silently drop the tail from the reduction")
     flat = x.reshape(-1, _LANES)
-    tiles = flat.shape[0] // _ROWS
+    tiles = flat.shape[0] // rows
     grid_spec = pl.GridSpec(
         grid=(tiles,),
         in_specs=[
-            pl.BlockSpec((_ROWS, _LANES), lambda i: (i, 0),
+            pl.BlockSpec((rows, _LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
@@ -80,11 +86,11 @@ def scaled_sum(x, scale, *, interpret: bool = False):
     return out[0, 0]
 
 
-def pad_to_kernel_shape(arr):
+def pad_to_kernel_shape(arr, *, rows: int = _ROWS):
     """Zero-pad a flat int32 array up to the kernel's block multiple."""
     import jax.numpy as jnp
 
-    block = _ROWS * _LANES
+    block = rows * _LANES
     n = arr.size
     rem = (-n) % block
     if rem:

@@ -7,7 +7,7 @@ import pytest
 jnp = pytest.importorskip("jax.numpy")
 
 from alluxio_tpu.ops.reduce_kernel import (  # noqa: E402
-    _LANES, _ROWS, pad_to_kernel_shape, scaled_sum,
+    _LANES, _ROWS, CALIBRATION_ROWS, pad_to_kernel_shape, scaled_sum,
 )
 
 
@@ -40,3 +40,20 @@ class TestScaledSum:
         y = jnp.ones((_ROWS * _LANES,), dtype=jnp.int32)
         p = pad_to_kernel_shape(y)
         assert p.size == y.size
+
+    @pytest.mark.parametrize("rows", CALIBRATION_ROWS)
+    def test_block_height_variants_agree(self, rows):
+        # every calibration candidate must reduce identically — the
+        # bench picks by speed, never by value
+        rng = np.random.default_rng(rows)
+        y = jnp.asarray(rng.integers(-1000, 1000, size=rows * _LANES + 777,
+                                     dtype=np.int32))
+        p = pad_to_kernel_shape(y, rows=rows)
+        got = int(scaled_sum(p, jnp.int32(2), rows=rows, interpret=True))
+        ref = int(jnp.sum(y * jnp.int32(2)))
+        assert got == ref
+
+    def test_non_multiple_raises(self):
+        y = jnp.ones((_ROWS * _LANES + 1,), dtype=jnp.int32)
+        with pytest.raises(ValueError):
+            scaled_sum(y, jnp.int32(1), interpret=True)

@@ -96,6 +96,30 @@ def put(j, k, v):
         ctx.append("kv_put", {"k": k, "v": v})
 
 
+def with_stable_leader(systems, fn, timeout=45.0):
+    """Run ``fn(leader)`` against the current leader, retrying discovery
+    and ``fn`` when the leader steps down mid-use: under 1-core suite
+    load heartbeats get starved, so a node observed as leader can lose
+    the role between discovery and the next call — the same failover a
+    real client retries through. AssertionErrors retry too (state read
+    mid-step-down) but the last one is re-raised at the deadline, so a
+    genuine assertion failure still surfaces as itself."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        leader = leader_of(systems)
+        if leader is not None:
+            try:
+                return fn(leader)
+            except (JournalClosedError, AssertionError) as e:
+                last = e
+        time.sleep(0.05)
+    if isinstance(last, AssertionError):
+        raise last
+    raise AssertionError(f"no stable leader within {timeout}s "
+                         f"(last error: {last!r})")
+
+
 class TestQuorum:
     def test_three_node_election_and_replication(self, tmp_path):
         systems, kvs = make_quorum(tmp_path, free_ports(3))
@@ -214,14 +238,17 @@ class TestQuorum:
                 j.start()
             wait_for(lambda: leader_of(systems) is not None,
                      msg="election")
-            leader = leader_of(systems)
-            put(leader, "x", 1)
-            info = leader.quorum_info()
-            assert info["leader"] == leader.node.node_id
-            assert len(info["members"]) == 3
-            roles = {m["node_id"]: m["role"] for m in info["members"]}
-            assert roles[leader.node.node_id] == "LEADER"
-            assert list(roles.values()).count("FOLLOWER") == 2
+            def check(leader):
+                put(leader, "x", 1)
+                info = leader.quorum_info()
+                assert info["leader"] == leader.node.node_id
+                assert len(info["members"]) == 3
+                roles = {m["node_id"]: m["role"]
+                         for m in info["members"]}
+                assert roles[leader.node.node_id] == "LEADER"
+                assert list(roles.values()).count("FOLLOWER") == 2
+
+            with_stable_leader(systems, check)
         finally:
             for j in systems:
                 j.stop()

@@ -64,6 +64,19 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def _kernel_candidate_names(pallas_ok: bool) -> list:
+    """Single source of truth for reduce-kernel candidate names —
+    BENCH_KERNEL validation (cheap, before any grant time is spent) and
+    factory construction both derive from this list."""
+    names = [f"xla-u{u}" for u in sorted({4, 16, UNROLL})]
+    if pallas_ok:
+        from alluxio_tpu.ops import reduce_kernel
+
+        names += [f"pallas-r{r}-u{UNROLL}"
+                  for r in reduce_kernel.CALIBRATION_ROWS]
+    return names
+
+
 _PROBE_SRC = """
 import jax, jax.numpy as jnp
 dev = jax.devices()[0]
@@ -302,6 +315,25 @@ def main() -> None:
     from alluxio_tpu.client.jax_io import DeviceBlockLoader
     from alluxio_tpu.client.streams import WriteType
     from alluxio_tpu.minicluster import LocalCluster
+    from alluxio_tpu.ops import reduce_kernel
+
+    # fail a malformed BENCH_KERNEL HERE, before minutes of cluster
+    # boot and tunnel-limited phases are spent ahead of kernel
+    # selection. Validation is by FORMAT, not membership: a prior run's
+    # winner may carry an unroll outside this run's BENCH_UNROLL set
+    # (e.g. xla-u8) and is still buildable; a name that parses but
+    # cannot compile falls back to xla-u4 at selection time
+    import re
+
+    pinned = os.environ.get("BENCH_KERNEL", "")
+    known = _kernel_candidate_names(reduce_kernel.available())
+    if pinned and pinned not in known:
+        ok = re.fullmatch(r"xla-u\d+", pinned) or (
+            reduce_kernel.available()
+            and re.fullmatch(r"pallas-r\d+-u\d+", pinned))
+        if not ok:
+            raise SystemExit(f"BENCH_KERNEL={pinned!r} unknown; "
+                             f"candidates: {known}")
 
     log(f"device: {device}")
     total_bytes = BLOCK_BYTES * NUM_BLOCKS
@@ -362,7 +394,7 @@ def main() -> None:
 
             # p50 first-batch latency from warm host tier
             lat = []
-            for s in range(4):  # shards 0-3; 4.. stay untransferred
+            for s in range(min(4, NUM_BLOCKS)):  # 4.. stay untransferred
                 l2 = DeviceBlockLoader(fs, paths[s:s + 1], device=device,
                                        hbm_bytes=0)
                 t0 = time.monotonic()
@@ -380,15 +412,18 @@ def main() -> None:
             # judging the loader against a ceiling probed earlier is
             # noise — interleave ADJACENT ceiling/loader pairs over a
             # subset and take the median ratio
-            sub_bytes = 4 * BLOCK_BYTES
             pair_ratios = []
             h2d = 0.0
             for _rep in range(3):
                 # a shard subset this process has NOT transferred yet
-                # (first-batch used 0-3; reps take 4-7, 8-11, 12-15)
+                # (first-batch used 0-3; reps take 4-7, 8-11, 12-15).
+                # sub_bytes follows len(sub): under a tiny
+                # BENCH_NUM_BLOCKS the slice is short and counting a
+                # fixed 4 blocks would overstate both rates
                 lo_i = min(4 + 4 * _rep, max(0, NUM_BLOCKS - 4))
                 sub = paths[lo_i:lo_i + 4]
-                ps = [fresh_probe() for _ in range(4)]
+                sub_bytes = len(sub) * BLOCK_BYTES
+                ps = [fresh_probe() for _ in range(len(sub))]
                 t0 = time.monotonic()
                 raws = [jax.device_put(p, device) for p in ps]
                 jax.block_until_ready(raws)
@@ -422,8 +457,6 @@ def main() -> None:
             # depends on the previous iteration — XLA cannot hoist or cache
             # it, and fetching the final scalar forces real completion
             # (async-relay-proof timing).
-            from alluxio_tpu.ops import reduce_kernel
-
             def make_consume(k, unroll):
                 @jax.jit
                 def consume(blocks, acc0):
@@ -463,18 +496,27 @@ def main() -> None:
 
                 return consume_pallas
 
-            # candidate factories: (name, fn(k) -> jitted consume).
-            # Unroll variants cut while-loop condition overhead; pallas
-            # block-height variants trade per-grid-step cost against
-            # DMA pipelining depth. BENCH_UNROLL joins the unroll set
-            # so the env knob stays live.
-            factories = [(f"xla-u{u}", lambda k, u=u: make_consume(k, u))
-                         for u in sorted({4, 16, UNROLL})]
-            if reduce_kernel.available():
-                factories += [
-                    (f"pallas-r{r}-u{UNROLL}",
-                     lambda k, r=r: make_consume_pallas(k, UNROLL, r))
-                    for r in reduce_kernel.CALIBRATION_ROWS]
+            # candidate factories built from the validated name list:
+            # (name, fn(k) -> jitted consume). Unroll variants cut
+            # while-loop condition overhead; pallas block-height
+            # variants trade per-grid-step cost against DMA pipelining
+            # depth. BENCH_UNROLL joins the unroll set via
+            # _kernel_candidate_names so the env knob stays live.
+            def mk_from_name(name):
+                if name.startswith("xla-u"):
+                    u = int(name[len("xla-u"):])
+                    return lambda k: make_consume(k, u)
+                r, u = name[len("pallas-r"):].split("-u")
+                return lambda k: make_consume_pallas(k, int(u), int(r))
+
+            # BENCH_KERNEL pins a candidate by name (e.g. a prior run's
+            # calibration winner), skipping calibration compiles — each
+            # distinct kernel costs a ~20-40s first compile over the
+            # tunnel, real money on a crash-prone grant
+            if pinned:
+                log(f"reduce kernel pinned via BENCH_KERNEL={pinned}")
+            factories = [(n, mk_from_name(n))
+                         for n in ([pinned] if pinned else known)]
 
             blocks = [b for b in loader.epoch()]  # HBM-resident now
             # calibrate at reduced K: a grant is a scarce, crash-prone
@@ -486,7 +528,13 @@ def main() -> None:
             # whole headline run.
             k_cal = min(K, max(100, K // 10))
             cal_fns = []
-            for name, mk in factories:
+            if len(factories) == 1:
+                # nothing to rank — skip the reduced-K compile entirely
+                factories_to_rank = []
+                cal = [(0.0, factories[0][0])]
+            else:
+                factories_to_rank = factories
+            for name, mk in factories_to_rank:
                 # per-candidate failure isolation: a variant that fails
                 # to compile (e.g. a block height exceeding this
                 # stepping's VMEM) is dropped, never allowed to crash
@@ -498,26 +546,43 @@ def main() -> None:
                 except Exception as e:  # noqa: BLE001
                     log(f"calibration candidate {name} dropped: "
                         f"{type(e).__name__}: {str(e)[:200]}")
-            if not cal_fns:  # xla-u4 has run on every stepping so far
-                raise RuntimeError("no reduce-kernel candidate compiled")
-            samples = {name: [] for name, _ in cal_fns}
-            for _rep in range(3):
-                for name, fn in cal_fns:
-                    t0 = time.monotonic()
-                    int(fn(blocks, jnp.int32(1)))
-                    samples[name].append(time.monotonic() - t0)
-            cal = sorted((sorted(ts)[1], name) for name, ts in
-                         samples.items())
-            # raw seconds, not GB/s: at reduced k_cal the ~65 ms
-            # dispatch cost is a large common-mode offset, so a GB/s
-            # figure here would understate the device rate and risk
-            # being mistaken for headline evidence in the logs
-            log(f"reduce kernel calibration (median of 3 at K={k_cal}): "
-                + ", ".join(f"{n}={t:.3f}s" for t, n in cal)
-                + f" -> using {cal[0][1]}")
-            del cal_fns, samples
+            if factories_to_rank:
+                if not cal_fns:  # xla-u4 has run on every stepping yet
+                    raise RuntimeError(
+                        "no reduce-kernel candidate compiled")
+                samples = {name: [] for name, _ in cal_fns}
+                for _rep in range(3):
+                    for name, fn in cal_fns:
+                        t0 = time.monotonic()
+                        int(fn(blocks, jnp.int32(1)))
+                        samples[name].append(time.monotonic() - t0)
+                cal = sorted((sorted(ts)[1], name) for name, ts in
+                             samples.items())
+                # raw seconds, not GB/s: at reduced k_cal the ~65 ms
+                # dispatch cost is a large common-mode offset, so a
+                # GB/s figure here would understate the device rate and
+                # risk being mistaken for headline evidence in the logs
+                log(f"reduce kernel calibration (median of 3 at "
+                    f"K={k_cal}): "
+                    + ", ".join(f"{n}={t:.3f}s" for t, n in cal)
+                    + f" -> using {cal[0][1]}")
+                del samples
+            del cal_fns
             consume = dict(factories)[cal[0][1]](K)
-            _ = int(consume(blocks, jnp.int32(1)))  # compile + warm
+            try:
+                _ = int(consume(blocks, jnp.int32(1)))  # compile + warm
+            except Exception as e:  # noqa: BLE001
+                # a pinned (or calibration-winning) kernel can still
+                # fail its full-K compile on this stepping; the grant
+                # must survive — fall back to the kernel that has
+                # compiled on every stepping so far
+                if cal[0][1] == "xla-u4":
+                    raise
+                log(f"kernel {cal[0][1]} failed at full K "
+                    f"({type(e).__name__}: {str(e)[:200]}); "
+                    f"falling back to xla-u4")
+                consume = make_consume(K, 4)
+                _ = int(consume(blocks, jnp.int32(1)))
             rates, times = [], []
             for e in range(EPOCHS):
                 t0 = time.monotonic()

@@ -1,0 +1,232 @@
+"""YARN integration: allocator negotiation semantics (reference
+``ContainerAllocatorTest.java``), RM REST submission lifecycle
+(``ClientTest.java``), and the AM's allocate-then-launch flow
+(``ApplicationMaster.java``) against the fake ResourceManager."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import pytest
+
+from tests.testutils.fake_yarn import FakeResourceManager
+
+from alluxio_tpu.yarn import (
+    ApplicationMaster, Container, ContainerAllocator, NotEnoughHostsError,
+    YarnRestClient,
+)
+from alluxio_tpu.yarn.allocator import ANY_HOST, AllocationFailedError
+from alluxio_tpu.yarn.am import ClusterSpec, LaunchPlan, build_command
+from alluxio_tpu.yarn.client import YarnRestError
+
+
+class ScriptedRm:
+    """In-memory RmProtocol: offers the scripted host lists round by
+    round (empty script -> honest round-robin over requested hosts)."""
+
+    def __init__(self, hosts: Sequence[str],
+                 rounds: List[List[str]] = None) -> None:
+        self.hosts = list(hosts)
+        self.rounds = rounds
+        self.released: List[str] = []
+        self.requests: List[dict] = []
+        self._n = 0
+
+    def node_hosts(self):
+        return list(self.hosts)
+
+    def request_containers(self, count, hosts, relax_locality, *,
+                           memory_mb=1024, vcores=1):
+        self.requests.append({"count": count, "hosts": list(hosts),
+                              "relax": relax_locality,
+                              "memory_mb": memory_mb})
+        if self.rounds is not None:
+            grant_hosts = self.rounds.pop(0) if self.rounds else []
+        else:
+            pool = list(hosts) or self.hosts
+            grant_hosts = [pool[i % len(pool)] for i in range(count)]
+        out = []
+        for h in grant_hosts:
+            self._n += 1
+            out.append(Container(f"c{self._n}", h))
+        return out
+
+    def release(self, cid):
+        self.released.append(cid)
+
+
+class TestContainerAllocator:
+    def test_spreads_to_target_across_hosts(self):
+        rm = ScriptedRm(["h0", "h1", "h2"])
+        got = ContainerAllocator("worker", 3, 1, rm).allocate()
+        assert sorted(c.host for c in got) == ["h0", "h1", "h2"]
+        assert rm.released == []
+
+    def test_per_host_cap_releases_excess(self):
+        # round 1 offers three on one host at cap 1: keep one, release
+        # two, re-request; round 2 fills the rest
+        rm = ScriptedRm(["h0", "h1", "h2"],
+                        rounds=[["h0", "h0", "h0"], ["h1", "h2"]])
+        got = ContainerAllocator("worker", 3, 1, rm).allocate()
+        assert sorted(c.host for c in got) == ["h0", "h1", "h2"]
+        assert len(rm.released) == 2
+
+    def test_capped_hosts_leave_request_pool(self):
+        rm = ScriptedRm(["h0", "h1"], rounds=[["h0", "h0"], ["h1"]])
+        ContainerAllocator("worker", 3, 2, rm).allocate()
+        # after h0 reaches cap 2, the next round's request excludes it
+        assert rm.requests[1]["hosts"] == ["h1"]
+
+    def test_not_enough_hosts_fails_fast(self):
+        rm = ScriptedRm(["h0"])
+        with pytest.raises(NotEnoughHostsError):
+            ContainerAllocator("worker", 3, 1, rm).allocate()
+        assert rm.requests == []  # failed before any request round
+
+    def test_stingy_rm_exhausts_attempts(self):
+        rm = ScriptedRm(["h0", "h1"], rounds=[])  # never grants
+        with pytest.raises(AllocationFailedError):
+            ContainerAllocator("worker", 2, 1, rm,
+                               max_attempts=3).allocate()
+        assert len(rm.requests) == 3
+
+    def test_preferred_host_pins_and_any_relaxes(self):
+        rm = ScriptedRm(["h0", "h1"])
+        ContainerAllocator("master", 1, 1, rm,
+                           preferred_host="h1").allocate()
+        assert rm.requests[0] == {"count": 1, "hosts": ["h1"],
+                                  "relax": False, "memory_mb": 1024}
+        rm2 = ScriptedRm(["h0", "h1"])
+        ContainerAllocator("master", 1, 1, rm2,
+                           preferred_host=ANY_HOST).allocate()
+        assert rm2.requests[0]["relax"] is True
+
+    def test_excess_beyond_target_released(self):
+        rm = ScriptedRm(["h0", "h1", "h2"],
+                        rounds=[["h0", "h1", "h2"]])
+        got = ContainerAllocator("worker", 2, 1, rm).allocate()
+        assert len(got) == 2
+        assert len(rm.released) == 1
+
+
+class TestYarnRestClient:
+    def test_submission_lifecycle(self):
+        with FakeResourceManager() as rm:
+            cli = YarnRestClient(rm.endpoint)
+            app_id = cli.new_application()
+            assert app_id.startswith("application_")
+            cli.submit(app_id, "atpu-cluster",
+                       "env python -m alluxio_tpu.yarn.am",
+                       memory_mb=2048, env={"ATPU_HOME": "/opt"})
+            assert cli.state(app_id) == "ACCEPTED"
+            rm.set_app_state(app_id, "RUNNING")
+            assert cli.wait_for_state(app_id, ["RUNNING"],
+                                      timeout=5) == "RUNNING"
+            cli.kill(app_id)
+            assert cli.state(app_id) == "KILLED"
+            # the submitted context carried the AM command + env
+            ctx = rm.apps[app_id]["ctx"]
+            assert ctx["am-container-spec"]["commands"]["command"] \
+                .endswith("yarn.am")
+            assert ctx["resource"]["memory"] == 2048
+
+    def test_node_hosts_filters_non_running(self):
+        with FakeResourceManager(["a", "b", "c"]) as rm:
+            rm.node_states["b"] = "LOST"
+            assert YarnRestClient(rm.endpoint).node_hosts() == ["a", "c"]
+
+    def test_http_error_surfaces(self):
+        with FakeResourceManager() as rm:
+            cli = YarnRestClient(rm.endpoint)
+            with pytest.raises(YarnRestError):
+                cli.state("application_does_not_exist")
+
+    def test_container_request_and_release_wire(self):
+        with FakeResourceManager(["a", "b"]) as rm:
+            cli = YarnRestClient(rm.endpoint)
+            got = cli.request_containers(2, ["a", "b"], True,
+                                         memory_mb=4096, vcores=2)
+            assert [c.host for c in got] == ["a", "b"]
+            cli.release(got[0].container_id)
+            assert rm.released == [got[0].container_id]
+            req = rm.container_requests[0]
+            assert req["relax-locality"] is True
+            # sized requests, as the reference's ContainerRequest carries
+            assert req["resource"] == {"memory": 4096, "vCores": 2}
+
+
+class RecordingLauncher:
+    def __init__(self):
+        self.plans: List[LaunchPlan] = []
+
+    def launch(self, plan):
+        self.plans.append(plan)
+
+
+class TestApplicationMaster:
+    def test_allocates_and_launches_cluster(self):
+        with FakeResourceManager(["nm-0", "nm-1", "nm-2"]) as rm:
+            cli = YarnRestClient(rm.endpoint)
+            launcher = RecordingLauncher()
+            am = ApplicationMaster(
+                ClusterSpec(num_workers=3, max_workers_per_host=1,
+                            conf={"atpu.master.rpc.port": "19998"}),
+                cli, launcher)
+            plans = am.run()
+        assert len(plans) == 4
+        roles = [p.env["ATPU_ROLE"] for p in plans]
+        assert roles.count("master") == 1
+        assert roles.count("worker") == 3
+        # every worker is told where the master landed, via env-var
+        # config surface, and per-host cap held
+        master_host = am.master_container.host
+        worker_hosts = [c.host for c in am.worker_containers]
+        assert len(set(worker_hosts)) == 3
+        for p in plans:
+            assert f"ATPU_MASTER_HOSTNAME={master_host}" in p.command
+            assert "ATPU_MASTER_RPC_PORT=19998" in p.command
+        # workers get the BYTES-typed ramdisk key and sized requests
+        for p in plans[1:]:
+            assert "ATPU_WORKER_RAMDISK_SIZE=2048MB" in p.command
+        sized = [r["resource"]["memory"]
+                 for r in rm.container_requests]
+        assert sized[0] == 2048 and sized[-1] == 4096
+        assert launcher.plans == plans
+
+    def test_master_host_pin(self):
+        with FakeResourceManager(["nm-0", "nm-1"]) as rm:
+            cli = YarnRestClient(rm.endpoint)
+            am = ApplicationMaster(
+                ClusterSpec(num_workers=1, master_host="nm-1"),
+                cli, RecordingLauncher())
+            am.run()
+            assert am.master_container.host == "nm-1"
+
+
+class TestCli:
+    def test_submit_status_kill_roundtrip(self, capsys):
+        from alluxio_tpu.yarn.__main__ import main
+
+        with FakeResourceManager() as rm:
+            assert main(["--rm", rm.endpoint, "submit",
+                         "--workers", "2", "--queue", "prod",
+                         "-C", "atpu.master.rpc.port=19998"]) == 0
+            app_id = capsys.readouterr().out.strip()
+            assert app_id.startswith("application_")
+            ctx = rm.apps[app_id]["ctx"]
+            assert ctx["queue"] == "prod"
+            cmd = ctx["am-container-spec"]["commands"]["command"]
+            assert "--workers 2" in cmd
+            assert "-C atpu.master.rpc.port=19998" in cmd
+            assert main(["--rm", rm.endpoint, "status", app_id]) == 0
+            assert capsys.readouterr().out.strip() == "ACCEPTED"
+            assert main(["--rm", rm.endpoint, "kill", app_id]) == 0
+            assert rm.apps[app_id]["state"] == "KILLED"
+
+
+class TestCommandBuilder:
+    def test_env_assignment_quoting(self):
+        cmd = build_command("alluxio_tpu.worker.process",
+                            {"atpu.worker.tag": "a b"})
+        assert cmd == ("env ATPU_WORKER_TAG='a b' "
+                       "python -m alluxio_tpu.worker.process")

@@ -1,0 +1,134 @@
+"""In-process fake YARN ResourceManager: the ``/ws/v1/cluster`` REST
+surface the submission client drives (new-application / submit /
+state / kill / nodes) plus the REST allocation seam
+(``/containers/request`` + release) with a configurable grant policy,
+so allocator negotiation rounds — stingy grants, over-offers, offers
+on capped hosts — can be scripted server-side."""
+
+from __future__ import annotations
+
+import itertools
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional
+
+from tests.testutils.httpfake import HttpFakeServer
+
+
+class FakeResourceManager(HttpFakeServer):
+    def __init__(self, hosts: Optional[List[str]] = None) -> None:
+        self.hosts = hosts or ["nm-0", "nm-1", "nm-2"]
+        #: node host -> state (non-RUNNING nodes must be filtered out)
+        self.node_states: Dict[str, str] = {h: "RUNNING"
+                                            for h in self.hosts}
+        self.apps: Dict[str, dict] = {}
+        self.released: List[str] = []
+        self.container_requests: List[dict] = []
+        #: grants per request round; None -> honest round-robin over
+        #: the requested hosts. Each entry is a list of hostnames to
+        #: offer for ONE round (popped FIFO) — lets tests script
+        #: stingy, excess, or capped-host offers.
+        self.scripted_rounds: Optional[List[List[str]]] = None
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw.strip() else {}
+
+            def do_POST(self):  # noqa: N802
+                path = self.path
+                with outer._lock:
+                    if path.endswith("/apps/new-application"):
+                        app_id = (f"application_1700000000000_"
+                                  f"{next(outer._ids):04d}")
+                        return self._json(200, {
+                            "application-id": app_id,
+                            "maximum-resource-capability":
+                                {"memory": 8192, "vCores": 4}})
+                    if path.endswith("/cluster/apps"):
+                        ctx = self._body()
+                        app_id = ctx.get("application-id", "")
+                        if not app_id:
+                            return self._json(400,
+                                              {"message": "no app id"})
+                        outer.apps[app_id] = {"ctx": ctx,
+                                              "state": "ACCEPTED"}
+                        return self._json(202, {})
+                    if path.endswith("/containers/request"):
+                        req = self._body()
+                        outer.container_requests.append(req)
+                        grants = outer._grant(req)
+                        return self._json(200, {"containers": grants})
+                    m = re.fullmatch(
+                        r".*/containers/([^/]+)/release", path)
+                    if m:
+                        outer.released.append(m.group(1))
+                        return self._json(200, {})
+                return self._json(404, {"message": path})
+
+            def do_GET(self):  # noqa: N802
+                path = self.path
+                with outer._lock:
+                    if path.endswith("/cluster/nodes"):
+                        return self._json(200, {"nodes": {"node": [
+                            {"nodeHostName": h, "state": s}
+                            for h, s in outer.node_states.items()]}})
+                    m = re.fullmatch(r".*/apps/([^/]+)/state", path)
+                    if m and m.group(1) in outer.apps:
+                        return self._json(200, {
+                            "state": outer.apps[m.group(1)]["state"]})
+                return self._json(404, {"message": path})
+
+            def do_PUT(self):  # noqa: N802
+                path = self.path
+                body = self._body()
+                with outer._lock:
+                    m = re.fullmatch(r".*/apps/([^/]+)/state", path)
+                    if m and m.group(1) in outer.apps:
+                        if body.get("state") == "KILLED":
+                            outer.apps[m.group(1)]["state"] = "KILLED"
+                        return self._json(200, {
+                            "state": outer.apps[m.group(1)]["state"]})
+                return self._json(404, {"message": path})
+
+        self._init_server(Handler)
+
+    # must be called under self._lock (handler holds it)
+    def _grant(self, req: dict) -> List[dict]:
+        if self.scripted_rounds is not None:
+            hosts = (self.scripted_rounds.pop(0)
+                     if self.scripted_rounds else [])
+        else:
+            pool = [h for h in (req.get("hosts") or self.hosts)
+                    if self.node_states.get(h) == "RUNNING"]
+            if not pool and req.get("relax-locality"):
+                # YARN relaxed locality: the scheduler may place off
+                # the named hosts (e.g. the "any" pseudo-host)
+                pool = [h for h, s in self.node_states.items()
+                        if s == "RUNNING"]
+            hosts = [pool[i % len(pool)]
+                     for i in range(req["count"])] if pool else []
+        return [{"container-id":
+                 f"container_{next(self._ids):06d}", "host": h}
+                for h in hosts]
+
+    def set_app_state(self, app_id: str, state: str) -> None:
+        with self._lock:
+            self.apps[app_id]["state"] = state

@@ -24,6 +24,8 @@ Commands:
   table      table/catalog shell (attachdb/ls/sync/transform)
   stress     stress benchmark suite (worker/master/prefetch/table/write)
   validateConf  sanity-check the effective configuration
+  validateEnv   pre-flight node checks (ports/dirs/ssh/native/cluster)
+  validateHms   validate a Hive metastore before table attachdb
   format     format master journal / worker storage
   master     run a master process
   worker     run a worker process
@@ -121,6 +123,14 @@ def main(argv=None) -> int:
         from alluxio_tpu.shell.validate import main as validate_main
 
         return validate_main(rest, conf=conf)
+    if cmd == "validateEnv":
+        from alluxio_tpu.shell.validate_env import main_env
+
+        return main_env(rest, conf=conf)
+    if cmd == "validateHms":
+        from alluxio_tpu.shell.validate_env import main_hms
+
+        return main_hms(rest, conf=conf)
     if cmd == "format":
         from alluxio_tpu.shell.format import main as format_main
 

@@ -1,0 +1,162 @@
+"""validateEnv / validateHms task-based pre-flight checks (reference
+``integration/tools/validation`` + ``HmsValidationTool.java:32``)."""
+
+from __future__ import annotations
+
+import io
+import socket
+
+from tests.testutils.fake_hms import FakeHmsServer, HmsTable
+
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.shell.validate_env import (
+    FAILED, OK, SKIPPED, WARNING, TaskResult, ValidationTool,
+    _check_dir, _check_port, env_tool, hms_tool, main_hms,
+    print_results,
+)
+
+
+class TestTaskFramework:
+    def test_task_exception_becomes_failed_row(self):
+        tool = ValidationTool("t")
+        tool.add("boom", lambda: 1 / 0)
+        tool.add("fine", lambda: TaskResult("fine", OK, "yes"))
+        rows = tool.run_all()
+        assert rows[0].state == FAILED
+        assert "ZeroDivisionError" in rows[0].message
+        assert rows[1].state == OK
+
+    def test_print_results_exit_code(self):
+        buf = io.StringIO()
+        rc = print_results("t", [TaskResult("a", OK),
+                                 TaskResult("b", WARNING, "w")],
+                           out=buf)
+        assert rc == 0
+        assert "[     OK] a" in buf.getvalue()
+        rc = print_results("t", [TaskResult("a", FAILED, "x")],
+                           out=buf)
+        assert rc == 1
+
+
+class TestEnvTasks:
+    def test_free_port_ok_and_serving_port_warns(self):
+        r = _check_port("p", "127.0.0.1", 0)  # ephemeral: always free
+        assert r.state == OK
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        try:
+            r = _check_port("p", "127.0.0.1",
+                            srv.getsockname()[1])
+            assert r.state == WARNING
+            assert "already serving" in r.message
+        finally:
+            srv.close()
+
+    def test_dir_writable_and_missing_path_skips(self, tmp_path):
+        r = _check_dir("d", str(tmp_path / "tier0"), 1 << 10)
+        assert r.state == OK
+        assert (tmp_path / "tier0").is_dir()
+        assert _check_dir("d", "", 1).state == SKIPPED
+
+    def test_dir_unwritable_fails(self, tmp_path):
+        ro = tmp_path / "ro"
+        ro.mkdir()
+        ro.chmod(0o500)
+        try:
+            r = _check_dir("d", str(ro), 1 << 10)
+            # root bypasses the mode bits; accept either honest outcome
+            assert r.state in (OK, FAILED)
+        finally:
+            ro.chmod(0o700)
+
+    def test_env_tool_runs_offline(self, tmp_path):
+        """No cluster, no conf dir: every task must still return a row
+        (ssh + cluster-conf report SKIPPED, ports/dirs/native real)."""
+        conf = Configuration()
+        conf.set(Keys.MASTER_HOSTNAME, "127.0.0.1")
+        rows = env_tool(conf, conf_dir=str(tmp_path)).run_all()
+        byname = {r.name: r for r in rows}
+        assert byname["ssh.masters"].state == SKIPPED
+        assert byname["cluster.conf"].state == SKIPPED
+        assert byname["native.lib"].state in (OK, WARNING)
+        assert all(r.state in (OK, WARNING, SKIPPED) for r in rows), \
+            [f"{r.name}={r.state}:{r.message}" for r in rows]
+
+
+class TestHmsTasks:
+    def _hms(self):
+        hms = FakeHmsServer()
+        hms.add_table("default", HmsTable(
+            "orders", "hdfs://nn/warehouse/orders",
+            cols=[("id", "bigint")]))
+        return hms
+
+    def test_all_tasks_pass_against_fake(self):
+        with self._hms() as hms:
+            rows = hms_tool(hms.uri, db_name="default",
+                            tables="orders").run_all()
+        assert [r.state for r in rows] == [OK] * 5, \
+            [(r.name, r.state, r.message) for r in rows]
+
+    def test_bad_uri_fails_fast_and_skips_rest(self):
+        rows = hms_tool("http://nope:1").run_all()
+        assert rows[0].state == FAILED
+        assert {r.state for r in rows[1:]} == {SKIPPED}
+
+    def test_unreachable_metastore_fails_connect(self):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing listens here now
+        rows = hms_tool(f"thrift://127.0.0.1:{port}",
+                        timeout_s=2).run_all()
+        byname = {r.name: r for r in rows}
+        assert byname["hms.connect"].state == FAILED
+
+    def test_missing_database_fails(self):
+        with self._hms() as hms:
+            rows = hms_tool(hms.uri, db_name="absent").run_all()
+        byname = {r.name: r for r in rows}
+        assert byname["hms.database"].state == FAILED
+        assert byname["hms.tables"].state == SKIPPED
+
+    def test_missing_table_reported(self):
+        with self._hms() as hms:
+            rows = hms_tool(hms.uri, db_name="default",
+                            tables="orders,ghosts").run_all()
+        byname = {r.name: r for r in rows}
+        assert byname["hms.tables"].state == FAILED
+        assert "ghosts" in byname["hms.tables"].message
+
+    def test_location_translation_through_fs(self):
+        """Drives the hms.tables fs branch end-to-end: an fs stub
+        exposing get_mount_points (the mount_translations contract)
+        makes an off-mount location FAILED and an on-mount one OK."""
+        from types import SimpleNamespace
+
+        class StubFs:
+            def __init__(self, ufs_uri):
+                self._m = [SimpleNamespace(ufs_uri=ufs_uri,
+                                           alluxio_path="/warehouse")]
+
+            def get_mount_points(self):
+                return self._m
+
+        with self._hms() as hms:  # table location hdfs://nn/warehouse/orders
+            bad = hms_tool(hms.uri, db_name="default", tables="orders",
+                           fs=StubFs("s3://bucket/data")).run_all()
+            good = hms_tool(hms.uri, db_name="default", tables="orders",
+                            fs=StubFs("hdfs://nn/warehouse")).run_all()
+        bad_row = {r.name: r for r in bad}["hms.tables"]
+        assert bad_row.state == FAILED
+        assert "not under any" in bad_row.message
+        assert {r.name: r for r in good}["hms.tables"].state == OK
+
+    def test_cli_roundtrip(self, capsys):
+        with self._hms() as hms:
+            rc = main_hms(["-m", hms.uri, "-t", "orders",
+                           "--no-fs"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "validateHms: 5 task(s), 0 failed" in out

@@ -1,3 +1,6 @@
 """Test cluster harnesses (reference: ``minicluster/``)."""
 
 from alluxio_tpu.minicluster.local_cluster import LocalCluster  # noqa: F401
+from alluxio_tpu.minicluster.multi_process import (  # noqa: F401
+    MultiProcessCluster,
+)

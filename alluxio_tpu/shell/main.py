@@ -26,6 +26,8 @@ Commands:
   validateConf  sanity-check the effective configuration
   validateEnv   pre-flight node checks (ports/dirs/ssh/native/cluster)
   validateHms   validate a Hive metastore before table attachdb
+  runOperation  run one fs operation N times over T threads
+  journalCrashTest  crash-kill masters under load, verify replay
   format     format master journal / worker storage
   master     run a master process
   worker     run a worker process
@@ -131,6 +133,14 @@ def main(argv=None) -> int:
         from alluxio_tpu.shell.validate_env import main_hms
 
         return main_hms(rest, conf=conf)
+    if cmd == "runOperation":
+        from alluxio_tpu.shell.run_operation import main as runop_main
+
+        return runop_main(rest, conf=conf)
+    if cmd == "journalCrashTest":
+        from alluxio_tpu.shell.journal_crash import main as crash_main
+
+        return crash_main(rest)
     if cmd == "format":
         from alluxio_tpu.shell.format import main as format_main
 

@@ -349,7 +349,7 @@ def main_env(argv=None, conf: Optional[Configuration] = None,
 
     ap = argparse.ArgumentParser(prog="alluxio-tpu validateEnv")
     ap.add_argument("--conf-dir", default=None)
-    args = ap.parse_args(argv or [])
+    args = ap.parse_args(argv)
     conf = conf or Configuration()
     tool = env_tool(conf, conf_dir=args.conf_dir)
     return print_results(tool.name, tool.run_all(), out=out)
@@ -367,7 +367,7 @@ def main_hms(argv=None, conf: Optional[Configuration] = None,
                     help="comma-separated table names to check")
     ap.add_argument("--no-fs", action="store_true",
                     help="skip mount-table location translation")
-    args = ap.parse_args(argv or [])
+    args = ap.parse_args(argv)
     fs = None
     if not args.no_fs:
         try:

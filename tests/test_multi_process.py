@@ -80,8 +80,9 @@ class TestMultiProcess:
 
             leader = primary_index()
             # generous failover window: elections on a contended 1-core
-            # CI box can take tens of seconds during a full-suite run
-            fs = FsMasterClient(c.master_addresses, retry_duration_s=120.0)
+            # CI box can take minutes during a full-suite run (observed
+            # 120s insufficient in suite order)
+            fs = FsMasterClient(c.master_addresses, retry_duration_s=300.0)
             acked = []
             for i in range(15):
                 fs.create_directory(f"/pre-{i}")

@@ -69,6 +69,7 @@ def _kernel_candidate_names(pallas_ok: bool) -> list:
     BENCH_KERNEL validation (cheap, before any grant time is spent) and
     factory construction both derive from this list."""
     names = [f"xla-u{u}" for u in sorted({4, 16, UNROLL})]
+    names.append(f"mxu-dot-u{UNROLL}")
     if pallas_ok:
         from alluxio_tpu.ops import reduce_kernel
 
@@ -328,7 +329,7 @@ def main() -> None:
     pinned = os.environ.get("BENCH_KERNEL", "")
     known = _kernel_candidate_names(reduce_kernel.available())
     if pinned and pinned not in known:
-        ok = re.fullmatch(r"xla-u\d+", pinned) or (
+        ok = re.fullmatch(r"(xla|mxu-dot)-u\d+", pinned) or (
             reduce_kernel.available()
             and re.fullmatch(r"pallas-r\d+-u\d+", pinned))
         if not ok:
@@ -477,6 +478,34 @@ def main() -> None:
 
                 return consume
 
+            def make_consume_dot(k, unroll):
+                @jax.jit
+                def consume_dot(blocks, acc0):
+                    # MXU path: view the int32 stream as int8 and
+                    # matvec against a ones vector with int32
+                    # accumulation — the MXU's HBM feed is the most
+                    # heavily pipelined read path on TPU. The scalar
+                    # scale multiplies the DATA side so the form stays
+                    # a per-iteration full read; the calibration
+                    # honesty guard below rejects any candidate the
+                    # compiler manages to hoist anyway.
+                    X = jnp.concatenate(blocks)
+                    X8 = jax.lax.bitcast_convert_type(
+                        X, jnp.int8).reshape(-1, 1024)
+                    w = jnp.ones((1024,), jnp.int8)
+
+                    def body(i, acc):
+                        s8 = (acc % 3 + 1).astype(jnp.int8)
+                        rows = jax.lax.dot_general(
+                            X8 * s8, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+                        return (jnp.sum(rows) + acc) % 1000003
+
+                    return jax.lax.fori_loop(0, k, body, acc0,
+                                             unroll=unroll)
+
+                return consume_dot
+
             def make_consume_pallas(k, unroll, rows):
                 @jax.jit
                 def consume_pallas(blocks, acc0):
@@ -506,6 +535,9 @@ def main() -> None:
                 if name.startswith("xla-u"):
                     u = int(name[len("xla-u"):])
                     return lambda k: make_consume(k, u)
+                if name.startswith("mxu-dot-u"):
+                    u = int(name[len("mxu-dot-u"):])
+                    return lambda k: make_consume_dot(k, u)
                 r, u = name[len("pallas-r"):].split("-u")
                 return lambda k: make_consume_pallas(k, int(u), int(r))
 
@@ -558,6 +590,30 @@ def main() -> None:
                         samples[name].append(time.monotonic() - t0)
                 cal = sorted((sorted(ts)[1], name) for name, ts in
                              samples.items())
+                # honesty guard: a candidate faster than physical HBM
+                # bandwidth means the compiler hoisted/factored the
+                # read out of the loop (e.g. sum(X*s) -> s*sum(X) with
+                # loop-invariant sum(X)) — its timing no longer
+                # measures reads; reject it. Applies only on real TPU:
+                # CPU-backend smoke runs are legitimately unrelated to
+                # the 819 GB/s figure.
+                if device.platform == "tpu":
+                    honest = []
+                    for t, n in cal:
+                        rate = k_cal * total_bytes / max(t, 1e-9) / 1e9
+                        if rate > 1.2 * V5E_HBM_GBPS:
+                            log(f"calibration candidate {n} rejected: "
+                                f"{rate:.0f} GB/s exceeds HBM peak — "
+                                f"compiler hoisted the read")
+                        else:
+                            honest.append((t, n))
+                    # all rejected: fall back to the canonical xla-u4
+                    # (comparable across rounds; the headline-level
+                    # invalid marker below still flags the run if even
+                    # that one is hoisted)
+                    cal = (honest
+                           or [tn for tn in cal if tn[1] == "xla-u4"]
+                           or cal[-1:])
                 # raw seconds, not GB/s: at reduced k_cal the ~65 ms
                 # dispatch cost is a large common-mode offset, so a
                 # GB/s figure here would understate the device rate and
@@ -593,6 +649,12 @@ def main() -> None:
                 times.append(dt)
             order = sorted(range(EPOCHS), key=lambda i: rates[i])
             value = rates[order[EPOCHS // 2]]
+            hoist_suspect = (device.platform == "tpu"
+                             and value > 1.2 * V5E_HBM_GBPS)
+            if hoist_suspect:
+                log(f"WARNING: headline {value:.0f} GB/s exceeds "
+                    f"physical HBM bandwidth — the compiler likely "
+                    f"hoisted the read; this run is marked invalid")
             log(f"warm HBM-tier read epochs GB/s: "
                 f"{', '.join(f'{r:.1f}' for r in sorted(rates))} (K={K})")
             # fixed-overhead fit from the two extreme epochs is meaningless
@@ -623,7 +685,7 @@ def main() -> None:
             loader.close()
             fs.close()
 
-        print(json.dumps({
+        row = {
             "metric": "warm-cache sequential read GB/s/chip into HBM "
                       "(config #1, StressWorkerBench analogue)",
             "value": round(value, 2),
@@ -633,7 +695,14 @@ def main() -> None:
             # loader judged against THIS environment's own ceilings
             "h2d_vs_device_put_ceiling": round(h2d_vs_ceiling, 3),
             "p50_first_batch_vs_raw_floor": round(p50_vs_floor, 3),
-        }), flush=True)
+        }
+        if hoist_suspect:
+            # machine-readable: a JSON consumer must never ingest a
+            # rate the bench itself determined is physically impossible
+            row["invalid"] = ("headline exceeds physical HBM "
+                              "bandwidth — compiler hoisted the read")
+            row["vs_baseline"] = 0.0
+        print(json.dumps(row), flush=True)
     finally:
         shutil.rmtree(base, ignore_errors=True)
 

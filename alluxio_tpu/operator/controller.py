@@ -38,6 +38,8 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+from alluxio_tpu.utils.httperr import error_body
+
 LOG = logging.getLogger(__name__)
 
 
@@ -114,7 +116,7 @@ class K8sApi:
                                         context=self._ctx) as r:
                 return json.loads(r.read() or b"{}")
         except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:300]
+            detail = error_body(e, limit=300)
             if e.code == 409:
                 raise ConflictError(
                     f"k8s {method} {path}: conflict {detail}") from None

@@ -34,6 +34,7 @@ from typing import Dict, Iterator, List, Optional
 from alluxio_tpu.table.hive import PathTranslator, mount_translations
 from alluxio_tpu.table.udb import UdbTable, UnderDatabase
 from alluxio_tpu.utils.exceptions import NotFoundError, UnavailableError
+from alluxio_tpu.utils.httperr import error_body
 
 
 class GlueClient:
@@ -77,7 +78,7 @@ class GlueClient:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 return json.loads(r.read() or b"{}")
         except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:400]
+            detail = error_body(e)
             try:
                 err_type = json.loads(detail).get("__type", "")
             except ValueError:

@@ -28,6 +28,8 @@ import urllib.parse
 import urllib.request
 from typing import BinaryIO, Dict, List, Optional
 
+from alluxio_tpu.utils import httperr
+
 from alluxio_tpu.underfs.base import (
     CreateOptions, DeleteOptions, UfsStatus, UnderFileSystem,
 )
@@ -81,9 +83,11 @@ class WebHdfsUnderFileSystem(UnderFileSystem):
         except urllib.error.HTTPError as e:
             if redirect_body is not None and e.code == 307:
                 loc = e.headers.get("Location", "")
-                e.read()
+                httperr.drain(e)
                 return self._request(method, loc, data=redirect_body)
-            detail = e.read()
+            # parse-sensitive: the RemoteException mapping needs the
+            # FULL body (truncation breaks absence detection)
+            detail = httperr.error_body(e, limit=1 << 20)
             try:
                 remote = json.loads(detail)["RemoteException"]
                 raise _RemoteError(remote.get("exception", ""),
@@ -152,7 +156,7 @@ class WebHdfsUnderFileSystem(UnderFileSystem):
         try:
             return urllib.request.urlopen(req, timeout=self._timeout)
         except urllib.error.HTTPError as e:
-            detail = e.read()
+            detail = httperr.error_body(e, limit=1 << 20)
             try:
                 remote = json.loads(detail)["RemoteException"]
             except (ValueError, KeyError):

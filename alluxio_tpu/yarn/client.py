@@ -18,6 +18,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
+from alluxio_tpu.utils.httperr import error_body
 from alluxio_tpu.yarn.allocator import Container
 
 logger = logging.getLogger(__name__)
@@ -54,8 +55,7 @@ class YarnRestClient:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 raw = r.read()
         except urllib.error.HTTPError as e:
-            raise YarnRestError(e.code,
-                                e.read().decode(errors="replace")) from e
+            raise YarnRestError(e.code, error_body(e)) from e
         return json.loads(raw) if raw.strip() else {}
 
     # -- submission lifecycle (Client.java run()) ---------------------

@@ -1,0 +1,51 @@
+"""HTTP error-body reading must never raise: an exception inside an
+``except HTTPError`` handler escapes the caller's error translation
+(observed: Glue 403 under suite load surfacing as a raw
+ConnectionResetError instead of UnavailableError)."""
+
+from __future__ import annotations
+
+import io
+import urllib.error
+
+import pytest
+
+from alluxio_tpu.utils.httperr import drain, error_body
+
+
+class _ExplodingBody(io.RawIOBase):
+    def read(self, *a):  # noqa: ARG002
+        raise ConnectionResetError(104, "Connection reset by peer")
+
+
+def _http_error(fp) -> urllib.error.HTTPError:
+    return urllib.error.HTTPError("http://x/", 403, "Forbidden",
+                                  {}, fp)
+
+
+class TestErrorBody:
+    def test_normal_body_decoded_and_limited(self):
+        e = _http_error(io.BytesIO(b"a" * 1000))
+        assert error_body(e, limit=10) == "a" * 10
+
+    def test_unreadable_body_never_raises(self):
+        e = _http_error(_ExplodingBody())
+        body = error_body(e)
+        assert "unreadable" in body and "403" in body
+
+    def test_drain_swallows_reset(self):
+        drain(_http_error(_ExplodingBody()))  # must not raise
+
+    def test_glue_translates_unreadable_403(self):
+        """The original failure: GlueClient must raise UnavailableError
+        even when the 403 body read dies mid-flight."""
+        from unittest import mock
+
+        from alluxio_tpu.table.glue import GlueClient
+        from alluxio_tpu.utils.exceptions import UnavailableError
+
+        cli = GlueClient(region="", endpoint="http://127.0.0.1:9")
+        err = _http_error(_ExplodingBody())
+        with mock.patch("urllib.request.urlopen", side_effect=err):
+            with pytest.raises(UnavailableError):
+                cli.get_database("db")

@@ -78,9 +78,13 @@ class GlueClient:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 return json.loads(r.read() or b"{}")
         except urllib.error.HTTPError as e:
-            detail = error_body(e)
+            # parse-sensitive: Glue signals EntityNotFound as HTTP 400
+            # with the type in the body — read it whole, truncate only
+            # what goes into the message
+            full = error_body(e, limit=1 << 20)
+            detail = full[:400]
             try:
-                err_type = json.loads(detail).get("__type", "")
+                err_type = json.loads(full).get("__type", "")
             except ValueError:
                 err_type = ""
             if "EntityNotFoundException" in err_type or e.code == 404:

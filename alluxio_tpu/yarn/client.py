@@ -55,7 +55,10 @@ class YarnRestClient:
             with urllib.request.urlopen(req, timeout=self._timeout) as r:
                 raw = r.read()
         except urllib.error.HTTPError as e:
-            raise YarnRestError(e.code, error_body(e)) from e
+            # RM errors carry full Java stack traces operators need —
+            # keep parity with the pre-helper unlimited read
+            raise YarnRestError(e.code,
+                                error_body(e, limit=1 << 20)) from e
         return json.loads(raw) if raw.strip() else {}
 
     # -- submission lifecycle (Client.java run()) ---------------------

@@ -1,0 +1,130 @@
+"""Contract tests for the libhdfs (pyarrow) HDFS dialect.
+
+``HdfsUnderFileSystem`` delegates every op to a ``pyarrow.fs.FileSystem``
+— the JNI connect in ``__init__`` is the only line that needs a real
+Hadoop install. These tests swap in ``pyarrow.fs.LocalFileSystem``
+(same abstract interface, real pyarrow C++ implementation) rooted at a
+tmpdir, so every translation line in ``underfs/hdfs.py`` runs against
+genuine pyarrow semantics (FileInfo types, FileSelector listing,
+read_at, move) without a NameNode — closing the 'only untested
+connector' gap honestly (reference
+``HdfsUnderFileSystem.java:80``)."""
+
+from __future__ import annotations
+
+import pytest
+
+pafs = pytest.importorskip("pyarrow.fs")
+
+from alluxio_tpu.underfs.hdfs import HdfsUnderFileSystem  # noqa: E402
+
+
+@pytest.fixture()
+def hdfs(tmp_path, monkeypatch):
+    root = tmp_path / "hdfs-root"
+    root.mkdir()
+
+    class _LocalAsHadoop:
+        """LocalFileSystem with hdfs paths mapped under the tmp root."""
+
+        def __init__(self, **kw):
+            self._fs = pafs.LocalFileSystem()
+            self._root = str(root)
+
+        def _m(self, path):
+            return self._root + path
+
+        def open_output_stream(self, path):
+            return self._fs.open_output_stream(self._m(path))
+
+        def open_input_file(self, path):
+            return self._fs.open_input_file(self._m(path))
+
+        def delete_file(self, path):
+            return self._fs.delete_file(self._m(path))
+
+        def delete_dir(self, path):
+            return self._fs.delete_dir(self._m(path))
+
+        def move(self, src, dst):
+            return self._fs.move(self._m(src), self._m(dst))
+
+        def create_dir(self, path, recursive=True):
+            return self._fs.create_dir(self._m(path),
+                                       recursive=recursive)
+
+        def get_file_info(self, sel):
+            if isinstance(sel, pafs.FileSelector):
+                return self._fs.get_file_info(
+                    pafs.FileSelector(self._m(sel.base_dir),
+                                      recursive=sel.recursive))
+            return self._fs.get_file_info(self._m(sel))
+
+    monkeypatch.setattr(pafs, "HadoopFileSystem", _LocalAsHadoop)
+    return HdfsUnderFileSystem("hdfs://nn:8020/", {"hdfs.user": "atpu"})
+
+
+class TestHdfsDialect:
+    def test_create_status_read_roundtrip(self, hdfs):
+        with hdfs.create("/a.bin") as w:
+            w.write(b"hello hdfs")
+        st = hdfs.get_status("/a.bin")
+        assert st is not None and not st.is_directory
+        assert st.length == 10
+        with hdfs.open("/a.bin") as r:
+            assert r.read() == b"hello hdfs"
+
+    def test_open_with_offset_and_read_range(self, hdfs):
+        with hdfs.create("/r.bin") as w:
+            w.write(b"0123456789")
+        with hdfs.open("/r.bin", offset=4) as r:
+            assert r.read(3) == b"456"
+        assert hdfs.read_range("/r.bin", 2, 5) == b"23456"
+
+    def test_full_uri_paths_accepted(self, hdfs):
+        with hdfs.create("hdfs://nn:8020/u.bin") as w:
+            w.write(b"x")
+        assert hdfs.get_status("/u.bin").length == 1
+
+    def test_mkdirs_list_and_types(self, hdfs):
+        hdfs.mkdirs("/d/e")
+        with hdfs.create("/d/f.bin") as w:
+            w.write(b"z")
+        names = {s.name: s for s in hdfs.list_status("/d")}
+        assert set(names) == {"e", "f.bin"}
+        assert names["e"].is_directory
+        assert not names["f.bin"].is_directory
+        assert hdfs.list_status("/d/f.bin") is None  # not a dir
+
+    def test_get_status_absent_is_none(self, hdfs):
+        assert hdfs.get_status("/nope") is None
+
+    def test_delete_file_and_dir_semantics(self, hdfs):
+        with hdfs.create("/del.bin") as w:
+            w.write(b"x")
+        assert hdfs.delete_file("/del.bin") is True
+        assert hdfs.get_status("/del.bin") is None
+        hdfs.mkdirs("/dd")
+        with hdfs.create("/dd/kid") as w:
+            w.write(b"x")
+        from alluxio_tpu.underfs.base import DeleteOptions
+
+        assert hdfs.delete_directory(
+            "/dd", DeleteOptions(recursive=False)) is False
+        assert hdfs.delete_directory(
+            "/dd", DeleteOptions(recursive=True)) is True
+        assert hdfs.get_status("/dd") is None
+
+    def test_rename(self, hdfs):
+        with hdfs.create("/old") as w:
+            w.write(b"mv")
+        assert hdfs.rename_file("/old", "/new") is True
+        assert hdfs.get_status("/old") is None
+        with hdfs.open("/new") as r:
+            assert r.read() == b"mv"
+
+    def test_mtime_populated(self, hdfs):
+        with hdfs.create("/t.bin") as w:
+            w.write(b"x")
+        st = hdfs.get_status("/t.bin")
+        assert st.last_modified_ms and st.last_modified_ms > 1_500_000_000_000

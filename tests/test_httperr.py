@@ -36,6 +36,25 @@ class TestErrorBody:
     def test_drain_swallows_reset(self):
         drain(_http_error(_ExplodingBody()))  # must not raise
 
+    def test_glue_long_entity_not_found_still_notfound(self):
+        """EntityNotFound arrives as HTTP 400 with the type in the
+        body; a >400-char body must still classify as NotFoundError
+        (parse the full body, truncate only the message)."""
+        import json as _json
+        from unittest import mock
+
+        from alluxio_tpu.table.glue import GlueClient
+        from alluxio_tpu.utils.exceptions import NotFoundError
+
+        body = _json.dumps({"Message": "x" * 600,
+                            "__type": "EntityNotFoundException"})
+        err = urllib.error.HTTPError("http://x/", 400, "Bad", {},
+                                     io.BytesIO(body.encode()))
+        cli = GlueClient(region="", endpoint="http://127.0.0.1:9")
+        with mock.patch("urllib.request.urlopen", side_effect=err):
+            with pytest.raises(NotFoundError):
+                cli.get_database("db")
+
     def test_glue_translates_unreadable_403(self):
         """The original failure: GlueClient must raise UnavailableError
         even when the 403 body read dies mid-flight."""

@@ -187,6 +187,13 @@ class MultiProcessCluster:
         raise TimeoutError(f"no primary master within {timeout_s}s: "
                            f"{last_err}")
 
+    def primary_index(self, timeout_s: float = 180.0) -> int:
+        """Index of the master currently serving RPCs (the address
+        format and port list are this class's own invariants — callers
+        must not re-parse them)."""
+        addr = self.wait_for_primary(timeout_s)
+        return self.master_ports.index(int(addr.rsplit(":", 1)[1]))
+
     def wait_for_workers(self, count: int, timeout_s: float = 60.0) -> None:
         from alluxio_tpu.rpc.clients import BlockMasterClient
 

@@ -123,9 +123,16 @@ def run_crash_test(*, total_time_s: float = 20.0,
                    journal_type: str = "LOCAL", num_masters: int = 1,
                    base_dir: Optional[str] = None,
                    test_dir: str = "/crash-test",
+                   kill: str = "all",
                    log=None) -> bool:
+    """``kill``: "all" SIGKILLs every master each cycle (cold restart +
+    replay — the reference tool's shape); "leader" kills only the
+    serving primary, so a multi-master quorum must keep accepting
+    writes through failover while the victim restarts and catches up."""
     from alluxio_tpu.minicluster import MultiProcessCluster
 
+    if kill not in ("all", "leader"):
+        raise ValueError(f"kill must be 'all' or 'leader', got {kill!r}")
     log = log or (lambda *a: print(*a, file=sys.stderr))
     base = base_dir or tempfile.mkdtemp(prefix="atpu_crash_")
     own_base = base_dir is None
@@ -153,16 +160,24 @@ def run_crash_test(*, total_time_s: float = 20.0,
                                max(0.0, deadline - time.monotonic())))
                 if time.monotonic() >= deadline:
                     break
-                # hard-kill every living master (LOCAL: the one
-                # primary; EMBEDDED: leader + followers restart too)
-                for i, m in enumerate(cluster.masters):
-                    if m.alive:
-                        m.kill()
-                crashes += 1
-                log(f"crash #{crashes}: all masters SIGKILLed, "
-                    "restarting")
-                for i in range(len(cluster.masters)):
-                    cluster.start_master(i)
+                if kill == "leader":
+                    li = cluster.primary_index()
+                    cluster.masters[li].kill()
+                    crashes += 1
+                    log(f"crash #{crashes}: leader m{li} SIGKILLed, "
+                        "restarting it (quorum keeps serving)")
+                    cluster.start_master(li)
+                else:
+                    # hard-kill every living master (LOCAL: the one
+                    # primary; EMBEDDED: leader + followers too)
+                    for i, m in enumerate(cluster.masters):
+                        if m.alive:
+                            m.kill()
+                    crashes += 1
+                    log(f"crash #{crashes}: all masters SIGKILLed, "
+                        "restarting")
+                    for i in range(len(cluster.masters)):
+                        cluster.start_master(i)
                 cluster.wait_for_primary()
             for t in threads:
                 t.stop()
@@ -201,6 +216,7 @@ def main(argv=None, out=None) -> int:
     ap.add_argument("--journal", default="LOCAL",
                     choices=["LOCAL", "EMBEDDED"])
     ap.add_argument("--masters", type=int, default=1)
+    ap.add_argument("--kill", default="all", choices=["all", "leader"])
     ap.add_argument("--dir", default="/crash-test")
     args = ap.parse_args(argv)
     stream = out or sys.stderr
@@ -212,7 +228,8 @@ def main(argv=None, out=None) -> int:
         total_time_s=args.total_time, max_alive_s=args.max_alive,
         creates=args.creates, create_deletes=args.create_deletes,
         create_renames=args.create_renames, journal_type=args.journal,
-        num_masters=args.masters, test_dir=args.dir, log=log)
+        num_masters=args.masters, test_dir=args.dir, kill=args.kill,
+        log=log)
     log("journalCrashTest: " + ("PASSED" if ok else "FAILED"))
     return 0 if ok else 1
 

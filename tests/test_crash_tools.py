@@ -72,3 +72,18 @@ class TestJournalCrash:
         assert ok, "\n".join(lines)
         assert any("crash #" in ln for ln in lines), \
             "no crash cycle ever ran"
+
+    def test_leader_kill_quorum_failover_drill(self, tmp_path):
+        """--kill leader on an EMBEDDED 3-master quorum: only the
+        serving primary dies each cycle; the remaining 2/3 quorum must
+        keep acking ops through failover and every ack must survive."""
+        lines = []
+        ok = run_crash_test(
+            total_time_s=30.0, max_alive_s=12.0,
+            creates=1, create_deletes=0, create_renames=1,
+            journal_type="EMBEDDED", num_masters=3, kill="leader",
+            base_dir=str(tmp_path), log=lambda *a: lines.append(
+                " ".join(str(x) for x in a)))
+        assert ok, "\n".join(lines)
+        assert any("leader m" in ln for ln in lines), \
+            "no leader kill ever ran"

@@ -97,6 +97,16 @@ class FsMasterClient(_BaseClient):
     def exists(self, path: str) -> bool:
         return self._call("exists", {"path": str(path)})["exists"]
 
+    @staticmethod
+    def _decode_columnar(cols: dict) -> List[FileInfo]:
+        """Struct-of-arrays listing wire format -> FileInfo rows (the
+        one decoder for both the unary and streamed paths)."""
+        if not cols:
+            return []
+        keys = tuple(cols)
+        return [FileInfo.from_wire(dict(zip(keys, row)))
+                for row in zip(*(cols[k] for k in keys))]
+
     def list_status(self, path: str, recursive: bool = False,
                     sync_interval_ms: int = -1) -> List[FileInfo]:
         resp = self._call("list_status", {
@@ -105,12 +115,7 @@ class FsMasterClient(_BaseClient):
         col = resp.get("columnar")
         if col is None:  # server predates the columnar listing format
             return [FileInfo.from_wire(d) for d in resp["infos"]]
-        cols = col["cols"]
-        if not cols:
-            return []
-        keys = tuple(cols)
-        return [FileInfo.from_wire(dict(zip(keys, row)))
-                for row in zip(*(cols[k] for k in keys))]
+        return self._decode_columnar(col["cols"])
 
     def iter_status(self, path: str, recursive: bool = False,
                     sync_interval_ms: int = -1,
@@ -127,7 +132,7 @@ class FsMasterClient(_BaseClient):
 
         request = {"path": str(path), "recursive": recursive,
                    "sync_interval_ms": sync_interval_ms,
-                   "batch_size": batch_size}
+                   "batch_size": batch_size, "columnar": True}
 
         def attempt():
             it = self._channel.call_stream(
@@ -151,8 +156,12 @@ class FsMasterClient(_BaseClient):
 
         chunks = it if first is None else chain([first], it)
         for chunk in chunks:
-            for d in chunk.get("infos", []):
-                yield FileInfo.from_wire(d)
+            cols = chunk.get("cols")
+            if cols is not None:  # columnar batch (struct-of-arrays)
+                yield from self._decode_columnar(cols)
+            else:  # row-dict batch (pre-columnar server)
+                for d in chunk.get("infos", []):
+                    yield FileInfo.from_wire(d)
 
     def create_file(self, path: str, **opts) -> FileInfo:
         return FileInfo.from_wire(self._call(

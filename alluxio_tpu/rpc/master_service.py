@@ -108,16 +108,30 @@ def fs_master_service(fsm: FileSystemMaster,
         of ``file_system_master.proto:475-590``): the full listing
         resolves once against the version-guarded cache, then ships in
         batches so a million-entry directory never rides one frame.
-        Timed + audited like the unary RPCs: the listing resolves (and
-        is audited) before the first chunk goes out; batching itself is
-        transport work."""
-        rows = _audited_resolve(r)
+        Columnar-requesting clients get struct-of-arrays batches
+        (sliced views of the memoized transpose — same encode win as
+        the unary columnar path); recursive listings fall back to row
+        dicts. Timed + audited like the unary RPCs: the listing
+        resolves (and is audited) before the first chunk goes out;
+        batching itself is transport work."""
+        res = _audited_resolve(r)
         batch = max(1, int(r.get("batch_size", 500)))
-        for i in range(0, len(rows), batch):
-            yield {"infos": rows[i:i + batch],
-                   "offset": i, "total": len(rows)}
+        if isinstance(res, dict):  # columnar {"n": N, "cols": {...}}
+            cols, n = res["cols"], res.get("n", 0)
+            keys = list(cols)
+            for i in range(0, n, batch):
+                yield {"cols": {k: cols[k][i:i + batch] for k in keys},
+                       "offset": i, "total": n}
+        else:
+            for i in range(0, len(res), batch):
+                yield {"infos": res[i:i + batch],
+                       "offset": i, "total": len(res)}
 
     def _resolve(r: dict):
+        if r.get("columnar") and not r.get("recursive"):
+            return fsm.list_status(
+                r["path"], sync_interval_ms=r.get("sync_interval_ms",
+                                                  -1), columnar=True)
         return fsm.list_status(
             r["path"], recursive=r.get("recursive", False),
             sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)

@@ -97,6 +97,9 @@ SUITE = (
     ("master-ListStatus", ["master", "--op", "ListStatus", "--threads",
                            "8", "--duration", "5",
                            "--fixed-count", "100"]),
+    ("master-ListStatus-large", ["master", "--op", "ListStatusStream",
+                                 "--threads", "2", "--duration", "6",
+                                 "--fixed-count", "10000"]),
     ("master-DeleteFile", ["master", "--op", "DeleteFile", "--threads",
                            "8", "--duration", "5",
                            "--fixed-count", "2000"]),

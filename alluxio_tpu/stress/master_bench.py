@@ -18,7 +18,8 @@ from alluxio_tpu.stress.base import (
 )
 from alluxio_tpu.stress.cluster import bench_cluster
 
-OPS = ("CreateFile", "GetStatus", "ListStatus", "DeleteFile", "RenameFile")
+OPS = ("CreateFile", "GetStatus", "ListStatus", "ListStatusStream",
+       "DeleteFile", "RenameFile")
 
 
 def _prep(fs, op: str, threads: int, fixed_count: int,
@@ -30,7 +31,8 @@ def _prep(fs, op: str, threads: int, fixed_count: int,
     for t in range(threads):
         fs.create_directory(f"{base_path}/{t}", allow_exists=True,
                             recursive=True)
-    if op in ("GetStatus", "ListStatus", "DeleteFile", "RenameFile"):
+    if op in ("GetStatus", "ListStatus", "ListStatusStream",
+              "DeleteFile", "RenameFile"):
         for t in range(threads):
             for i in range(fixed_count):
                 fs.write_all(f"{base_path}/{t}/f-{i:06d}", b"",
@@ -65,6 +67,19 @@ def run(*, op: str = "CreateFile", master: Optional[str] = None,
             def body(t: int, i: int) -> int:
                 fs.fs_master.list_status(f"{base_path}/{t}")
                 return 0
+        elif op == "ListStatusStream":
+            # the partial-response listing RPC (reference streams
+            # ListStatus, file_system_master.proto:475-590) — sized for
+            # LARGE directories where one-shot listing would build the
+            # whole reply in memory
+            def body(t: int, i: int) -> int:
+                n = 0
+                for _st in fs.fs_master.iter_status(f"{base_path}/{t}"):
+                    n += 1
+                if n < fixed_count:
+                    raise RuntimeError(
+                        f"stream returned {n} < {fixed_count} entries")
+                return n  # drive() sums returns -> real entry counts
         elif op == "DeleteFile":
             def body(t: int, i: int) -> int:
                 n = next(counters[t])
@@ -96,6 +111,10 @@ def run(*, op: str = "CreateFile", master: Optional[str] = None,
                     "target_ops_per_s": target_ops_per_s,
                     "master": master or "in-process"},
             metrics={"ops_per_s": round(res.ops_per_s, 1),
+                     **({"entries_per_s":
+                         round(res.bytes / res.wall_s, 1)
+                         if res.wall_s > 0 else 0.0}
+                        if op == "ListStatusStream" else {}),
                      **percentiles(res.latencies_s)},
             errors=res.errors, duration_s=res.wall_s)
 

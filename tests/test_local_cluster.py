@@ -50,6 +50,38 @@ class TestStreamedListing:
         one = list(fs.fs_master.iter_status("/stream-one"))
         assert len(one) == 1 and one[0].name == "stream-one"
 
+    def test_iter_status_recursive_uses_row_batches(self, fs):
+        """recursive=True rides the row-dict fallback (columnar is
+        non-recursive only) and must surface the whole subtree."""
+        fs.create_directory("/stream-rec/a/b", recursive=True)
+        fs.write_all("/stream-rec/a/f1", b"x")
+        fs.write_all("/stream-rec/a/b/f2", b"x")
+        got = sorted(i.path for i in fs.fs_master.iter_status(
+            "/stream-rec", recursive=True, batch_size=2))
+        assert got == ["/stream-rec/a", "/stream-rec/a/b",
+                       "/stream-rec/a/b/f2", "/stream-rec/a/f1"]
+
+    def test_iter_status_decodes_row_dict_batches(self, fs):
+        """A pre-columnar server ships {"infos": [...]} batches; the
+        client iterator must still decode them (mixed-version
+        cluster)."""
+        fs.create_directory("/stream-compat", recursive=True)
+        fs.write_all("/stream-compat/f", b"x")
+        real = fs.fs_master._channel.call_stream
+
+        def no_columnar(service, method, request):
+            req = dict(request)
+            req.pop("columnar", None)  # old server ignores the flag
+            return real(service, method, req)
+
+        from unittest import mock
+
+        with mock.patch.object(fs.fs_master._channel, "call_stream",
+                               side_effect=no_columnar):
+            got = [i.name for i in
+                   fs.fs_master.iter_status("/stream-compat")]
+        assert got == ["f"]
+
 
 class TestEndToEnd:
     def test_write_read_roundtrip(self, fs):

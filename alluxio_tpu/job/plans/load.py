@@ -113,12 +113,35 @@ class LoadDefinition(PlanDefinition):
     def _await_commit(block_master, block_id: int, hostname: str,
                       timeout_s: float = 60.0) -> None:
         deadline = time.monotonic() + timeout_s
+        sleep_s = 0.02
+        next_live_check = time.monotonic() + 1.0
+        absent_checks = 0
         while time.monotonic() < deadline:
             info = block_master.get_block_info(block_id)
             if any(loc.address.tiered_identity.value("host") == hostname
                    for loc in info.locations):
                 return
-            time.sleep(0.02)
+            if time.monotonic() >= next_live_check:
+                # fail FAST when the target worker has left the live
+                # set (killed mid-task): burning the full timeout in a
+                # 20ms poll loop clogs the executor pool and starves
+                # the re-replication the failure is supposed to
+                # trigger. HYSTERESIS (3 consecutive absent checks,
+                # ~3s): a task-raised error fails the whole plan, so a
+                # transient lost-marking (GC pause under a short
+                # worker timeout) must get the chance to re-register —
+                # only a persistently-absent worker aborts the wait.
+                next_live_check = time.monotonic() + 1.0
+                live = {w.address.tiered_identity.value("host")
+                        for w in block_master.get_worker_infos()}
+                absent_checks = 0 if hostname in live \
+                    else absent_checks + 1
+                if absent_checks >= 3:
+                    raise UnavailableError(
+                        f"target worker {hostname} left the live set "
+                        f"while waiting for block {block_id}")
+            time.sleep(sleep_s)
+            sleep_s = min(sleep_s * 1.5, 0.25)  # adaptive backoff
         raise UnavailableError(
             f"block {block_id} did not land on {hostname} "
             f"within {timeout_s}s")

@@ -114,6 +114,41 @@ SUITE = (
 )
 
 
+#: sentinel bench name for the host-speed stamp row — consumers
+#: (bench.py suite counting) must exclude it by THIS constant
+HOST_CALIBRATION_BENCH = "host-calibration"
+
+
+def _host_calibration():
+    """A suite run is only comparable to another on a like-for-like
+    host: the CI container's per-core speed drifts several-fold between
+    sessions (observed: 10M-adds 2126 ms on one allocation vs ~600 ms
+    on another — every GIL-bound op/s row scales with it). This row
+    stamps each BENCH_SUITE with the host's measured speed so later
+    readers can normalize instead of mistaking allocation drift for
+    code regressions."""
+    import os
+    import platform
+    import time as _t
+
+    from alluxio_tpu.stress.base import BenchResult
+
+    t0 = _t.monotonic()
+    x = 0
+    for i in range(10_000_000):
+        x += i
+    loop_ms = (_t.monotonic() - t0) * 1000
+    cores = os.cpu_count() or 0
+    return BenchResult(
+        bench=HOST_CALIBRATION_BENCH,
+        params={"python": platform.python_version(), "cores": cores},
+        metrics={"python_10m_adds_ms": round(loop_ms, 1),
+                 "note": "GIL-bound op/s rows scale ~inversely with "
+                         "python_10m_adds_ms; compare suites only "
+                         "after normalizing"},
+        errors=0, duration_s=round(loop_ms / 1000, 3))
+
+
 def run_suite() -> list:
     """The five BASELINE configs + master-op samples, each in its OWN
     subprocess: a bench must not inherit the previous one's page-cache
@@ -131,12 +166,13 @@ def run_suite() -> list:
     # the stress suite is host-side
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    results = []
-    for name, argv in SUITE:
+    results = [_host_calibration()]
+    print(results[0].json_line(), flush=True)
+    for bench_i, (name, argv) in enumerate(SUITE):
         print(f"[suite] running {name} ...", file=sys.stderr, flush=True)
         proc = None
         try:
-            if results:
+            if bench_i:
                 # let the previous bench's teardown IO (tmpdir deletion,
                 # page-cache writeback) drain — it measured 2-3x into
                 # the next bench's tail latencies on a 1-core host
@@ -145,18 +181,32 @@ def run_suite() -> list:
             proc = subprocess.run(
                 [sys.executable, "-m", "alluxio_tpu.stress", *argv],
                 capture_output=True, text=True, timeout=600, env=env)
-            line = (proc.stdout or "").strip().splitlines()[-1]
-            d = json.loads(line)
+            out_lines = (proc.stdout or "").strip().splitlines()
+            if not out_lines:
+                raise RuntimeError(
+                    f"bench child produced no output (rc="
+                    f"{proc.returncode})")
+            d = json.loads(out_lines[-1])
             r = BenchResult(bench=d["bench"], params=d["params"],
                             metrics=d["metrics"], errors=d["errors"],
                             duration_s=d["duration_s"])
         except Exception as e:  # noqa: BLE001 — record and continue
             r = BenchResult(bench=name, params={}, metrics={},
                             errors=1, duration_s=0.0)
+            # on TimeoutExpired proc was never assigned, but
+            # subprocess.run attaches the drained output to the
+            # exception itself
+            src = proc if proc is not None else e
+            tail = getattr(src, "stderr", None) or ""
+            if isinstance(tail, bytes):  # TimeoutExpired keeps bytes
+                tail = tail.decode(errors="replace")
+            tail = tail[-2000:]
+            # the child's stderr tail goes IN THE ROW: a bare exception
+            # name from the wrapper's own parse (observed:
+            # 'IndexError' on empty stdout) is undiagnosable later
             r.metrics["error"] = f"{type(e).__name__}: {e}"
-            tail = ""
-            if proc is not None and getattr(proc, "stderr", None):
-                tail = proc.stderr[-300:]
+            if tail:
+                r.metrics["child_stderr_tail"] = tail
             print(f"[suite] {name} FAILED: {e} {tail}", file=sys.stderr)
         print(r.json_line(), flush=True)
         results.append(r)

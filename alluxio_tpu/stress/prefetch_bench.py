@@ -23,7 +23,7 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
         replication: int = 1, block_size: int = 4 << 20,
         base_path: str = "/stress-prefetch",
         pressure: bool = False, kill_worker: bool = False,
-        rereplicate_timeout_s: float = 120.0) -> BenchResult:
+        rereplicate_timeout_s: float = 240.0) -> BenchResult:
     """``pressure=True`` sizes worker tiers so eviction must fire
     mid-load (tiers are pre-filled with MUST_CACHE filler the load then
     evicts). ``kill_worker=True`` stops one worker (block + job) while

@@ -48,3 +48,38 @@ def _reset_heartbeats():
     yield
     HeartbeatThread.reset_timer_policy()
     HeartbeatScheduler.clear()
+
+
+def pytest_runtest_protocol(item, nextitem):
+    """Bounded rerun for ``steal_prone`` tests: the CI container's CPU
+    is shared and stolen in multi-second bursts (observed 3-4x
+    slowdowns mid-round), which flakes the real-subprocess election /
+    kill-recovery tests on pure timing. A marked test that fails gets
+    exactly ONE fresh run; a genuine failure still fails twice and
+    surfaces. Unmarked tests are untouched."""
+    if item.get_closest_marker("steal_prone") is None:
+        return None
+    from _pytest.runner import runtestprotocol
+
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    first_failed = [r for r in reports if r.failed]
+    if first_failed:
+        # only the FINAL attempt is logged: logging the first failure
+        # would count the test failed even when the rerun passes
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+        for r in reports:
+            if r.when != "call":
+                continue
+            # the first attempt's traceback must not vanish — an
+            # intermittently-real bug that passes on retry has to stay
+            # visible (render with -rA, or via CI report consumers)
+            r.sections.append(
+                ("steal_prone first-attempt failure",
+                 "\n".join(str(f.longrepr) for f in first_failed)))
+    for r in reports:
+        item.ihook.pytest_runtest_logreport(report=r)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True

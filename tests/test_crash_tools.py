@@ -5,6 +5,8 @@ from __future__ import annotations
 
 import io
 
+import pytest
+
 from alluxio_tpu.conf import Configuration, Keys
 from alluxio_tpu.minicluster import LocalCluster
 from alluxio_tpu.shell.journal_crash import run_crash_test
@@ -58,6 +60,7 @@ class TestRunOperation:
 
 
 class TestJournalCrash:
+    @pytest.mark.steal_prone
     def test_acked_ops_survive_repeated_master_kills(self, tmp_path):
         """The reference tool's contract: SIGKILL the master mid-load
         on a real subprocess cluster, several cycles, then every
@@ -73,6 +76,7 @@ class TestJournalCrash:
         assert any("crash #" in ln for ln in lines), \
             "no crash cycle ever ran"
 
+    @pytest.mark.steal_prone
     def test_leader_kill_quorum_failover_drill(self, tmp_path):
         """--kill leader on an EMBEDDED 3-master quorum: only the
         serving primary dies each cycle; the remaining 2/3 quorum must

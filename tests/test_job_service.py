@@ -371,6 +371,7 @@ class TestTaskFailover:
         # w3 has no task of this job; w2 already has one -> w3 chosen
         assert sent[2:] == [3]
 
+    @pytest.mark.steal_prone
     def test_fault_drill_end_to_end(self, tmp_path):
         """The full drill at tiny scale: replication 2 + eviction
         pressure + a worker killed mid-load; the plan completes and

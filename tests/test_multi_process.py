@@ -52,6 +52,7 @@ class TestMultiProcess:
             c2.create_directory("/post-failover")
             assert c2.exists("/post-failover")
 
+    @pytest.mark.steal_prone
     def test_embedded_quorum_leader_kill_under_load(self, tmp_path):
         """The VERDICT done-criterion for the replicated journal: a
         3-master Raft quorum (per-master journals, NO shared filesystem)

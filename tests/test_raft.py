@@ -231,6 +231,7 @@ class TestQuorum:
             for j in systems:
                 j.stop()
 
+    @pytest.mark.steal_prone
     def test_quorum_info_reports_members(self, tmp_path):
         systems, _ = make_quorum(tmp_path, free_ports(3))
         try:

@@ -692,7 +692,8 @@ class Keys:
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,
         description="Comma-separated metric sinks to start (console, "
-                    "csv, jsonl) — reference: metrics/sink/*Sink.java.")
+                    "csv, jsonl, graphite) — reference: "
+                    "metrics/sink/*Sink.java.")
     METRICS_SINK_INTERVAL = _k(
         "atpu.metrics.sink.interval", KeyType.DURATION, default="10s",
         scope=Scope.ALL)
@@ -703,6 +704,15 @@ class Keys:
     METRICS_SINK_JSONL_PATH = _k(
         "atpu.metrics.sink.jsonl.path", KeyType.STRING,
         default="/tmp/atpu-metrics/metrics.jsonl", scope=Scope.ALL)
+    METRICS_SINK_GRAPHITE_ADDRESS = _k(
+        "atpu.metrics.sink.graphite.address", KeyType.STRING,
+        default="", scope=Scope.ALL,
+        description="host:port of the Graphite/Carbon plaintext "
+                    "listener (reference: metrics/sink/"
+                    "GraphiteSink.java).")
+    METRICS_SINK_GRAPHITE_PREFIX = _k(
+        "atpu.metrics.sink.graphite.prefix", KeyType.STRING,
+        default="alluxio-tpu", scope=Scope.ALL)
     USER_METRICS_COLLECTION_ENABLED = _k(
         "atpu.user.metrics.collection.enabled", KeyType.BOOL, default=False,
         scope=Scope.CLIENT,

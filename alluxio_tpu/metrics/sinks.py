@@ -1,11 +1,12 @@
 """Metrics sinks: periodic export of registry snapshots.
 
 Re-design of ``core/common/src/main/java/alluxio/metrics/sink/
-{Sink,ConsoleSink,CsvSink,Slf4jSink}.java`` (Graphite/JMX have no
+{Sink,ConsoleSink,CsvSink,GraphiteSink,Slf4jSink}.java`` (JMX has no
 environment analogue here; the JSON-lines sink is the modern structured
 equivalent): a sink receives the flat snapshot each scheduler tick and
 writes it somewhere durable/visible. Sinks are configured by name
-(``atpu.metrics.sinks=csv,jsonl,console``) and driven by one heartbeat.
+(``atpu.metrics.sinks=csv,jsonl,console,graphite``) and driven by one
+heartbeat.
 """
 
 from __future__ import annotations
@@ -87,6 +88,39 @@ class JsonLinesSink(Sink):
             LOG.debug("jsonl sink write failed", exc_info=True)
 
 
+class GraphiteSink(Sink):
+    """Plaintext Graphite/Carbon protocol (reference:
+    ``metrics/sink/GraphiteSink.java``): one ``<prefix>.<name> <value>
+    <unix-ts>\\n`` line per metric over TCP. The socket reconnects per
+    report tick — Carbon treats connections as cheap and a long-lived
+    one would silently die across Carbon restarts."""
+
+    def __init__(self, host: str, port: int,
+                 prefix: str = "alluxio-tpu") -> None:
+        self._host = host
+        self._port = port
+        self._prefix = prefix.rstrip(".")
+
+    @staticmethod
+    def _sanitize(name: str) -> str:
+        # Graphite path segments must not contain spaces; dots are
+        # hierarchy separators and kept as-is
+        return name.replace(" ", "_")
+
+    def report(self, snapshot: Dict[str, float]) -> None:
+        import socket
+
+        ts = int(time.time())
+        lines = [f"{self._prefix}.{self._sanitize(n)} {v} {ts}\n"
+                 for n, v in sorted(snapshot.items())
+                 if isinstance(v, (int, float))]
+        if not lines:
+            return
+        with socket.create_connection((self._host, self._port),
+                                      timeout=10) as s:
+            s.sendall("".join(lines).encode())
+
+
 class SinkManager:
     """Builds sinks from config and reports on a heartbeat tick
     (reference: MetricsSystem's sink scheduling)."""
@@ -118,9 +152,28 @@ class SinkManager:
                     root, ext = os.path.splitext(p)
                     p = f"{root}.{me}{ext}"
                 self.sinks.append(JsonLinesSink(p))
+            elif name == "graphite":
+                addr = conf.get(Keys.METRICS_SINK_GRAPHITE_ADDRESS)
+                if not addr:
+                    LOG.warning("graphite sink configured without "
+                                "atpu.metrics.sink.graphite.address")
+                    continue
+                host, sep, port = addr.rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    # a malformed address must fail LOUDLY: silently
+                    # defaulting host/port would ship metrics to the
+                    # wrong place while the operator believes they
+                    # configured carbon
+                    LOG.warning("graphite sink skipped: address %r is "
+                                "not host:port", addr)
+                    continue
+                self.sinks.append(GraphiteSink(
+                    host, int(port),
+                    prefix=conf.get(
+                        Keys.METRICS_SINK_GRAPHITE_PREFIX)))
             else:
                 LOG.warning("unknown metrics sink %r (known: console, "
-                            "csv, jsonl)", name)
+                            "csv, jsonl, graphite)", name)
 
     def heartbeat(self) -> None:
         if not self.sinks:

@@ -69,13 +69,15 @@ def pytest_runtest_protocol(item, nextitem):
         # only the FINAL attempt is logged: logging the first failure
         # would count the test failed even when the rerun passes
         reports = runtestprotocol(item, nextitem=nextitem, log=False)
-        for r in reports:
-            if r.when != "call":
-                continue
-            # the first attempt's traceback must not vanish — an
-            # intermittently-real bug that passes on retry has to stay
-            # visible (render with -rA, or via CI report consumers)
-            r.sections.append(
+        # the first attempt's traceback must not vanish — an
+        # intermittently-real bug that passes on retry has to stay
+        # visible (render with -rA, or via CI report consumers).
+        # Attach to the call report, or the last report when the rerun
+        # died in setup and produced no call phase.
+        target = next((r for r in reports if r.when == "call"),
+                      reports[-1] if reports else None)
+        if target is not None:
+            target.sections.append(
                 ("steal_prone first-attempt failure",
                  "\n".join(str(f.longrepr) for f in first_failed)))
     for r in reports:

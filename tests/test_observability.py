@@ -62,6 +62,53 @@ class TestSinks:
         assert (tmp_path / "csv" / "Master.TestOps.csv").exists()
         assert (tmp_path / "m.jsonl").exists()
 
+    def test_graphite_sink_plaintext_protocol(self, registry, conf):
+        """GraphiteSink speaks the Carbon plaintext line protocol
+        (reference ``metrics/sink/GraphiteSink.java``): one
+        ``prefix.name value unix-ts`` line per metric over TCP."""
+        import socket
+        import threading
+
+        from alluxio_tpu.metrics.sinks import GraphiteSink
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        got = []
+
+        def accept():
+            c, _ = srv.accept()
+            with c:
+                while chunk := c.recv(4096):
+                    got.append(chunk)
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        try:
+            GraphiteSink("127.0.0.1", srv.getsockname()[1],
+                         prefix="clusterA").report(registry.snapshot())
+            t.join(timeout=10)
+        finally:
+            srv.close()
+        lines = b"".join(got).decode().splitlines()
+        row = next(ln for ln in lines
+                   if ln.startswith("clusterA.Master.TestOps "))
+        name, value, ts = row.split(" ")
+        assert float(value) == 7.0
+        assert int(ts) > 1_500_000_000
+
+        # manager wiring: address key -> sink; missing OR malformed
+        # addresses are skipped loudly, never silently defaulted
+        conf.set(Keys.METRICS_SINKS, "graphite")
+        assert SinkManager(conf, registry).sinks == []
+        for bad in ("carbon.internal", "carbon:20o3", ":2003"):
+            conf.set(Keys.METRICS_SINK_GRAPHITE_ADDRESS, bad)
+            assert SinkManager(conf, registry).sinks == [], bad
+        conf.set(Keys.METRICS_SINK_GRAPHITE_ADDRESS, "carbon:2003")
+        mgr = SinkManager(conf, registry)
+        assert len(mgr.sinks) == 1
+        assert mgr.sinks[0]._port == 2003
+
     def test_failing_sink_does_not_kill_others(self, registry, tmp_path):
         class Boom(ConsoleSink):
             def report(self, snapshot):

@@ -29,6 +29,11 @@ def job_master_service(job_master) -> ServiceDefinition:
               lambda r: {"types": job_master.list_plan_types()})
     svc.unary("register_worker", lambda r: {
         "worker_id": job_master.register_worker(r["hostname"])})
+    svc.unary("list_workers", lambda r: {
+        "workers": [{"worker_id": w.worker_id,
+                     "hostname": w.hostname,
+                     "health": w.health.to_wire()}
+                    for w in job_master.workers()]})
     svc.unary("worker_heartbeat", lambda r: {
         "commands": job_master.heartbeat(
             r["worker_id"], r.get("health") or {},
@@ -67,6 +72,12 @@ class JobMasterClient:
     def list_jobs(self) -> List[JobInfo]:
         return [JobInfo.from_wire(j)
                 for j in self._call("list_jobs", {})["jobs"]]
+
+    def list_workers(self) -> List[Dict[str, Any]]:
+        """Registered job workers with their latest health report
+        (reference: the worker-health section of
+        ``fsadmin report jobservice``)."""
+        return self._call("list_workers", {})["workers"]
 
     def list_plan_types(self) -> List[str]:
         return self._call("list_plan_types", {})["types"]

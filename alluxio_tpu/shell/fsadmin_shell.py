@@ -21,11 +21,13 @@ ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 @ADMIN_SHELL.register
 class ReportCommand(Command):
     name = "report"
-    description = "Report cluster summary|capacity|ufs|metrics."
+    description = ("Report cluster summary|capacity|ufs|metrics|"
+                   "jobservice.")
 
     def configure(self, p):
         p.add_argument("category", nargs="?", default="summary",
-                       choices=["summary", "capacity", "ufs", "metrics"])
+                       choices=["summary", "capacity", "ufs", "metrics",
+                                "jobservice"])
 
     def run(self, args, ctx):
         return getattr(self, f"_{args.category}")(ctx)
@@ -98,6 +100,36 @@ class ReportCommand(Command):
         snap = ctx.meta_client().get_metrics()
         for k in sorted(snap):
             ctx.print(f"{k}  {snap[k]}")
+        return 0
+
+    def _jobservice(self, ctx):
+        """Job-service health + activity (reference ``fsadmin report
+        jobservice``, ``cli/fsadmin/report/
+        JobServiceMetricsCommand.java``)."""
+        jc = ctx.job_client()
+        workers = jc.list_workers()
+        ctx.print(f"Job workers: {len(workers)}")
+        for w in sorted(workers, key=lambda w: w["worker_id"]):
+            h = w.get("health") or {}
+            ctx.print(
+                f"  {w['hostname']} (id={w['worker_id']}): "
+                f"active {h.get('num_active_tasks', 0)}/"
+                f"{h.get('task_pool_size', 0)} tasks, "
+                f"{h.get('unfinished_tasks', 0)} unfinished, "
+                f"load {h.get('load_avg', 0.0):.2f}")
+        jobs = jc.list_jobs()
+        by_status: dict = {}
+        for j in jobs:
+            by_status[j.status] = by_status.get(j.status, 0) + 1
+        ctx.print("Status: " + (", ".join(
+            f"{s}={n}" for s, n in sorted(by_status.items()))
+            or "(no jobs)"))
+        newest = sorted(jobs, key=lambda j: j.last_updated_ms,
+                        reverse=True)[:10]
+        for j in newest:
+            err = f" error={j.error_message}" if j.error_message else ""
+            ctx.print(f"  job {j.job_id} {j.name or '?'}: "
+                      f"{j.status}{err}")
         return 0
 
 

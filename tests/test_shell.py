@@ -259,6 +259,23 @@ class TestAdminShell:
                                  ["report", "metrics"])
         assert code == 0 and "Master.rpc" in out
 
+    def test_report_jobservice(self, cluster):
+        """``report jobservice`` (reference
+        ``JobServiceMetricsCommand.java``): worker health + per-status
+        job counts + recent jobs against a live job service."""
+        fs = cluster.file_system()
+        fs.write_all("/js", b"x" * 1024)
+        jc = cluster.job_client()
+        job_id = jc.run({"type": "load", "path": "/js"})
+        jc.wait_for_job(job_id)
+        code, out, _ = run_shell(ADMIN_SHELL, cluster,
+                                 ["report", "jobservice"])
+        assert code == 0
+        assert "Job workers: " in out
+        assert "COMPLETED=" in out
+        assert f"job {job_id} " in out
+        fs.close()
+
     def test_doctor_and_getconf(self, cluster):
         code, out, _ = run_shell(ADMIN_SHELL, cluster, ["doctor"])
         assert code == 0

@@ -322,8 +322,8 @@ class BlockMasterClient(_BaseClient):
 class MetaMasterClient(_BaseClient):
     service = META_SERVICE
 
-    def get_configuration(self) -> dict:
-        return self._call("get_configuration", {})
+    def get_configuration(self, *, sources: bool = False) -> dict:
+        return self._call("get_configuration", {"sources": sources})
 
     def get_config_hash(self) -> str:
         return self._call("get_config_hash", {})["hash"]

@@ -269,6 +269,9 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
             permission_checker.check_superuser(authenticated_user())
     svc.unary("get_configuration", lambda r: {
         "properties": conf.to_map(min_source=Source.SITE_PROPERTY),
+        "sources": {k: conf.source(k).name for k in
+                    conf.to_map(min_source=Source.SITE_PROPERTY)}
+        if r.get("sources") else {},
         "hash": conf.hash()})
     svc.unary("get_config_hash", lambda r: {"hash": conf.hash()})
     svc.unary("get_master_info", lambda r: {

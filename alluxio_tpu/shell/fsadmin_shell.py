@@ -379,13 +379,17 @@ class GetConfCommand(Command):
     def run(self, args, ctx):
         from alluxio_tpu.conf.property_key import mask_credential
 
-        props = ctx.meta_client().get_configuration()["properties"]
+        resp = ctx.meta_client().get_configuration(sources=args.source)
+        props = resp["properties"]
+        srcs = resp.get("sources") or {}
         # display surface: mask credential values (reference
         # DisplayType.CREDENTIALS handling in GetConfCommand)
         props = {k: mask_credential(k, v) for k, v in props.items()}
         if args.key:
             if args.key in props:
-                ctx.print(props[args.key])
+                suffix = (f"  (source: {srcs[args.key]})"
+                          if args.key in srcs else "")
+                ctx.print(f"{props[args.key]}{suffix}")
                 return 0
             try:
                 v = ctx.conf.get(args.key)
@@ -397,7 +401,8 @@ class GetConfCommand(Command):
             ctx.print(mask_credential(args.key, v))
             return 0
         for k in sorted(props):
-            ctx.print(f"{k}={props[k]}")
+            suffix = f"  (source: {srcs[k]})" if k in srcs else ""
+            ctx.print(f"{k}={props[k]}{suffix}")
         return 0
 
 

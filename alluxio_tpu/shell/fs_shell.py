@@ -639,7 +639,7 @@ class MountCommand(Command):
     def configure(self, p):
         p.add_argument("--readonly", action="store_true")
         p.add_argument("--shared", action="store_true")
-        p.add_argument("--option", action="append", default=[],
+        p.add_argument("-o", "--option", action="append", default=[],
                        help="key=value UFS property")
         p.add_argument("path", nargs="?")
         p.add_argument("ufs_uri", nargs="?")

@@ -129,20 +129,15 @@ def _host_calibration():
     code regressions."""
     import os
     import platform
-    import time as _t
 
-    from alluxio_tpu.stress.base import BenchResult
+    from alluxio_tpu.stress.base import BenchResult, host_speed_stamp_ms
 
-    t0 = _t.monotonic()
-    x = 0
-    for i in range(10_000_000):
-        x += i
-    loop_ms = (_t.monotonic() - t0) * 1000
+    loop_ms = host_speed_stamp_ms()
     cores = os.cpu_count() or 0
     return BenchResult(
         bench=HOST_CALIBRATION_BENCH,
         params={"python": platform.python_version(), "cores": cores},
-        metrics={"python_10m_adds_ms": round(loop_ms, 1),
+        metrics={"python_10m_adds_ms": loop_ms,
                  "note": "GIL-bound op/s rows scale ~inversely with "
                          "python_10m_adds_ms; compare suites only "
                          "after normalizing"},

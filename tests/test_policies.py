@@ -1,0 +1,119 @@
+"""Block location policies (reference
+``client/block/policy/*Policy.java``): the worker-selection logic that
+every read/write placement decision rides."""
+
+from __future__ import annotations
+
+import pytest
+
+from alluxio_tpu.client.policy import BlockLocationPolicy
+from alluxio_tpu.utils.wire import (
+    TieredIdentity, WorkerInfo, WorkerNetAddress,
+)
+
+
+def w(host: str, slice_: str = "s0", pod: str = "p0", *,
+      capacity: int = 100, used: int = 0, wid: int = 0) -> WorkerInfo:
+    return WorkerInfo(
+        id=wid,
+        address=WorkerNetAddress(
+            host=host, rpc_port=1,
+            tiered_identity=TieredIdentity.from_spec(
+                [f"host={host}", f"slice={slice_}", f"pod={pod}"])),
+        capacity_bytes=capacity, used_bytes=used)
+
+
+class TestLocalFirst:
+    def _policy(self, host="h0", slice_="s0", pod="p0"):
+        return BlockLocationPolicy.create(
+            "LOCAL_FIRST", identity=TieredIdentity.from_spec(
+                [f"host={host}", f"slice={slice_}", f"pod={pod}"]))
+
+    def test_same_host_wins(self):
+        p = self._policy("h1")
+        got = p.pick([w("h0"), w("h1"), w("h2")])
+        assert got.host == "h1"
+
+    def test_ici_slice_beats_remote_pod(self):
+        # no same-host worker: nearest is the same-slice one, then pod
+        p = self._policy("h9", slice_="s1", pod="p0")
+        got = p.pick([w("h2", "s2", "p1"), w("h3", "s1", "p0")])
+        assert got.host == "h3"
+
+    def test_empty_returns_none(self):
+        assert self._policy().pick([]) is None
+
+    def test_tie_spreads_over_equally_near(self):
+        p = self._policy("h9", slice_="s9", pod="p9")  # all equally far
+        hosts = {p.pick([w("h0"), w("h1"), w("h2")]).host
+                 for _ in range(60)}
+        assert len(hosts) > 1  # random among peers, not always first
+
+
+class TestAvoidEviction:
+    def test_skips_full_workers(self):
+        p = BlockLocationPolicy.create(
+            "LOCAL_FIRST_AVOID_EVICTION",
+            identity=TieredIdentity.from_spec(["host=h0"]))
+        full = w("h0", capacity=100, used=95)   # local but no room
+        roomy = w("h1", capacity=100, used=0)
+        assert p.pick([full, roomy], block_size=50).host == "h1"
+
+    def test_falls_back_when_nothing_fits(self):
+        p = BlockLocationPolicy.create(
+            "LOCAL_FIRST_AVOID_EVICTION",
+            identity=TieredIdentity.from_spec(["host=h0"]))
+        got = p.pick([w("h0", capacity=10), w("h1", capacity=10)],
+                     block_size=50)
+        assert got is not None  # eviction beats failing the write
+
+
+class TestMostAvailable:
+    def test_max_free_space_wins(self):
+        p = BlockLocationPolicy.create("MOST_AVAILABLE")
+        got = p.pick([w("h0", capacity=100, used=90),
+                      w("h1", capacity=1000, used=100),
+                      w("h2", capacity=200, used=0)])
+        assert got.host == "h1"
+
+
+class TestRoundRobin:
+    def test_cycles_deterministically_over_sorted_workers(self):
+        p = BlockLocationPolicy.create("ROUND_ROBIN")
+        workers = [w("h2"), w("h0"), w("h1")]  # unsorted on purpose
+        picks = [p.pick(workers).host for _ in range(6)]
+        assert picks == ["h0", "h1", "h2", "h0", "h1", "h2"]
+
+
+class TestDeterministicHash:
+    def test_same_block_same_worker(self):
+        p = BlockLocationPolicy.create("DETERMINISTIC_HASH", shards=1)
+        workers = [w(f"h{i}") for i in range(8)]
+        first = p.pick(workers, block_id=1234).host
+        assert all(p.pick(workers, block_id=1234).host == first
+                   for _ in range(20))
+
+    def test_k_shards_bounds_the_candidate_set(self):
+        p = BlockLocationPolicy.create("DETERMINISTIC_HASH", shards=3)
+        workers = [w(f"h{i}") for i in range(8)]
+        hosts = {p.pick(workers, block_id=77).host for _ in range(200)}
+        assert 1 < len(hosts) <= 3  # spread, but over exactly k workers
+
+    def test_different_blocks_spread_cluster_wide(self):
+        p = BlockLocationPolicy.create("DETERMINISTIC_HASH", shards=1)
+        workers = [w(f"h{i}") for i in range(8)]
+        hosts = {p.pick(workers, block_id=b).host for b in range(64)}
+        assert len(hosts) >= 4  # md5 spreads block ids over the ring
+
+
+class TestSpecificHost:
+    def test_exact_host_or_none(self):
+        p = BlockLocationPolicy.create("SPECIFIC_HOST", hostname="h1")
+        assert p.pick([w("h0"), w("h1")]).host == "h1"
+        assert p.pick([w("h0"), w("h2")]) is None
+
+
+class TestFactory:
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError):
+            BlockLocationPolicy.create("NOPE")

@@ -1,0 +1,153 @@
+"""In-process fake GCS JSON API (``storage/v1``): media upload,
+``alt=media`` reads with Range, object metadata, delete, paginated
+prefix listing, and ``rewriteTo`` incl. the multi-round
+``rewriteToken`` dance — the exact surface ``underfs/gcs.py`` speaks.
+Verifies the Bearer token server-side when one is configured."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional
+
+from tests.testutils.httpfake import HttpFakeServer
+
+
+class FakeGcsServer(HttpFakeServer):
+    def __init__(self, bucket: str = "test-bucket",
+                 required_token: str = "",
+                 rewrite_rounds: int = 1,
+                 page_size: int = 1000) -> None:
+        self.bucket = bucket
+        self.required_token = required_token
+        #: rewriteTo replies done=false this many - 1 times per copy
+        self.rewrite_rounds = rewrite_rounds
+        self.page_size = page_size
+        self.objects: Dict[str, bytes] = {}
+        self.requests: List[str] = []
+        self._rewrites: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _reply(self, code: int, body: bytes = b"",
+                       ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, payload: dict) -> None:
+                self._reply(code, json.dumps(payload).encode())
+
+            def _auth_ok(self) -> bool:
+                if not outer.required_token:
+                    return True
+                return (self.headers.get("Authorization", "")
+                        == f"Bearer {outer.required_token}")
+
+            def _parts(self):
+                u = urllib.parse.urlsplit(self.path)
+                return (urllib.parse.unquote(u.path),
+                        urllib.parse.parse_qs(u.query))
+
+            def do_POST(self):  # noqa: N802
+                path, q = self._parts()
+                outer.requests.append(f"POST {path}")
+                if not self._auth_ok():
+                    return self._json(401, {"error": "unauthorized"})
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                with outer._lock:
+                    if path == f"/upload/storage/v1/b/{outer.bucket}/o":
+                        name = q.get("name", [""])[0]
+                        if q.get("uploadType", [""])[0] != "media" \
+                                or not name:
+                            return self._json(400, {"error": "bad upload"})
+                        outer.objects[name] = body
+                        return self._json(200, {
+                            "name": name, "size": str(len(body))})
+                    if "/rewriteTo/b/" in path:
+                        head = f"/storage/v1/b/{outer.bucket}/o/"
+                        src, _, rest = path[len(head):].partition(
+                            f"/rewriteTo/b/{outer.bucket}/o/")
+                        if src not in outer.objects:
+                            return self._json(404, {"error": "no src"})
+                        kid = f"{src}->{rest}"
+                        done_at = outer.rewrite_rounds
+                        n_seen = outer._rewrites.get(kid, 0) + 1
+                        outer._rewrites[kid] = n_seen
+                        if n_seen < done_at:
+                            return self._json(200, {
+                                "done": False,
+                                "rewriteToken": f"tok-{kid}-{n_seen}"})
+                        outer.objects[rest] = outer.objects[src]
+                        return self._json(200, {"done": True})
+                return self._json(404, {"error": path})
+
+            def do_GET(self):  # noqa: N802
+                path, q = self._parts()
+                outer.requests.append(f"GET {path}")
+                if not self._auth_ok():
+                    return self._json(401, {"error": "unauthorized"})
+                with outer._lock:
+                    if path == f"/storage/v1/b/{outer.bucket}/o":
+                        return self._list(q)
+                    head = f"/storage/v1/b/{outer.bucket}/o/"
+                    if path.startswith(head):
+                        key = path[len(head):]
+                        data = outer.objects.get(key)
+                        if data is None:
+                            return self._json(404, {"error": key})
+                        if q.get("alt", [""])[0] == "media":
+                            return self._media(data)
+                        return self._json(200, {
+                            "name": key, "size": str(len(data)),
+                            "etag": f"etag-{len(data)}",
+                            "updated": "2026-01-02T03:04:05Z"})
+                return self._json(404, {"error": path})
+
+            def _media(self, data: bytes) -> None:
+                rng = self.headers.get("Range", "")
+                if rng.startswith("bytes="):
+                    lo_s, _, hi_s = rng[6:].partition("-")
+                    lo = int(lo_s)
+                    if lo >= len(data):
+                        return self._reply(416)
+                    hi = int(hi_s) + 1 if hi_s else len(data)
+                    return self._reply(206, data[lo:hi],
+                                       "application/octet-stream")
+                self._reply(200, data, "application/octet-stream")
+
+            def _list(self, q) -> None:
+                prefix = q.get("prefix", [""])[0]
+                keys = sorted(k for k in outer.objects
+                              if k.startswith(prefix))
+                start = int(q.get("pageToken", ["0"])[0] or 0)
+                page = keys[start:start + outer.page_size]
+                body = {"items": [{"name": k} for k in page]}
+                if start + outer.page_size < len(keys):
+                    body["nextPageToken"] = str(start + outer.page_size)
+                self._json(200, body)
+
+            def do_DELETE(self):  # noqa: N802
+                path, _q = self._parts()
+                outer.requests.append(f"DELETE {path}")
+                if not self._auth_ok():
+                    return self._json(401, {"error": "unauthorized"})
+                head = f"/storage/v1/b/{outer.bucket}/o/"
+                with outer._lock:
+                    key = path[len(head):] if path.startswith(head) \
+                        else None
+                    if key is not None and key in outer.objects:
+                        del outer.objects[key]
+                        return self._reply(204)
+                return self._json(404, {"error": path})
+
+        self._init_server(Handler)

@@ -1,0 +1,74 @@
+"""Client page-cache evictors (reference
+``client/file/cache/evictor/{LRUCacheEvictor,LFUCacheEvictor}.java``):
+the ordering logic deciding which page leaves the local cache."""
+
+from __future__ import annotations
+
+import pytest
+
+from alluxio_tpu.client.cache.evictor import CacheEvictor
+from alluxio_tpu.client.cache.page_store import PageId
+
+
+def pid(i: int) -> PageId:
+    return PageId(file_id=f"f{i}", page_index=0)
+
+
+class TestLru:
+    def test_oldest_untouched_evicts_first(self):
+        ev = CacheEvictor.create("LRU")
+        for i in range(3):
+            ev.update_on_put(pid(i))
+        ev.update_on_get(pid(0))  # 0 is now most-recent
+        assert ev.evict() == pid(1)
+        ev.update_on_delete(pid(1))
+        assert ev.evict() == pid(2)
+
+    def test_get_of_unknown_page_is_noop(self):
+        ev = CacheEvictor.create("LRU")
+        ev.update_on_get(pid(9))
+        assert ev.evict() is None
+
+    def test_evict_matching_respects_order_and_pred(self):
+        ev = CacheEvictor.create("LRU")
+        for i in range(4):
+            ev.update_on_put(pid(i))
+        got = ev.evict_matching(lambda p: p.file_id in ("f2", "f3"))
+        assert got == pid(2)  # oldest among the matching
+
+
+class TestLfu:
+    def test_least_frequent_evicts_first(self):
+        ev = CacheEvictor.create("LFU")
+        for i in range(3):
+            ev.update_on_put(pid(i))
+        for _ in range(3):
+            ev.update_on_get(pid(0))
+        ev.update_on_get(pid(2))
+        assert ev.evict() == pid(1)  # count 1 vs 4 and 2
+
+    def test_delete_forgets_counts(self):
+        ev = CacheEvictor.create("LFU")
+        ev.update_on_put(pid(0))
+        ev.update_on_delete(pid(0))
+        assert ev.evict() is None
+        ev.update_on_put(pid(0))  # re-added: count restarts at 1
+        ev.update_on_put(pid(1))
+        ev.update_on_get(pid(1))
+        assert ev.evict() == pid(0)
+
+    def test_evict_matching_picks_least_frequent_candidate(self):
+        ev = CacheEvictor.create("LFU")
+        for i in range(3):
+            ev.update_on_put(pid(i))
+        ev.update_on_get(pid(1))
+        got = ev.evict_matching(lambda p: p.file_id in ("f1", "f2"))
+        assert got == pid(2)
+
+
+class TestFactory:
+    def test_create_and_unknown(self):
+        assert CacheEvictor.create("LRU").evict() is None
+        assert CacheEvictor.create("LFU").evict() is None
+        with pytest.raises(ValueError):
+            CacheEvictor.create("CLOCK")

@@ -212,13 +212,26 @@ class FileSystem:
     def persist(self, path: "str | AlluxioURI") -> None:
         self.fs_master.schedule_async_persistence(AlluxioURI(path).path)
 
-    def persist_now(self, path: "str | AlluxioURI") -> str:
+    def persist_now(self, path: "str | AlluxioURI", *,
+                    expected_id: int = 0) -> str:
         """Synchronously write a cached file back to its UFS via a worker
         holding its blocks, then mark the inode persisted (reference: the
-        worker-side persist executor driven by ``PersistDefinition``)."""
-        from alluxio_tpu.utils.exceptions import UnavailableError
+        worker-side persist executor driven by ``PersistDefinition``).
+
+        ``expected_id`` pins the operation to one inode: a rename that
+        put a DIFFERENT (already-persisted) file at ``path`` must fail
+        the job — reporting success would silently drop the renamed
+        file's ASYNC_THROUGH durability; the scheduler re-resolves the
+        id and retries at the new path."""
+        from alluxio_tpu.utils.exceptions import (
+            FileDoesNotExistError, UnavailableError,
+        )
 
         info = self.get_status(path)
+        if expected_id and info.file_id != expected_id:
+            raise FileDoesNotExistError(
+                f"inode {expected_id} is no longer at {path} (found "
+                f"{info.file_id}) — re-resolve and retry")
         if not info.ufs_path:
             raise UnavailableError(f"{path} has no UFS path to persist to")
         if info.persisted:

@@ -46,12 +46,18 @@ class PersistDefinition(PlanDefinition):
                 break
         if best is None:
             best = sorted(workers, key=lambda w: w.worker_id)[0]
-        return [(best.worker_id, {"path": info.path})]
+        return [(best.worker_id, {"path": info.path,
+                                  "inode_id": config.get("inode_id",
+                                                         0)})]
 
     def run_task(self, config: Dict[str, Any], task_args: Any,
                  ctx: RunTaskContext) -> Any:
         path = task_args["path"]
-        ctx.fs.persist_now(path)
+        # id-pinned: a rename racing the job must FAIL it (the
+        # scheduler re-resolves and retries at the new path), never
+        # succeed against whatever file now sits at the old path
+        ctx.fs.persist_now(path,
+                           expected_id=task_args.get("inode_id", 0))
         return {"persisted": path}
 
     def join(self, config: Dict[str, Any],

@@ -90,7 +90,7 @@ class FileSystemMaster:
         journal.register(self.inode_tree)
         journal.register(_MountTableJournal(self.mount_table))
         #: paths with in-flight async persist (file id -> alluxio path)
-        self._persist_requests: Dict[int, str] = {}
+        self._persist_requests: "set[int]" = set()
         # serializes persist commits' UFS IO (see commit_persist)
         self._persist_mutex = threading.Lock()
         from alluxio_tpu.master.sync import AbsentPathCache, UfsSyncPathCache
@@ -610,7 +610,7 @@ class FileSystemMaster:
                     ctx.append(EntryType.PERSIST_FILE, {
                         "id": inode.id, "ufs_fingerprint": ufs_fingerprint})
             if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
-                self._persist_requests[inode.id] = uri.path
+                self._persist_requests.add(inode.id)
 
     def _existing_file(self, uri: AlluxioURI) -> Inode:
         lookup = self.inode_tree.lookup(uri)
@@ -1046,14 +1046,25 @@ class FileSystemMaster:
                 ctx.append(EntryType.SET_ATTRIBUTE, {
                     "id": inode.id,
                     "persistence_state": PersistenceState.TO_BE_PERSISTED})
-            self._persist_requests[inode.id] = uri.path
+            self._persist_requests.add(inode.id)
 
-    def pop_persist_requests(self) -> Dict[int, str]:
-        """Drain scheduled persist work (consumed by the persistence
-        scheduler heartbeat / job service)."""
-        out = dict(self._persist_requests)
+    def pop_persist_requests(self) -> "set[int]":
+        """Drain scheduled persist work as inode IDS (consumed by the
+        persistence scheduler heartbeat). Paths are deliberately NOT
+        stored here — a stored path is stale-by-design after a rename;
+        the scheduler re-resolves via ``current_path_of``."""
+        out = set(self._persist_requests)
         self._persist_requests.clear()
         return out
+
+    def current_path_of(self, inode_id: int) -> "Optional[str]":
+        """Re-resolve an inode id to its CURRENT path (None when the
+        inode no longer exists). Persistence tracks files by id so a
+        rename between scheduling and submission keeps durability at
+        the new path (reference: fileId-keyed ``PersistJob``)."""
+        with self.inode_tree.lock.read_locked():
+            uri = self.inode_tree.path_of_id(inode_id)
+        return str(uri) if uri is not None else None
 
     def mark_persisted(self, path: "str | AlluxioURI",
                        ufs_fingerprint: str = "") -> None:

@@ -128,6 +128,14 @@ class InodeTree(Journaled):
     def child_names(self, inode: Inode) -> List[str]:
         return self._store.child_names(inode.id)
 
+    def path_of_id(self, inode_id: int) -> Optional[AlluxioURI]:
+        """Current full path of an inode id, or None when it no longer
+        exists (callers hold the tree lock)."""
+        inode = self._store.get(inode_id)
+        if inode is None:
+            return None
+        return self.get_path(inode)
+
     def children(self, inode: Inode) -> Iterator[Inode]:
         for name in self._store.child_names(inode.id):
             cid = self._store.get_child_id(inode.id, name)

@@ -111,9 +111,7 @@ class LostFileDetector:
                             "persistence_state": state,
                             "lost_pending_persist": False})
                     if state == PersistenceState.TO_BE_PERSISTED:
-                        path = tree.get_path(inode)
-                        self._fsm._persist_requests[inode.id] = \
-                            AlluxioURI(path).path
+                        self._fsm._persist_requests.add(inode.id)
                     LOG.info("file %s recovered from LOST (-> %s)",
                              inode.name, state)
 

@@ -192,7 +192,8 @@ class TestReviewRegressions:
         detector.heartbeat()
         assert fs.get_status("/pending").persistence_state == \
             PersistenceState.TO_BE_PERSISTED
-        assert "/pending" in fsm.pop_persist_requests().values()
+        requeued = fsm.pop_persist_requests()
+        assert fsm.current_path_of(next(iter(requeued))) == "/pending"
 
 
 class TestUfsCleaner:

@@ -158,6 +158,46 @@ class TestPersist:
         # the cached copy stays (ASYNC_THROUGH keeps cache + UFS copy)
         assert fs.read_all("/ap") == b"async" * 5000
 
+    def test_rename_before_persist_keeps_durability(self, cluster):
+        """A file renamed between ASYNC_THROUGH completion and the
+        persist submission must persist at its NEW path — a path-keyed
+        queue silently lost durability and the failed job's UFS parent
+        mkdirs resurrected the OLD directory after mv (observed in
+        suite order: ghost /cp after `mv /cp /moved`). Persistence is
+        inode-id-keyed with fresh path resolution (reference:
+        fileId-keyed PersistJob)."""
+        import time
+
+        fs = cluster.file_system()
+        fs.create_directory("/rp", recursive=True)
+        fs.write_all("/rp/f", b"rename me" * 1000,
+                     write_type="ASYNC_THROUGH")
+        # rename BEFORE any scheduler heartbeat can submit the job
+        fs.rename("/rp", "/rp-moved")
+        deadline = time.monotonic() + 30.0
+        while not fs.get_status("/rp-moved/f").persisted:
+            assert time.monotonic() < deadline, \
+                "renamed ASYNC_THROUGH file never persisted"
+            time.sleep(0.05)
+        st = fs.get_status("/rp-moved/f")
+        assert st.persisted
+        # and the old path must NOT come back (UFS ghost via sync)
+        assert not fs.exists("/rp/f")
+        assert not fs.exists("/rp")
+
+    def test_persist_now_rejects_wrong_inode(self, cluster):
+        """The id pin: a persist job must FAIL (and get retried at the
+        re-resolved path) when a different file now sits at its path —
+        succeeding against the impostor silently drops the renamed
+        file's durability."""
+        from alluxio_tpu.utils.exceptions import FileDoesNotExistError
+
+        fs = cluster.file_system()
+        fs.write_all("/pin", b"x" * 100)
+        real_id = fs.get_status("/pin").file_id
+        with pytest.raises(FileDoesNotExistError):
+            fs.persist_now("/pin", expected_id=real_id + 999)
+
 
 class TestReplicate:
     def test_replicate_block(self, cluster):

@@ -600,15 +600,21 @@ class FileSystemMaster:
                 infos = self._block_master.get_block_infos(inode.block_ids)
                 length = sum(b.length for b in infos)
             now = self._now()
+            anc = self._unpersisted_chain(
+                self.inode_tree.parent_of(inode), uri) \
+                if ufs_fingerprint else []
+            if anc:
+                # breadcrumbs BEFORE the durable flip: a crash after the
+                # journal fsync must not leave PERSISTED dirs that exist
+                # only as implicit object prefixes (steady state skips
+                # the UFS round-trip entirely)
+                self._ensure_ufs_parent_dirs(uri)
             with self._journal.create_context() as ctx:
                 ctx.append(EntryType.COMPLETE_FILE, {
                     "file_id": inode.id, "length": length, "op_time_ms": now})
-                if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
-                    # async persist kicks in post-complete
-                    pass
                 if ufs_fingerprint:
-                    ctx.append(EntryType.PERSIST_FILE, {
-                        "id": inode.id, "ufs_fingerprint": ufs_fingerprint})
+                    self._journal_persisted(ctx, inode, ufs_fingerprint,
+                                            ancestors=anc)
             if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
                 self._persist_requests.add(inode.id)
 
@@ -732,10 +738,22 @@ class FileSystemMaster:
             persisted = inode.persistence_state == PersistenceState.PERSISTED
             if persisted:
                 self._check_ufs_writable(src_uri)
+            dst_anc = self._unpersisted_chain(new_parent, dst_uri) \
+                if persisted else []
+            if dst_anc:
+                # the UFS rename will implicitly create dst's parent
+                # chain; those inodes flip PERSISTED in the SAME journal
+                # context as the RENAME (a second context would leave a
+                # crash window replaying the rename with NOT_PERSISTED
+                # dst parents — re-opening the ghost-tree bug), and
+                # breadcrumbs land first
+                self._ensure_ufs_parent_dirs(dst_uri)
             with self._journal.create_context() as ctx:
                 ctx.append(EntryType.RENAME, {
                     "id": inode.id, "new_parent_id": new_parent.id,
                     "new_name": dst_uri.name, "op_time_ms": now})
+                for cur in dst_anc:
+                    ctx.append(EntryType.PERSIST_FILE, {"id": cur.id})
             if persisted:
                 self._rename_in_ufs(src_uri, dst_uri, inode.is_directory)
             self._absent_cache.remove(dst_uri.path)
@@ -1057,6 +1075,63 @@ class FileSystemMaster:
         self._persist_requests.clear()
         return out
 
+    def _unpersisted_chain(self, start, mount_uri: AlluxioURI) -> list:
+        """``start`` and its ancestors (nearest first) that are not yet
+        PERSISTED, stopping at ``mount_uri``'s mount point: an OUTER
+        mount's directories live in a different UFS namespace — a
+        persist inside a nested mount must never flip them (their UFS
+        has no such dir and breadcrumbs cannot be written there).
+        Callers hold the tree lock."""
+        mp = self.mount_table.get_mount_point(mount_uri)
+        out = []
+        cur = start
+        while cur is not None and \
+                cur.persistence_state != PersistenceState.PERSISTED:
+            if self.mount_table.get_mount_point(
+                    self.inode_tree.get_path(cur)) != mp:
+                break
+            out.append(cur)
+            cur = self.inode_tree.parent_of(cur)
+        return out
+
+    def _journal_persisted(self, ctx, inode, ufs_fingerprint: str = "",
+                           ancestors: "Optional[list]" = None) -> None:
+        """Journal PERSIST_FILE for ``inode`` AND every not-yet-persisted
+        ancestor directory within the same mount. The UFS write that
+        made the file durable also created its parent directories in
+        the UFS, so their inodes must say PERSISTED — otherwise
+        renaming such a directory skips the UFS-side rename (``rename``
+        gates on the DIR's state) and the old UFS tree gets resurrected
+        by metadata sync (observed: ghost ``/cp`` after ``mv /cp
+        /moved`` once ``/cp/f`` had persisted). Callers that computed
+        the chain already (to order breadcrumbs before this durable
+        flip) pass it via ``ancestors``."""
+        ctx.append(EntryType.PERSIST_FILE, {
+            "id": inode.id, "ufs_fingerprint": ufs_fingerprint})
+        if ancestors is None:
+            ancestors = self._unpersisted_chain(
+                self.inode_tree.parent_of(inode),
+                self.inode_tree.get_path(inode))
+        for cur in ancestors:
+            ctx.append(EntryType.PERSIST_FILE, {"id": cur.id})
+
+    def _ensure_ufs_parent_dirs(self, uri: AlluxioURI) -> None:
+        """Make the UFS parent chain of ``uri`` explicit (breadcrumb
+        objects on object stores, real dirs elsewhere; idempotent). A
+        directory inode marked PERSISTED must exist in the UFS in its
+        own right — implicit-prefix-only existence means metadata sync
+        would delete the directory (and its cache-only children) as
+        soon as its last persisted file is removed."""
+        parent = uri.parent()
+        if parent is None:
+            return
+        try:
+            res = self.mount_table.resolve(parent)
+            self._ufs.get(res.mount_id).mkdirs(res.ufs_path)
+        except Exception:  # noqa: BLE001 best-effort; sync self-heals
+            LOG.debug("breadcrumb mkdirs for %s failed", parent,
+                      exc_info=True)
+
     def current_path_of(self, inode_id: int) -> "Optional[str]":
         """Re-resolve an inode id to its CURRENT path (None when the
         inode no longer exists). Persistence tracks files by id so a
@@ -1072,9 +1147,13 @@ class FileSystemMaster:
         uri = AlluxioURI(path)
         with self.inode_tree.lock.write_locked():
             inode = self._existing_file(uri)
+            anc = self._unpersisted_chain(
+                self.inode_tree.parent_of(inode), uri)
+            if anc:  # breadcrumbs BEFORE the durable flip
+                self._ensure_ufs_parent_dirs(uri)
             with self._journal.create_context() as ctx:
-                ctx.append(EntryType.PERSIST_FILE, {
-                    "id": inode.id, "ufs_fingerprint": ufs_fingerprint})
+                self._journal_persisted(ctx, inode, ufs_fingerprint,
+                                        ancestors=anc)
 
     def commit_persist(self, path: "str | AlluxioURI",
                        temp_ufs_path: str, *,
@@ -1124,9 +1203,16 @@ class FileSystemMaster:
                     self._discard_temp(uri, temp_ufs_path)
                     raise
                 resolution = self.mount_table.resolve(uri)
+                anc_ids = [a.id for a in self._unpersisted_chain(
+                    self.inode_tree.parent_of(inode), uri)]
             ufs = self._ufs.get(resolution.mount_id)
             # phase 2: UFS IO outside the tree lock (can be a
-            # multi-second server-side copy on object stores)
+            # multi-second server-side copy on object stores).
+            # Parent-chain breadcrumbs FIRST: the ancestors are about
+            # to be journaled PERSISTED and must exist explicitly
+            # (steady state — chain already persisted — skips the RPC)
+            if anc_ids:
+                self._ensure_ufs_parent_dirs(uri)
             if temp_ufs_path:
                 if not ufs.rename_file(temp_ufs_path, resolution.ufs_path):
                     raise UnavailableError(
@@ -1151,8 +1237,7 @@ class FileSystemMaster:
                                   resolution.ufs_path, exc_info=True)
                     raise
                 with self._journal.create_context() as ctx:
-                    ctx.append(EntryType.PERSIST_FILE, {
-                        "id": inode.id, "ufs_fingerprint": fingerprint})
+                    self._journal_persisted(ctx, inode, fingerprint)
                 return fingerprint
 
     def _discard_temp(self, uri: AlluxioURI, temp_ufs_path: str) -> None:

@@ -128,6 +128,11 @@ class InodeTree(Journaled):
     def child_names(self, inode: Inode) -> List[str]:
         return self._store.child_names(inode.id)
 
+    def parent_of(self, inode: Inode) -> Optional[Inode]:
+        if inode.parent_id == ROOT_ID_PARENT:
+            return None
+        return self._store.get(inode.parent_id)
+
     def path_of_id(self, inode_id: int) -> Optional[AlluxioURI]:
         """Current full path of an inode id, or None when it no longer
         exists (callers hold the tree lock)."""

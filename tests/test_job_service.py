@@ -185,6 +185,106 @@ class TestPersist:
         assert not fs.exists("/rp/f")
         assert not fs.exists("/rp")
 
+    def test_rename_after_persist_moves_ufs_tree(self, cluster):
+        """Once /d/f HAS persisted, `mv /d /d2` must move the UFS tree
+        too: the persist marks ancestor DIRECTORIES persisted (their
+        UFS dirs exist), so the rename's UFS leg runs — a dir left
+        NOT_PERSISTED skipped it, stranding the old UFS tree for
+        metadata sync to resurrect (ghost /cp in suite runs)."""
+        import time
+
+        fs = cluster.file_system()
+        fs.create_directory("/d", recursive=True)
+        fs.write_all("/d/f", b"durable" * 500,
+                     write_type="ASYNC_THROUGH")
+        deadline = time.monotonic() + 30.0
+        while not fs.get_status("/d/f").persisted:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        # the parent dir must now read PERSISTED (ancestor propagation)
+        from alluxio_tpu.master.inode_tree import PersistenceState
+
+        assert fs.get_status("/d").persistence_state == \
+            PersistenceState.PERSISTED
+        fs.rename("/d", "/d2")
+        # exists() runs metadata sync against the UFS: the old tree
+        # must really be gone there, not just in the namespace
+        assert not fs.exists("/d/f")
+        assert not fs.exists("/d")
+        assert fs.get_status("/d2/f").persisted
+        assert fs.read_all("/d2/f") == b"durable" * 500
+
+    def test_rename_into_unpersisted_parent_then_rename_parent(self,
+                                                               cluster):
+        """Renaming a persisted tree INTO a not-yet-persisted parent
+        implicitly creates that parent in the UFS — the parent's inode
+        must flip PERSISTED too, or renaming the parent later skips
+        its UFS leg and strands the tree for sync to resurrect."""
+        import time
+
+        from alluxio_tpu.master.inode_tree import PersistenceState
+
+        fs = cluster.file_system()
+        fs.create_directory("/p2", recursive=True)  # NOT persisted
+        fs.create_directory("/d0", recursive=True)
+        fs.write_all("/d0/f", b"x" * 600, write_type="ASYNC_THROUGH")
+        deadline = time.monotonic() + 30.0
+        while not fs.get_status("/d0/f").persisted:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        fs.rename("/d0", "/p2/d")
+        assert fs.get_status("/p2").persistence_state == \
+            PersistenceState.PERSISTED
+        fs.rename("/p2", "/moved2")
+        assert not fs.exists("/p2")       # sync runs: no UFS ghost
+        assert not fs.exists("/p2/d")
+        assert fs.get_status("/moved2/d/f").persisted
+        assert fs.read_all("/moved2/d/f") == b"x" * 600
+
+    def test_user_dir_survives_last_persisted_file_delete(self, cluster):
+        """Object-store semantics: marking a dir PERSISTED must come
+        with an explicit UFS breadcrumb — a dir that exists only as an
+        object prefix would be sync-deleted (with its cache-only
+        children's metadata) once its last persisted file is removed."""
+        from alluxio_tpu.underfs import create_ufs
+
+        fs = cluster.file_system()
+        create_ufs("mem://bcrumb/").mkdirs("mem://bcrumb/root")
+        fs.mount("/os", "mem://bcrumb/root")
+        fs.create_directory("/os/d", recursive=True)  # user-created
+        fs.write_all("/os/d/f", b"y" * 300,
+                     write_type="CACHE_THROUGH")  # persists inline
+        fs.write_all("/os/d/cacheonly", b"z" * 100,
+                     write_type="MUST_CACHE")
+        fs.delete("/os/d/f")  # the dir's only persisted file goes away
+        # the user-created dir and its cache-only child must survive
+        # a metadata sync against the object store
+        assert fs.exists("/os/d")
+        assert fs.read_all("/os/d/cacheonly") == b"z" * 100
+        fs.unmount("/os")
+
+    def test_nested_mount_persist_stops_at_mount_point(self, cluster):
+        """A persist inside a nested mount must not flip the OUTER
+        mount's cache-only parent dir to PERSISTED: that dir lives in a
+        different UFS namespace where no such directory exists — the
+        next sync of the outer mount would delete it."""
+        from alluxio_tpu.master.inode_tree import PersistenceState
+        from alluxio_tpu.underfs import create_ufs
+
+        fs = cluster.file_system()
+        create_ufs("mem://nmt/").mkdirs("mem://nmt/store")
+        fs.create_directory("/nm", recursive=True)  # cache-only
+        fs.mount("/nm/inner", "mem://nmt/store")
+        fs.write_all("/nm/inner/f", b"n" * 200,
+                     write_type="CACHE_THROUGH")
+        assert fs.get_status("/nm/inner/f").persisted
+        # the walk stopped at the mount point: /nm stays NOT_PERSISTED
+        assert fs.get_status("/nm").persistence_state != \
+            PersistenceState.PERSISTED
+        # and it survives syncs (exists() syncs against the root UFS)
+        assert fs.exists("/nm")
+        fs.unmount("/nm/inner")
+
     def test_persist_now_rejects_wrong_inode(self, cluster):
         """The id pin: a persist job must FAIL (and get retried at the
         re-resolved path) when a different file now sits at its path —

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch sdist clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -17,6 +17,10 @@ native:  ## force-rebuild the C++ layer
 
 bench:
 	$(PY) bench.py
+
+bench-prefetch:  ## clairvoyant prefetch: hit-rate + p50/p99 block-ready lateness
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress prefetch --clairvoyant \
+		--num-workers 1 --num-files 4 --file-mb 8 --epochs 2
 
 sdist:
 	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist

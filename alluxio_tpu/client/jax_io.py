@@ -42,7 +42,8 @@ class DeviceBlockLoader:
 
     def __init__(self, fs: FileSystem, paths: Sequence[str], *,
                  device=None, hbm_bytes: int = 0,
-                 prefetch: int = 2, dtype=np.uint8) -> None:
+                 prefetch: int = 2, dtype=np.uint8,
+                 prefetch_service=None) -> None:
         import jax
 
         self._jax = jax
@@ -52,6 +53,13 @@ class DeviceBlockLoader:
         self._hbm = HbmPageStore(hbm_bytes, self._device) \
             if hbm_bytes > 0 else None
         self._prefetch = max(0, prefetch)
+        # clairvoyant prefetch service (prefetch/service.py). None (the
+        # default) leaves every code path byte-identical to a loader
+        # without the subsystem; set, the loader consumes epochs in the
+        # oracle's seeded order, registers its cursor via on_consume,
+        # and records hit/late/miss outcomes.
+        self._svc = prefetch_service
+        self._epoch_counter = 0
         self._m = metrics()
         #: flat list of (path, block_index, page_id)
         self._plan: List[tuple] = []
@@ -59,8 +67,13 @@ class DeviceBlockLoader:
         #: get_status round-trip per path, e.g. placement reporting)
         self.block_ids_by_path: dict = {}
         self._infos = {}
+        # the prefetch service already resolved these paths for its
+        # manifest: reuse those FileInfos rather than paying a second
+        # get_status round per file on the startup path
+        resolved = dict(prefetch_service.oracle.manifest.file_infos) \
+            if prefetch_service is not None else {}
         for path in paths:
-            info = fs.get_status(path)
+            info = resolved.get(str(path)) or fs.get_status(path)
             self._infos[path] = info
             self.block_ids_by_path[path] = list(info.block_ids)
             for i in range(len(info.block_ids)):
@@ -71,6 +84,9 @@ class DeviceBlockLoader:
         self._tls = threading.local()
         self._all_streams: List = []
         self._streams_lock = threading.Lock()
+        #: the producer thread's stream cache, published in its finally
+        #: so early-exit retirement can close it from the consumer side
+        self._producer_streams = None
         # ONE persistent producer thread across epochs: a fresh thread
         # per epoch would miss the thread-local stream cache and reopen
         # every stream each epoch (fd/mmap leak over a training run)
@@ -87,6 +103,11 @@ class DeviceBlockLoader:
         from alluxio_tpu import native as _native
 
         _native.lib()
+        if self._svc is not None and self._hbm is not None:
+            # the agent's HBM placements ride this loader's host-read
+            # path and page store (device_put dispatches async, so the
+            # agent tick stays short)
+            self._svc.bind_hbm(self.prefetch_into_hbm)
 
     def __len__(self) -> int:
         return len(self._plan)
@@ -116,9 +137,16 @@ class DeviceBlockLoader:
             # cache would multiply worker-side block pins
             f = self._fs.open_file(path, info=self._infos.get(path),
                                    max_open_streams=1)
-            streams[path] = f
             with self._streams_lock:
+                # closed-check INSIDE the lock: an agent thread (HBM
+                # adopt) racing close() must not register a stream
+                # after close() swept _all_streams — that stream would
+                # leak (with its worker-side pins) for process lifetime
+                if self._closed:
+                    f.close()
+                    raise RuntimeError("loader is closed")
                 self._all_streams.append(f)
+            streams[path] = f
         stream = f.block_stream(index)
         view = getattr(stream, "numpy_view", None)
         if view is not None:
@@ -126,6 +154,21 @@ class DeviceBlockLoader:
             return view(dtype=self._dtype)
         self._m.counter("Client.JaxStreamedBlocks").inc()
         return np.frombuffer(stream.read_all(), dtype=self._dtype)
+
+    def prefetch_into_hbm(self, ref) -> bool:
+        """Prefetch-agent hook: host-read one block and adopt it into
+        the HBM tier ahead of its consume (runs on the agent's heartbeat
+        thread; per-thread streams keep it off the producer's state)."""
+        if self._hbm is None or self._closed:
+            return False
+        info = self._infos.get(ref.path)
+        fid = info.file_id if info is not None else ref.file_id
+        pid = PageId(f"{fid:x}", ref.block_index)
+        if self._hbm.has(pid):
+            return True
+        host = self._host_bytes(ref.path, ref.block_index)
+        arr = self._jax.device_put(host, self._device)
+        return self._hbm.adopt(pid, arr)
 
     def load_block(self, plan_index: int):
         """One block as a device uint8 array (HBM-cached across epochs)."""
@@ -152,6 +195,21 @@ class DeviceBlockLoader:
     def __iter__(self) -> Iterator:
         return self.epoch()
 
+    def _epoch_entries(self, epoch_no: int) -> List[tuple]:
+        """The per-epoch load plan as ``(path, index, pid, ref)`` rows.
+        Without a prefetch service the order is the static file order
+        (``ref`` None, behavior identical to pre-service builds); with
+        one, it is the oracle's seeded permutation for this epoch."""
+        if self._svc is None:
+            return [(p, i, pid, None) for (p, i, pid) in self._plan]
+        entries = []
+        for ref in self._svc.epoch_sequence(epoch_no):
+            info = self._infos.get(ref.path)
+            fid = info.file_id if info is not None else ref.file_id
+            entries.append((ref.path, ref.block_index,
+                            PageId(f"{fid:x}", ref.block_index), ref))
+        return entries
+
     def epoch(self) -> Iterator:
         """Iterate all blocks as device arrays with transfer prefetch.
 
@@ -160,16 +218,23 @@ class DeviceBlockLoader:
         so the device_put stream never stalls on per-block host latency
         — that serialization was the measured ~25% gap between the
         loader and the raw device_put ceiling. The queue is bounded, and
-        an abandoned generator unblocks the producer via a stop flag."""
+        an abandoned generator unblocks the producer via a stop flag.
+
+        Early consumer exit (break mid-epoch) retires the producer
+        executor: the queue is drained, the producer's streams closed,
+        and the ``loader-host-prefetch`` thread joined before control
+        returns — nothing leaks waiting for ``close()``."""
+        import time as _time
         import queue as _q
 
         q: _q.Queue = _q.Queue(maxsize=max(1, self._prefetch) + 1)
         stop = threading.Event()
+        retire = threading.Event()
         SENTINEL = object()
 
-        def producer():
+        def producer(entries, gen):
             try:
-                for (path, index, pid) in self._plan:
+                for (path, index, pid, ref) in entries:
                     if stop.is_set():
                         return
                     if self._hbm is not None:
@@ -178,8 +243,24 @@ class DeviceBlockLoader:
                             self._m.counter("Client.JaxHbmHits").inc()
                             arr = lease.array
                             lease.close()
+                            if ref is not None:
+                                out = self._svc.on_consume(
+                                    ref, resident_hint=True,
+                                    generation=gen)
+                                if out != "stale":
+                                    self._svc.release(ref)
                             self._put(q, stop, (pid, arr, True))
                             continue
+                    outcome = None
+                    if ref is not None:
+                        # classify BEFORE the read (ready state decides
+                        # hit vs late); the eviction pin is released
+                        # only after the read holds its own block lock.
+                        # The generation fences a superseded producer's
+                        # last consume off the new epoch's cursor.
+                        outcome = self._svc.on_consume(ref,
+                                                       generation=gen)
+                        t0 = _time.monotonic()
                     with annotate("atpu.loader.host_read"):
                         host = self._host_bytes(path, index)
                         if host.size:
@@ -189,6 +270,20 @@ class DeviceBlockLoader:
 
                             if not native.prefault(host):
                                 host[::4096].max()
+                    if ref is not None:
+                        if outcome != "stale":
+                            # a stale (superseded-epoch) consume must
+                            # NOT release: the scheduler still counts
+                            # the block ready, and the pin is what
+                            # keeps that true — the new epoch's own
+                            # consume releases it
+                            self._svc.release(ref)
+                        if outcome not in ("hit", "stale"):
+                            # block-ready stall: how long the consumer
+                            # waited for data clairvoyance should have
+                            # had resident already
+                            self._svc.record_stall(
+                                _time.monotonic() - t0)
                     self._put(q, stop, (pid, host, False))
             except BaseException as e:  # noqa: BLE001 re-raised in consumer
                 # a read failure must FAIL the epoch, not silently end
@@ -196,6 +291,14 @@ class DeviceBlockLoader:
                 self._put(q, stop, ("__error__", e))
             finally:
                 self._put(q, stop, SENTINEL)
+                # publish this thread's stream cache: if the consumer
+                # retires the pool AFTER we already exited (late break),
+                # it closes these post-join — retire.is_set() here alone
+                # would race and leak them until loader.close()
+                self._producer_streams = getattr(self._tls, "streams",
+                                                 None)
+                if retire.is_set():
+                    self._close_streams_dict(self._producer_streams)
 
         with self._epoch_lock:
             if self._closed:
@@ -210,8 +313,15 @@ class DeviceBlockLoader:
 
                 self._producer_pool = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="loader-host-prefetch")
-            fut = self._producer_pool.submit(producer)
+            epoch_no = self._epoch_counter
+            self._epoch_counter += 1
+            gen = self._svc.begin_epoch(epoch_no) \
+                if self._svc is not None else None
+            fut = self._producer_pool.submit(producer,
+                                             self._epoch_entries(epoch_no),
+                                             gen)
         inflight: deque = deque()
+        finished = False
         try:
             while True:
                 try:
@@ -242,16 +352,22 @@ class DeviceBlockLoader:
                     yield inflight.popleft()
             while inflight:
                 yield inflight.popleft()
+            finished = True
         finally:
             with self._epoch_lock:
                 # superseded by a newer epoch() or close()?
                 cancelled = self._current_stop is not stop
+                closed = self._closed
+            # early consumer exit (break / .close() mid-epoch) on the
+            # LIVE epoch: retire the producer executor entirely — the
+            # producer closes its per-thread streams on the way out and
+            # the pool thread is joined below, so nothing waits for
+            # loader.close() to stop leaking
+            early_exit = not finished and not cancelled and not closed
+            if early_exit:
+                retire.set()
             stop.set()
-            while True:  # drain so a blocked producer can exit
-                try:
-                    q.get_nowait()
-                except _q.Empty:
-                    break
+            self._drain(q)  # unblock a producer parked on the full queue
             try:
                 fut.result(timeout=5)
             except CancelledError:  # close() shut the pool first
@@ -262,6 +378,49 @@ class DeviceBlockLoader:
                     # a live epoch's producer is wedged (e.g. hung
                     # worker RPC): surface it, don't mask the hang
                     raise
+            # one last put can land between the first drain and the
+            # producer observing stop: drain again now that it exited
+            self._drain(q)
+            if early_exit:
+                with self._epoch_lock:
+                    pool = None
+                    if self._current_stop is stop:
+                        self._current_stop = None
+                        pool, self._producer_pool = \
+                            self._producer_pool, None
+                if pool is not None:
+                    pool.shutdown(wait=True)
+                    # the producer may have finished before retire was
+                    # set; its published stream cache is closed here
+                    # (idempotent: the dict is cleared on first close)
+                    self._close_streams_dict(
+                        getattr(self, "_producer_streams", None))
+
+    @staticmethod
+    def _drain(q) -> None:
+        import queue as _q
+
+        while True:
+            try:
+                q.get_nowait()
+            except _q.Empty:
+                break
+
+    def _close_streams_dict(self, streams) -> None:
+        """Close a (retiring) thread's cached block streams — they must
+        not linger until loader.close(). Clears the dict in place, so a
+        second call (producer-side AND consumer-side retirement paths)
+        is a no-op."""
+        if not streams:
+            return
+        victims = list(streams.values())
+        streams.clear()
+        with self._streams_lock:
+            for f in victims:
+                if f in self._all_streams:
+                    self._all_streams.remove(f)
+        for f in victims:
+            f.close()
 
     @staticmethod
     def _put(q, stop, item) -> None:
@@ -279,15 +438,20 @@ class DeviceBlockLoader:
                 "hbm_pages": self._hbm.page_count}
 
     def close(self) -> None:
+        if self._svc is not None:
+            self._svc.bind_hbm(None)  # agent must not touch a dead loader
         with self._epoch_lock:
             self._closed = True
             if self._current_stop is not None:
                 self._current_stop.set()  # unblock a parked producer
                 self._current_stop = None
-        if self._producer_pool is not None:
-            self._producer_pool.shutdown(wait=True)
-            self._producer_pool = None
+            pool, self._producer_pool = self._producer_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         with self._streams_lock:
+            # under the lock: serializes with _host_bytes' registration
+            # (an in-flight HBM adopt either lands its stream here and
+            # we close it, or observes _closed and closes it itself)
             for f in self._all_streams:
                 f.close()
             self._all_streams.clear()

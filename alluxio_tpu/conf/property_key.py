@@ -765,6 +765,42 @@ class Keys:
                                        KeyType.DURATION, default="1s",
                                        scope=Scope.JOB_WORKER)
 
+    # --- clairvoyant prefetch service (prefetch/; NoPFS arxiv 2101.08734,
+    #     Hoard arxiv 1812.00669 — no reference analogue) ---
+    PREFETCH_ENABLED = _k(
+        "atpu.prefetch.enabled", KeyType.BOOL, default=False,
+        scope=Scope.CLIENT, aliases=("prefetch.enabled",),
+        description="Run the clairvoyant prefetch control loop (oracle "
+                    "-> scheduler -> agent) for seeded-shuffle reads. "
+                    "Off: the loader's behavior is byte-identical to a "
+                    "build without the subsystem.")
+    PREFETCH_LOOKAHEAD_BLOCKS = _k(
+        "atpu.prefetch.lookahead.blocks", KeyType.INT, default=16,
+        scope=Scope.CLIENT, aliases=("prefetch.lookahead.blocks",),
+        description="How many future accesses (per the oracle's exact "
+                    "order, across epoch boundaries) the scheduler "
+                    "plans placements for each tick.")
+    PREFETCH_BUDGET_BYTES = _k(
+        "atpu.prefetch.budget.bytes", KeyType.BYTES, default="256MB",
+        scope=Scope.CLIENT, aliases=("prefetch.budget.bytes",),
+        description="Ceiling on prefetched-ahead bytes (issued + ready, "
+                    "not yet consumed) across all tiers; the planner "
+                    "stops at the nearest-deadline block that no longer "
+                    "fits (backpressure).")
+    PREFETCH_HBM_FRACTION = _k(
+        "atpu.prefetch.hbm.fraction", KeyType.FLOAT, default=0.25,
+        scope=Scope.CLIENT, aliases=("prefetch.hbm.fraction",),
+        description="Slice of the budget placed directly into the HBM "
+                    "tier (device-resident jax.Array); the rest goes to "
+                    "worker DRAM. Effective only when a loader with an "
+                    "HBM store is bound.")
+    PREFETCH_HEARTBEAT_INTERVAL = _k(
+        "atpu.prefetch.heartbeat.interval.ms", KeyType.DURATION,
+        default="100ms", scope=Scope.CLIENT,
+        aliases=("prefetch.heartbeat.interval.ms",),
+        description="Agent tick cadence: completions are observed and "
+                    "the next placement plan issued once per tick.")
+
     # --- TPU / HBM data path (native additions) ---
     TPU_MESH_SHAPE = _k("atpu.tpu.mesh.shape", KeyType.LIST, default=None,
                         description="Logical mesh axes 'data=4,model=2' used by "

@@ -40,6 +40,7 @@ class HeartbeatContext:
     JOB_WORKER_COMMAND_HANDLING = "JobWorker.CommandHandling"
     CLIENT_METRICS_HEARTBEAT = "Client.MetricsHeartbeat"
     CLIENT_CONFIG_HASH_SYNC = "Client.ConfigHashSync"
+    CLIENT_PREFETCH_AGENT = "Client.PrefetchAgent"
 
 
 class HeartbeatExecutor:

@@ -1,0 +1,29 @@
+"""Clairvoyant prefetch service: epoch-aware block scheduling into tiers.
+
+With a seeded shuffle the exact per-epoch access order is known before
+the first step runs (NoPFS, arxiv 2101.08734; Hoard, arxiv 1812.00669),
+so the data plane can plan — not guess — which blocks must already be
+resident in which tier when the consumer arrives:
+
+- :mod:`~alluxio_tpu.prefetch.oracle` derives the exact future access
+  sequence from (manifest, seed, epoch, cursor);
+- :mod:`~alluxio_tpu.prefetch.scheduler` turns the lookahead window into
+  tier-placement plans (HBM vs DRAM vs skip) under a byte budget, with
+  deadline/lateness tracking and backpressure;
+- :mod:`~alluxio_tpu.prefetch.agent` executes plans each heartbeat:
+  async worker-tier loads + eviction pins, and HBM adoption through the
+  consumer's :class:`~alluxio_tpu.client.jax_io.DeviceBlockLoader`;
+- :mod:`~alluxio_tpu.prefetch.service` assembles the control loop from
+  configuration and binds it to a loader.
+"""
+
+from alluxio_tpu.prefetch.oracle import (  # noqa: F401
+    AccessOracle, BlockRef, DatasetManifest,
+)
+from alluxio_tpu.prefetch.scheduler import (  # noqa: F401
+    PlacementAction, PrefetchScheduler, TIER_DRAM, TIER_HBM,
+)
+from alluxio_tpu.prefetch.agent import (  # noqa: F401
+    JobServiceExecutor, PrefetchAgent, WorkerTierExecutor,
+)
+from alluxio_tpu.prefetch.service import PrefetchService  # noqa: F401

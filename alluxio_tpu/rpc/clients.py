@@ -452,6 +452,16 @@ class WorkerClient(_BaseClient):
             "block_id": block_id, "ufs_path": ufs_path, "offset": offset,
             "length": length, "mount_id": mount_id})["accepted"]
 
+    def prefetch_pin(self, block_id: int, ttl_s: float = 600.0) -> bool:
+        """Eviction shield for a clairvoyantly-placed block (held until
+        ``prefetch_unpin`` or TTL expiry — the worker reclaims pins of
+        clients that died without unpinning; no lease to keep alive)."""
+        return self._call("prefetch_pin", {"block_id": block_id,
+                                           "ttl_s": ttl_s})["pinned"]
+
+    def prefetch_unpin(self, block_id: int) -> None:
+        self._call("prefetch_unpin", {"block_id": block_id})
+
     def remove_block(self, block_id: int) -> None:
         self._call("remove_block", {"block_id": block_id})
 

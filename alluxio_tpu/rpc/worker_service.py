@@ -163,6 +163,11 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
         UfsBlockDescriptor(block_id=r["block_id"], ufs_path=r["ufs_path"],
                            offset=r["offset"], length=r["length"],
                            mount_id=r.get("mount_id", 0)))})
+    svc.unary("prefetch_pin", lambda r: {
+        "pinned": worker.store.pin_prefetch(r["block_id"],
+                                            r.get("ttl_s", 600.0))})
+    svc.unary("prefetch_unpin", lambda r: (
+        worker.store.unpin_prefetch(r["block_id"]), {})[-1])
     svc.unary("remove_block", lambda r: (
         worker.store.remove_block(r["block_id"]), {})[-1])
     svc.unary("move_block", lambda r: (

@@ -64,6 +64,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size tiers so eviction fires mid-load")
     p.add_argument("--kill-worker", action="store_true",
                    help="stop a worker mid-job; plan must survive")
+    p.add_argument("--clairvoyant", action="store_true",
+                   help="run the oracle->scheduler->agent loop instead: "
+                        "seeded multi-epoch DeviceBlockLoader read "
+                        "reporting hit-rate + block-ready lateness")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--lookahead", type=int, default=16)
+    p.add_argument("--budget-mb", type=int, default=128)
+    p.add_argument("--hbm-fraction", type=float, default=0.0)
 
     t = sub.add_parser("table", help="column projection (config #4)")
     t.add_argument("--master", default=None)
@@ -109,6 +118,10 @@ SUITE = (
                               "--num-files", "8", "--file-mb", "8",
                               "--replication", "2", "--pressure",
                               "--kill-worker"]),
+    ("prefetch-clairvoyant", ["prefetch", "--clairvoyant",
+                              "--num-workers", "1",
+                              "--num-files", "4", "--file-mb", "8",
+                              "--epochs", "2"]),
     ("table-projection", ["table"]),
     ("write-eviction", ["write"]),
 )
@@ -230,12 +243,31 @@ def main(argv=None) -> int:
                                duration_s=args.duration,
                                fixed_count=args.fixed_count)
     elif args.bench == "prefetch":
-        from alluxio_tpu.stress.prefetch_bench import run
+        if args.clairvoyant:
+            # flags of the DistributedLoad variant that the clairvoyant
+            # run does not model — failing beats silently ignoring them
+            if args.pressure or args.kill_worker or \
+                    args.replication != 1:
+                print("--pressure/--kill-worker/--replication do not "
+                      "apply to --clairvoyant", file=sys.stderr)
+                return 2
+            from alluxio_tpu.stress.prefetch_bench import run_clairvoyant
 
-        r = run(num_workers=args.num_workers, num_files=args.num_files,
-                file_bytes=args.file_mb << 20,
-                replication=args.replication, pressure=args.pressure,
-                kill_worker=args.kill_worker)
+            r = run_clairvoyant(num_workers=args.num_workers,
+                                num_files=args.num_files,
+                                file_bytes=args.file_mb << 20,
+                                epochs=args.epochs, seed=args.seed,
+                                lookahead_blocks=args.lookahead,
+                                budget_bytes=args.budget_mb << 20,
+                                hbm_fraction=args.hbm_fraction)
+        else:
+            from alluxio_tpu.stress.prefetch_bench import run
+
+            r = run(num_workers=args.num_workers,
+                    num_files=args.num_files,
+                    file_bytes=args.file_mb << 20,
+                    replication=args.replication, pressure=args.pressure,
+                    kill_worker=args.kill_worker)
     elif args.bench == "table":
         from alluxio_tpu.stress.table_bench import run
 

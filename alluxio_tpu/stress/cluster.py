@@ -11,6 +11,29 @@ import tempfile
 from typing import Dict, Iterator, Optional, Tuple
 
 
+def write_cold_corpus(fs, block_client, paths_and_payloads, *,
+                      timeout_s: float = 60.0) -> None:
+    """Persist ``{path: payload}`` THROUGH to the UFS, then wait until
+    every cached copy has been freed — the cold-start precondition the
+    prefetch benches and tests measure from. THROUGH frees the cached
+    copy asynchronously (the worker heartbeat applies the Free
+    command), so writing alone does not make the corpus cold."""
+    import time
+
+    from alluxio_tpu.client.streams import WriteType
+
+    for path, payload in paths_and_payloads.items():
+        fs.write_all(path, payload, write_type=WriteType.THROUGH)
+    deadline = time.monotonic() + timeout_s
+    for path in paths_and_payloads:
+        for fbi in fs.fs_master.get_file_block_info_list(path):
+            while block_client.get_block_info(
+                    fbi.block_info.block_id).locations:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("corpus never went cold")
+                time.sleep(0.02)
+
+
 @contextlib.contextmanager
 def bench_cluster(master: Optional[str] = None, *, num_workers: int = 1,
                   block_size: int = 32 << 20,

@@ -62,22 +62,13 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
                        start_worker_heartbeats=True,
                        conf_overrides=overrides) as (fs, cluster):
         # THROUGH: persisted to the UFS, cached nowhere — the cold corpus
+        from alluxio_tpu.stress.cluster import write_cold_corpus
+
         payload = rng.integers(0, 255, size=file_bytes, dtype=np.uint8
                                ).tobytes()
-        for i in range(num_files):
-            fs.write_all(f"{base_path}/f-{i:05d}", payload,
-                         write_type=WriteType.THROUGH)
-        # THROUGH frees the cached copy asynchronously (worker heartbeat
-        # applies the Free command): wait until the corpus is truly cold
-        deadline = time.monotonic() + 60.0
-        bc = cluster.block_client()
-        for i in range(num_files):
-            for fbi in fs.fs_master.get_file_block_info_list(
-                    f"{base_path}/f-{i:05d}"):
-                while bc.get_block_info(fbi.block_info.block_id).locations:
-                    if time.monotonic() > deadline:
-                        raise RuntimeError("corpus never went cold")
-                    time.sleep(0.02)
+        write_cold_corpus(fs, cluster.block_client(),
+                          {f"{base_path}/f-{i:05d}": payload
+                           for i in range(num_files)})
         filler_paths = []
         if pressure:
             # fill ~the whole cluster capacity so the load can only
@@ -215,3 +206,113 @@ def run(*, master: Optional[str] = None, num_workers: int = 4,
                      "killed_mid_job": killed_mid_job,
                      "rereplication_wait_s": round(rerepl_wait, 2)},
             errors=blocks - cached, duration_s=wall)
+
+
+def run_clairvoyant(*, num_workers: int = 1, num_files: int = 4,
+                    file_bytes: int = 8 << 20,
+                    block_size: int = 1 << 20, epochs: int = 2,
+                    seed: int = 42, lookahead_blocks: int = 16,
+                    budget_bytes: int = 128 << 20,
+                    hbm_fraction: float = 0.0,
+                    heartbeat_ms: int = 10,
+                    base_path: str = "/stress-clairvoyant") -> BenchResult:
+    """Clairvoyant prefetch bench: a seeded multi-epoch DeviceBlockLoader
+    run with the oracle -> scheduler -> agent loop live (heartbeat
+    thread, no test ticking). Reports the subsystem's own trajectory
+    metrics — prefetch hit-rate and p50/p99 block-ready lateness — plus
+    consume throughput."""
+    import os
+
+    from alluxio_tpu.client.jax_io import DeviceBlockLoader
+    from alluxio_tpu.conf import Keys
+    from alluxio_tpu.metrics import metrics, reset_metrics
+    from alluxio_tpu.minicluster import LocalCluster
+    from alluxio_tpu.prefetch import PrefetchService
+    from alluxio_tpu.stress.cluster import write_cold_corpus
+    import tempfile
+
+    # the report reads process-global counters AND timer percentiles;
+    # percentiles cannot be delta'd, so a prior in-process run (or any
+    # earlier bench) would contaminate p50/p99 — start from zero
+    reset_metrics()
+    rng = np.random.default_rng(seed)
+    with tempfile.TemporaryDirectory(prefix="atpu-clairvoyant-") as base:
+        with LocalCluster(
+                os.path.join(base, "cluster"), num_workers=num_workers,
+                block_size=block_size,
+                worker_mem_bytes=num_files * file_bytes + (64 << 20),
+                start_worker_heartbeats=True,
+                conf_overrides={
+                    Keys.WORKER_BLOCK_HEARTBEAT_INTERVAL: "50ms",
+                    Keys.MASTER_WORKER_TIMEOUT: "10000min",
+                }) as cluster:
+            fs = cluster.file_system()
+            corpus = {
+                f"{base_path}/f-{i:03d}": rng.integers(
+                    0, 255, size=file_bytes, dtype=np.uint8).tobytes()
+                for i in range(num_files)}
+            write_cold_corpus(fs, cluster.block_client(), corpus)
+            paths = list(corpus)
+            conf = cluster.conf.copy()
+            conf.set(Keys.PREFETCH_ENABLED, True)
+            conf.set(Keys.PREFETCH_LOOKAHEAD_BLOCKS, lookahead_blocks)
+            conf.set(Keys.PREFETCH_BUDGET_BYTES, budget_bytes)
+            conf.set(Keys.PREFETCH_HBM_FRACTION, hbm_fraction)
+            conf.set(Keys.PREFETCH_HEARTBEAT_INTERVAL,
+                     f"{heartbeat_ms}ms")
+            svc = PrefetchService.from_conf(conf, fs, paths, seed=seed)
+            loader = DeviceBlockLoader(
+                fs, paths, prefetch_service=svc,
+                hbm_bytes=(budget_bytes if hbm_fraction > 0 else 0))
+            base_stats = svc.stats()
+            try:
+                svc.start()
+                # warm-up gate: let the agent land the first window so
+                # the measurement reflects steady state, not cold boot
+                svc.wait_ready(min(lookahead_blocks, len(loader)),
+                               timeout_s=60.0)
+                consumed_bytes = 0
+                wall = 0.0  # consume time only: the inter-epoch gate
+                # below must not deflate the reported throughput
+                for e in range(epochs):
+                    t0 = time.monotonic()
+                    for arr in loader.epoch():
+                        consumed_bytes += int(arr.nbytes)
+                    wall += time.monotonic() - t0
+                    if e + 1 < epochs:
+                        # inter-epoch gate: a real consumer spends step
+                        # time between epochs; this bench otherwise
+                        # re-reads instantly and races the replan tick
+                        svc.wait_ready(min(lookahead_blocks,
+                                           len(loader)), timeout_s=60.0)
+            finally:
+                loader.close()
+                svc.close()
+            stats = svc.stats()
+            ready = metrics().timer("Client.PrefetchBlockReady")
+            hits = stats["hits"] - base_stats["hits"]
+            late = stats["late"] - base_stats["late"]
+            misses = stats["misses"] - base_stats["misses"]
+            consumed = hits + late + misses
+            return BenchResult(
+                bench="clairvoyant-prefetch",
+                params={"num_workers": num_workers,
+                        "num_files": num_files, "file_bytes": file_bytes,
+                        "block_size": block_size, "epochs": epochs,
+                        "seed": seed, "lookahead_blocks": lookahead_blocks,
+                        "budget_bytes": budget_bytes,
+                        "hbm_fraction": hbm_fraction,
+                        "heartbeat_ms": heartbeat_ms},
+                metrics={"hit_rate": round(hits / consumed, 4)
+                         if consumed else 0.0,
+                         "hits": hits, "late": late, "misses": misses,
+                         "late_arrivals": stats["late_arrivals"] -
+                         base_stats["late_arrivals"],
+                         "p50_block_ready_ms": round(
+                             ready.percentile(50) * 1e3, 3),
+                         "p99_block_ready_ms": round(
+                             ready.percentile(99) * 1e3, 3),
+                         "gb_per_s": round(
+                             consumed_bytes / wall / 1e9, 3),
+                         "blocks_per_epoch": len(loader)},
+                errors=misses, duration_s=wall)

@@ -105,6 +105,14 @@ class TieredBlockStore:
         self.pinned_blocks: Set[int] = set()
         #: master-driven pins, wholesale-replaced by PinListSync each tick
         self.master_pinned_blocks: Set[int] = set()
+        #: prefetch-agent pins: block_id -> expiry (monotonic). Soon-
+        #: needed blocks the clairvoyant scheduler placed ahead of the
+        #: consumer; eviction must not undo a placement before its
+        #: consume (prefetch/agent.py). TTL-bounded, NOT session-bound:
+        #: a SIGKILLed client can never unpin, and a permanent pin
+        #: would make the block unevictable forever — expiry is the
+        #: worker-side reclamation path.
+        self.prefetch_pinned_blocks: Dict[int, float] = {}
         #: serialized allocation/eviction decisions (metadata lock; IO and
         #: reads proceed outside it — mirroring the reference's hierarchy)
         self._alloc_lock = threading.RLock()
@@ -256,6 +264,27 @@ class TieredBlockStore:
         self.annotator.on_access(block_id)
         return lock
 
+    def pin_prefetch(self, block_id: int, ttl_s: float = 600.0) -> bool:
+        """Shield a committed block from eviction until the prefetch
+        consumer reads it. Unlike :meth:`pin_block` this holds no lock
+        object a remote caller would have to keep alive — it is an
+        expiring entry the evictor respects, dropped by
+        :meth:`unpin_prefetch`, block removal, or TTL expiry (the
+        backstop for clients that die without unpinning)."""
+        import time
+
+        with self._alloc_lock:
+            if self.meta.get_block(block_id) is None:
+                return False
+            self.prefetch_pinned_blocks[block_id] = \
+                time.monotonic() + ttl_s
+        self.annotator.on_access(block_id)
+        return True
+
+    def unpin_prefetch(self, block_id: int) -> None:
+        with self._alloc_lock:
+            self.prefetch_pinned_blocks.pop(block_id, None)
+
     def get_block_meta(self, block_id: int) -> Optional[BlockMeta]:
         return self.meta.get_block(block_id)
 
@@ -279,6 +308,7 @@ class TieredBlockStore:
                 meta.dir.release(meta.length)
                 self.pinned_blocks.discard(block_id)
                 self.master_pinned_blocks.discard(block_id)
+                self.prefetch_pinned_blocks.pop(block_id, None)
             if os.path.exists(meta.path):
                 os.remove(meta.path)
         finally:
@@ -340,14 +370,23 @@ class TieredBlockStore:
     def _free_space_in_dir(self, d: StorageDir, need: int) -> int:
         """Evict coldest blocks from one dir; demote to the tier below when
         it has room, else drop (re-fetchable cache by design)."""
+        import time
+
         victims = self.annotator.sorted_blocks(d.block_ids())
         freed = 0
         below = self.meta.tier_below(d.tier.alias)
+        now = time.monotonic()
         for bid in victims:
             if freed >= need:
                 break
-            if bid in self.pinned_blocks or bid in self.master_pinned_blocks:
+            if bid in self.pinned_blocks or \
+                    bid in self.master_pinned_blocks:
                 continue
+            expiry = self.prefetch_pinned_blocks.get(bid)
+            if expiry is not None:
+                if expiry > now:
+                    continue
+                del self.prefetch_pinned_blocks[bid]  # expired: reclaim
             lock = self._locks.try_lock_write(bid)
             if lock is None:
                 continue  # in use by a reader; skip (reference retries)

@@ -2,6 +2,8 @@
 HBM-retention hits, and lifecycle edge cases (the close()/second-epoch
 deadlock regression for the single-producer design)."""
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -100,6 +102,42 @@ class TestLifecycle:
             # the superseded iterator fails loudly, never truncates
             with pytest.raises(RuntimeError, match="cancelled"):
                 list(stale)
+        finally:
+            loader.close()
+
+    def test_break_mid_epoch_retires_producer(self, cluster):
+        """Regression: an early consumer exit (break mid-epoch) must
+        shut down the loader-host-prefetch executor and drain the
+        in-flight queue — no thread may linger waiting for close()."""
+        loader, data = _make_loader(cluster, n_blocks=6, prefetch=1)
+        try:
+            for b in loader.epoch():
+                break  # generator closed here; teardown is synchronous
+            assert loader._producer_pool is None
+            assert not [t for t in threading.enumerate()
+                        if t.name.startswith("loader-host-prefetch")]
+            # the producer's cached streams went with its thread
+            assert loader._all_streams == []
+            # and the loader still works: a fresh epoch re-provisions
+            out = b"".join(
+                np.asarray(b).tobytes() for b in loader.epoch())
+            assert out == data
+        finally:
+            loader.close()
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("loader-host-prefetch")]
+
+    def test_generator_close_mid_epoch_retires_producer(self, cluster):
+        """Same teardown contract when the consumer holds a reference
+        and closes the generator explicitly."""
+        loader, _ = _make_loader(cluster, n_blocks=6, prefetch=1)
+        try:
+            it = loader.epoch()
+            next(it)  # producer is parked on the full bounded queue
+            it.close()
+            assert loader._producer_pool is None
+            assert not [t for t in threading.enumerate()
+                        if t.name.startswith("loader-host-prefetch")]
         finally:
             loader.close()
 

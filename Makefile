@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch bench-obs sdist clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -21,6 +21,9 @@ bench:
 bench-prefetch:  ## clairvoyant prefetch: hit-rate + p50/p99 block-ready lateness
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress prefetch --clairvoyant \
 		--num-workers 1 --num-files 4 --file-mb 8 --epochs 2
+
+bench-obs:  ## tracing overhead: spans/sec + on-vs-off read latency (<2% budget)
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs
 
 sdist:
 	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist

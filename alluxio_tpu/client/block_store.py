@@ -110,6 +110,8 @@ class BlockStoreClient:
 
         ``exclude``: worker address keys to skip for this call only (the
         caller saw a stale location there mid-retry)."""
+        from alluxio_tpu.metrics import metrics
+
         info = fbi.block_info
         exclude = exclude or set()
         local_hostname = socket.gethostname()
@@ -124,6 +126,8 @@ class BlockStoreClient:
                             self.worker_client(loc.address), self.session_id,
                             info.block_id)
                         stream.address = loc.address
+                        metrics().counter(
+                            "Client.BlockOpens.shm").inc()
                         return stream
                     except Exception:  # noqa: BLE001 - fall through ladder
                         pass
@@ -142,6 +146,7 @@ class BlockStoreClient:
                     self.worker_client(address), info.block_id, info.length,
                     ufs=ufs_info, cache=cache_cold_reads)
                 stream.address = address
+                metrics().counter("Client.BlockOpens.remote").inc()
                 self._maybe_passive_cache(info, ufs_info)
                 return stream
         # 3) UFS fallback through a policy-chosen worker (caches read-through)
@@ -158,6 +163,7 @@ class BlockStoreClient:
                                    info.block_id, info.length, ufs=ufs_info,
                                    cache=cache_cold_reads)
         stream.address = address
+        metrics().counter("Client.BlockOpens.ufs").inc()
         return stream
 
     def _maybe_passive_cache(self, info: BlockInfo,

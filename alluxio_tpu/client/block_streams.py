@@ -34,6 +34,17 @@ from alluxio_tpu.utils.exceptions import UnavailableError
 from alluxio_tpu.utils.wire import BlockInfo, WorkerNetAddress
 
 
+def _record_read(bucket: str, nbytes: int) -> None:
+    """Per-source read accounting: ``Client.BytesRead.<bucket>`` /
+    ``Client.BlocksRead.<bucket>`` counters (additive — they roll up to
+    ``Cluster.*`` on the metrics heartbeat)."""
+    from alluxio_tpu.metrics import metrics
+
+    m = metrics()
+    m.counter(f"Client.BytesRead.{bucket}").inc(nbytes)
+    m.counter(f"Client.BlocksRead.{bucket}").inc()
+
+
 def is_local_worker(address: WorkerNetAddress, local_hostname: str) -> bool:
     """Same-host check gate for the short-circuit path: the worker's shm
     dir must be a real local directory."""
@@ -52,6 +63,9 @@ class BlockInStream:
         #: serving worker (set by BlockStoreClient); failed-worker retry
         #: marks it when a read dies mid-stream
         self.address = None
+        #: raw serving source of the LAST read: a worker tier alias
+        #: ("MEM"/"SSD"/...), "SHM" for short-circuit, or "UFS"
+        self.last_source: Optional[str] = None
 
     def pread(self, offset: int, n: int) -> bytes:
         raise NotImplementedError
@@ -66,6 +80,20 @@ class BlockInStream:
     @property
     def source(self) -> str:
         raise NotImplementedError
+
+    def source_bucket(self) -> str:
+        """The last read's serving source, normalized to an input-doctor
+        bucket: ``shm`` (same-host /dev/shm mmap), ``remote`` (cached on
+        a remote worker, whatever its tier), ``ufs`` (cold
+        read-through), or ``unknown``."""
+        src = self.last_source
+        if src is None:
+            return "unknown"
+        if src == "SHM":
+            return "shm"
+        if src == "UFS":
+            return "ufs"
+        return "remote"
 
     def close(self) -> None:
         pass
@@ -87,6 +115,7 @@ class LocalBlockInStream(BlockInStream):
     def __init__(self, worker: WorkerClient, session_id: int, block_id: int):
         lease = worker.open_local_block(session_id, block_id)
         super().__init__(block_id, lease["length"])
+        self.last_source = "SHM"
         self._worker = worker
         self._session = session_id
         self._path = lease["path"]
@@ -97,7 +126,9 @@ class LocalBlockInStream(BlockInStream):
     def pread(self, offset: int, n: int) -> bytes:
         if self._mm is None:
             return b""
-        return self._mm[offset:offset + n]
+        out = self._mm[offset:offset + n]
+        _record_read("shm", len(out))
+        return out
 
     def memoryview(self) -> Optional[memoryview]:
         return memoryview(self._mm) if self._mm is not None else memoryview(b"")
@@ -106,6 +137,7 @@ class LocalBlockInStream(BlockInStream):
         """Zero-copy ndarray over the mmap — feed straight to device_put."""
         if self._mm is None:
             return np.empty(0, dtype=dtype)
+        _record_read("shm", len(self._mm))
         return np.frombuffer(self._mm, dtype=dtype)
 
     def close(self) -> None:
@@ -142,10 +174,17 @@ class GrpcBlockInStream(BlockInStream):
 
     def pread(self, offset: int, n: int) -> bytes:
         out = bytearray()
+        source = None
         for msg in self._worker.read_block(
                 self.block_id, offset=offset, length=n,
                 chunk_size=self._chunk, ufs=self._ufs, cache=self._cache):
             out.extend(msg["data"])
+            source = msg.get("source", source)
+        # a pre-source-tagging worker sends no field: the read still
+        # went to a remote worker's cache (cold reads raise without a
+        # UFS descriptor, and with one the worker tags "UFS")
+        self.last_source = source or "REMOTE"
+        _record_read(self.source_bucket(), len(out))
         return bytes(out)
 
     @property

@@ -74,6 +74,9 @@ class FileSystem:
             from alluxio_tpu.utils.tracing import set_tracing_enabled
 
             set_tracing_enabled(True)
+        from alluxio_tpu.utils.tracing import apply_trace_conf
+
+        apply_trace_conf(self._conf)
         from alluxio_tpu.security.authentication import client_metadata
 
         md = tuple(client_metadata(self._conf))
@@ -144,13 +147,17 @@ class FileSystem:
             self._metrics_thread.start()
 
     def send_metrics(self) -> None:
-        """Ship this client's metric snapshot to the master for cluster
-        aggregation (reference: ``client/metrics/ClientMasterSync``)."""
+        """Ship this client's metric snapshot — plus completed trace
+        spans drained from the local ring — to the master for cluster
+        aggregation and trace stitching (reference:
+        ``client/metrics/ClientMasterSync``)."""
         from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils.tracing import tracer
 
+        spans = tracer().drain(500) if tracer().enabled else []
         self.meta_master.metrics_heartbeat(
             f"client-{socket.gethostname()}-{id(self):x}",
-            metrics().snapshot())
+            metrics().snapshot(), spans=spans)
 
     # ------------------------------------------------------------- metadata
     def get_status(self, path: "str | AlluxioURI") -> FileInfo:

@@ -22,6 +22,7 @@ part: "prefetch collectives must overlap compute").
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import deque
 from concurrent.futures import CancelledError
 from concurrent.futures import TimeoutError as FuturesTimeoutError
@@ -33,7 +34,129 @@ from alluxio_tpu.client.cache.hbm_store import HbmPageStore
 from alluxio_tpu.client.cache.meta import PageId
 from alluxio_tpu.client.file_system import FileSystem
 from alluxio_tpu.metrics import metrics
+from alluxio_tpu.metrics.stall import BUCKET_ADVICE, STALL_BUCKETS
 from alluxio_tpu.utils.tracing import annotate
+
+
+#: live StepStats instances backing the ONE process-level
+#: Client.InputBoundFraction gauge — per-instance registration would
+#: let a closed loader's frozen fraction shadow the running one (and
+#: pin the dead loader via the registry's closure)
+_LIVE_STEP_STATS: "weakref.WeakSet" = None  # type: ignore[assignment]
+_GAUGE_LOCK = threading.Lock()
+
+
+def _process_input_bound_fraction() -> float:
+    with _GAUGE_LOCK:
+        # copy under the lock: a concurrent StepStats.__init__ add()
+        # mid-iteration raises "set changed size during iteration"
+        stats = list(_LIVE_STEP_STATS or ())
+    if not stats:
+        return 0.0
+    wait = elapsed = 0.0
+    for st in stats:
+        w, e = st.window_totals()
+        wait += w
+        elapsed += e
+    return (wait / elapsed) if elapsed > 0 else 0.0
+
+
+class StepStats:
+    """Input-stall attribution for one :class:`DeviceBlockLoader`.
+
+    Every time the consumer waits on the loader pipeline, the wait is
+    attributed to the serving tier of the block that eventually arrived.
+    Exports ``Client.InputStall.<bucket>`` timers (local percentiles),
+    additive ``Client.InputStallUs/Count/Bytes.<bucket>`` counters (they
+    roll up to ``Cluster.*`` on the metrics heartbeat), and a rolling
+    input-bound-fraction gauge — what ``fsadmin report stall``, the
+    master statuspage and the stress suite read."""
+
+    def __init__(self, window: int = 512) -> None:
+        global _LIVE_STEP_STATS
+
+        self._lock = threading.Lock()
+        self._m = metrics()
+        self.wait_s = {b: 0.0 for b in STALL_BUCKETS}
+        self.count = {b: 0 for b in STALL_BUCKETS}
+        self.bytes = {b: 0 for b in STALL_BUCKETS}
+        #: rolling (wait_s, elapsed_s) per consumed block — the gauge's
+        #: window, so the fraction tracks NOW, not the whole run
+        self._window: deque = deque(maxlen=window)
+        with _GAUGE_LOCK:
+            if _LIVE_STEP_STATS is None:
+                _LIVE_STEP_STATS = weakref.WeakSet()
+            _LIVE_STEP_STATS.add(self)
+        # one registration for the whole process (idempotent overwrite
+        # of the same function): the gauge pools LIVE collectors only
+        self._m.register_gauge("Client.InputBoundFraction",
+                               _process_input_bound_fraction)
+
+    def close(self) -> None:
+        """Drop this collector from the process gauge (its additive
+        counters keep their totals — only the live fraction stops)."""
+        with _GAUGE_LOCK:
+            if _LIVE_STEP_STATS is not None:
+                _LIVE_STEP_STATS.discard(self)
+
+    def window_totals(self) -> "tuple[float, float]":
+        """(waited_s, elapsed_s) over the rolling window."""
+        with self._lock:
+            return (sum(w for w, _ in self._window),
+                    sum(e for _, e in self._window))
+
+    def record(self, bucket: str, wait_s: float, nbytes: int,
+               elapsed_s: float) -> None:
+        if bucket not in self.wait_s:
+            bucket = "unknown"
+        with self._lock:
+            self.wait_s[bucket] += wait_s
+            self.count[bucket] += 1
+            self.bytes[bucket] += nbytes
+            self._window.append((wait_s, max(elapsed_s, wait_s)))
+        self._m.timer(f"Client.InputStall.{bucket}").update(wait_s)
+        self._m.counter(f"Client.InputStallUs.{bucket}").inc(
+            int(wait_s * 1e6))
+        self._m.counter(f"Client.InputStallCount.{bucket}").inc()
+        self._m.counter(f"Client.InputStallBytes.{bucket}").inc(nbytes)
+
+    def input_bound_fraction(self) -> float:
+        """Share of recent wall time the consumer spent waiting for
+        input (0 = compute-bound, 1 = fully input-bound)."""
+        wait, elapsed = self.window_totals()
+        return (wait / elapsed) if elapsed > 0 else 0.0
+
+    def report(self) -> dict:
+        """Ranked bottleneck verdict (the input doctor)."""
+        with self._lock:
+            wait = dict(self.wait_s)
+            count = dict(self.count)
+            nbytes = dict(self.bytes)
+        total = sum(wait.values())
+        buckets = {}
+        for b in STALL_BUCKETS:
+            if not count[b]:
+                continue
+            buckets[b] = {
+                "wait_s": round(wait[b], 6), "count": count[b],
+                "bytes": nbytes[b],
+                "share": round(wait[b] / total, 4) if total else 0.0,
+            }
+        ranked = sorted(buckets, key=lambda b: buckets[b]["wait_s"],
+                        reverse=True)
+        frac = self.input_bound_fraction()
+        if not ranked:
+            verdict = "no input-stall samples recorded"
+        else:
+            top = ranked[0]
+            verdict = (f"input-bound {frac:.0%} of recent wall time; "
+                       f"top bottleneck: {top} "
+                       f"({buckets[top]['share']:.0%} of "
+                       f"{total:.3f}s stall) — {BUCKET_ADVICE[top]}")
+        return {"total_wait_s": round(total, 6),
+                "input_bound_fraction": round(frac, 4),
+                "buckets": buckets, "ranked": ranked,
+                "verdict": verdict}
 
 
 class DeviceBlockLoader:
@@ -61,6 +184,8 @@ class DeviceBlockLoader:
         self._svc = prefetch_service
         self._epoch_counter = 0
         self._m = metrics()
+        #: input doctor: per-tier wait attribution for this loader
+        self.step_stats = StepStats()
         #: flat list of (path, block_index, page_id)
         self._plan: List[tuple] = []
         #: path -> master block ids (public: saves consumers a
@@ -151,9 +276,14 @@ class DeviceBlockLoader:
         view = getattr(stream, "numpy_view", None)
         if view is not None:
             self._m.counter("Client.JaxShortCircuitBlocks").inc()
+            self._tls.last_bucket = "shm"
             return view(dtype=self._dtype)
         self._m.counter("Client.JaxStreamedBlocks").inc()
-        return np.frombuffer(stream.read_all(), dtype=self._dtype)
+        data = np.frombuffer(stream.read_all(), dtype=self._dtype)
+        # AFTER the read: a stale location can self-heal into a UFS
+        # read-through mid-call, and only the stream knows what served
+        self._tls.last_bucket = stream.source_bucket()
+        return data
 
     def prefetch_into_hbm(self, ref) -> bool:
         """Prefetch-agent hook: host-read one block and adopt it into
@@ -249,7 +379,8 @@ class DeviceBlockLoader:
                                     generation=gen)
                                 if out != "stale":
                                     self._svc.release(ref)
-                            self._put(q, stop, (pid, arr, True))
+                            self._put(q, stop, (pid, arr, True, "hbm",
+                                                getattr(arr, "nbytes", 0)))
                             continue
                     outcome = None
                     if ref is not None:
@@ -270,6 +401,7 @@ class DeviceBlockLoader:
 
                             if not native.prefault(host):
                                 host[::4096].max()
+                    bucket = getattr(self._tls, "last_bucket", "unknown")
                     if ref is not None:
                         if outcome != "stale":
                             # a stale (superseded-epoch) consume must
@@ -284,7 +416,8 @@ class DeviceBlockLoader:
                             # had resident already
                             self._svc.record_stall(
                                 _time.monotonic() - t0)
-                    self._put(q, stop, (pid, host, False))
+                    self._put(q, stop, (pid, host, False, bucket,
+                                        host.nbytes))
             except BaseException as e:  # noqa: BLE001 re-raised in consumer
                 # a read failure must FAIL the epoch, not silently end
                 # it short (a truncated epoch looks complete downstream)
@@ -323,23 +456,34 @@ class DeviceBlockLoader:
         inflight: deque = deque()
         finished = False
         try:
+            # input-doctor accounting: each queue wait is attributed to
+            # the serving tier of the item that ends it; elapsed-since-
+            # last-item bounds the rolling input-bound fraction
+            last_item_t = _time.monotonic()
             while True:
-                try:
-                    item = q.get(timeout=0.5)
-                except _q.Empty:
-                    if stop.is_set():
-                        # cancelled by close()/a newer epoch(): fail
-                        # loudly — a silently-truncated epoch looks
-                        # complete downstream
-                        raise RuntimeError(
-                            "epoch cancelled: the loader was closed or "
-                            "a newer epoch() superseded this iterator")
-                    continue
+                wait_t0 = _time.monotonic()
+                while True:
+                    try:
+                        item = q.get(timeout=0.5)
+                        break
+                    except _q.Empty:
+                        if stop.is_set():
+                            # cancelled by close()/a newer epoch(): fail
+                            # loudly — a silently-truncated epoch looks
+                            # complete downstream
+                            raise RuntimeError(
+                                "epoch cancelled: the loader was closed "
+                                "or a newer epoch() superseded this "
+                                "iterator")
                 if item is SENTINEL:
                     break
                 if item[0] == "__error__":
                     raise item[1]
-                pid, data, on_device = item
+                pid, data, on_device, bucket, nbytes = item
+                now = _time.monotonic()
+                self.step_stats.record(bucket, now - wait_t0, nbytes,
+                                       now - last_item_t)
+                last_item_t = now
                 if on_device:
                     arr = data
                 else:
@@ -437,7 +581,13 @@ class DeviceBlockLoader:
         return {"hbm_bytes": self._hbm.used_bytes,
                 "hbm_pages": self._hbm.page_count}
 
+    def stall_report(self) -> dict:
+        """Input-doctor verdict: ranked per-tier wait attribution for
+        this loader (see :meth:`StepStats.report`)."""
+        return self.step_stats.report()
+
     def close(self) -> None:
+        self.step_stats.close()  # stop feeding the process gauge
         if self._svc is not None:
             self._svc.bind_hbm(None)  # agent must not touch a dead loader
         with self._epoch_lock:

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Set
 
 from alluxio_tpu.client.block_store import BlockStoreClient
 from alluxio_tpu.client.block_streams import BlockInStream, BlockOutStream
+from alluxio_tpu.metrics import metrics
 from alluxio_tpu.rpc.clients import FsMasterClient
 from alluxio_tpu.utils.exceptions import (
     BlockDoesNotExistError, InvalidArgumentError, UnavailableError,
@@ -142,7 +143,15 @@ class FileInStream:
             if readable <= 0:
                 return b""
             try:
-                return stream.pread(offset_in_block, min(n, readable))
+                t0 = time.perf_counter()
+                chunk = stream.pread(offset_in_block, min(n, readable))
+                # per-tier read latency: the block stream tags its
+                # serving source AFTER the read (a worker may self-heal
+                # a stale location into a UFS read-through mid-call)
+                metrics().timer(
+                    f"Client.BlockReadTime.{stream.source_bucket()}"
+                ).update(time.perf_counter() - t0)
+                return chunk
             except UnavailableError as e:
                 # serving worker died mid-read: remember it, refresh the
                 # block's locations, retry another replica / UFS fallback

@@ -687,7 +687,22 @@ class Keys:
         "atpu.trace.enabled", KeyType.BOOL, default=False,
         scope=Scope.ALL,
         description="Record RPC/operation spans into the in-process "
-                    "trace ring (served at /api/v1/master/trace).")
+                    "trace ring (served at /api/v1/master/trace). "
+                    "Spans carry a W3C-traceparent context across RPC "
+                    "hops, so client/worker/master spans stitch into "
+                    "one trace.")
+    TRACE_SAMPLE_RATE = _k(
+        "atpu.trace.sample.rate", KeyType.FLOAT, default=1.0,
+        scope=Scope.ALL,
+        description="Probability a NEW root trace is recorded (0..1). "
+                    "Child spans — local and remote — inherit the "
+                    "root's decision, so traces never tear.")
+    TRACE_RING_CAPACITY = _k(
+        "atpu.trace.ring.capacity", KeyType.INT, default=4096,
+        scope=Scope.ALL,
+        description="Completed spans retained per process (oldest "
+                    "evicted first). Workers/clients drain the ring to "
+                    "the master on the metrics heartbeat.")
     METRICS_SINKS = _k(
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,
@@ -713,6 +728,13 @@ class Keys:
     METRICS_SINK_GRAPHITE_PREFIX = _k(
         "atpu.metrics.sink.graphite.prefix", KeyType.STRING,
         default="alluxio-tpu", scope=Scope.ALL)
+    METRICS_SINK_GRAPHITE_TIMEOUT = _k(
+        "atpu.metrics.sink.graphite.timeout", KeyType.DURATION,
+        default="5s", scope=Scope.ALL,
+        description="Connect/send deadline for the Graphite sink. The "
+                    "send also runs on a dedicated sender thread, so a "
+                    "dead carbon host can never stall the shared "
+                    "metrics-sink heartbeat.")
     USER_METRICS_COLLECTION_ENABLED = _k(
         "atpu.user.metrics.collection.enabled", KeyType.BOOL, default=False,
         scope=Scope.CLIENT,

@@ -7,6 +7,11 @@ stores them per source and serves ``Cluster.*`` aggregates (sums across
 sources, with the instance prefix rewritten) alongside its own metrics —
 what ``fsadmin report metrics`` and the Prometheus endpoint read.
 
+The same heartbeat carries completed SPAN batches (each node drains its
+trace ring): they land in a ``TraceStore`` so ``/api/v1/master/trace``
+serves stitched cross-process traces — one trace_id across client,
+worker and master spans.
+
 Aggregation is additive-only: counters/meters/gauges sum across sources;
 timer percentile sub-metrics (non-additive) are skipped, as the reference
 aggregates counters and throughput meters, not latency histograms.
@@ -18,7 +23,14 @@ import threading
 import time
 from typing import Dict, Optional
 
+from alluxio_tpu.utils.tracing import TraceStore
+
 _NON_ADDITIVE_SUFFIXES = (".p50", ".p95", ".p99", ".mean", ".min", ".max")
+#: fraction gauges aggregate as a MEAN across sources — summing 4
+#: clients' 0.8 into a "3.2 input-bound" Cluster gauge is nonsense,
+#: but dropping them would hide the input doctor's headline number
+#: from exactly the distributed deployment it targets
+_MEAN_SUFFIXES = ("InputBoundFraction",)
 _INSTANCE_PREFIXES = ("Worker.", "Client.", "JobWorker.", "Process.")
 
 
@@ -65,8 +77,10 @@ class MetricsStore:
             self._last_seen.pop(s, None)
 
     def cluster_metrics(self) -> Dict[str, float]:
-        """``Cluster.<name>`` = sum over sources of additive metrics."""
+        """``Cluster.<name>`` = sum over sources of additive metrics
+        (fraction gauges average instead)."""
         out: Dict[str, float] = {}
+        mean_counts: Dict[str, int] = {}
         with self._lock:
             self._gc(self._clock())
             for snap in self._reports.values():
@@ -79,6 +93,10 @@ class MetricsStore:
                             break
                     key = f"Cluster.{name}"
                     out[key] = out.get(key, 0.0) + value
+                    if name.endswith(_MEAN_SUFFIXES):
+                        mean_counts[key] = mean_counts.get(key, 0) + 1
+        for key, n in mean_counts.items():
+            out[key] = out[key] / n
         return out
 
     def source_count(self) -> int:
@@ -95,12 +113,17 @@ class MetricsStore:
 class MetricsMaster:
     """Facade the master process owns (reference: DefaultMetricsMaster)."""
 
-    def __init__(self, store: Optional[MetricsStore] = None) -> None:
+    def __init__(self, store: Optional[MetricsStore] = None,
+                 traces: Optional[TraceStore] = None) -> None:
         self.store = store or MetricsStore()
+        self.traces = traces or TraceStore()
 
     def handle_heartbeat(self, request: dict) -> dict:
         source = str(request.get("source") or "unknown")
         self.store.report(source, request.get("metrics") or {})
+        spans = request.get("spans")
+        if spans:
+            self.traces.ingest(source, spans)
         return {}
 
     def merged_snapshot(self, own: Dict[str, float]) -> Dict[str, float]:

@@ -148,9 +148,12 @@ class MasterProcess:
     def start(self) -> int:
         """Boot straight to primary; returns the bound RPC port."""
         from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
-        from alluxio_tpu.utils.tracing import set_tracing_enabled
+        from alluxio_tpu.utils.tracing import (
+            apply_trace_conf, set_tracing_enabled,
+        )
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
+        apply_trace_conf(self._conf)
         # stall detector (reference: JvmPauseMonitor started at
         # AlluxioMasterProcess.java:265-273): a paused master misses
         # heartbeats and trips elections — make it visible. ONE per
@@ -410,9 +413,12 @@ class FaultTolerantMasterProcess(MasterProcess):
         import threading
 
         from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
-        from alluxio_tpu.utils.tracing import set_tracing_enabled
+        from alluxio_tpu.utils.tracing import (
+            apply_trace_conf, set_tracing_enabled,
+        )
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
+        apply_trace_conf(self._conf)
         # the HA master is the one whose elections stall detection
         # protects — it must not be the one path without it
         ensure_process_monitor()

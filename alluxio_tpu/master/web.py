@@ -39,7 +39,8 @@ def _dashboard_html() -> bytes:
     return render(
         "alluxio-tpu master", "/api/v1/master",
         sections=[("Cluster", "info"), ("Workers", "workers"),
-                  ("Mounts", "mounts"), ("Catalog", "catalog")],
+                  ("Mounts", "mounts"), ("Catalog", "catalog"),
+                  ("Input doctor", "stall")],
         raw_routes=["/api/v1/master/info", "/capacity", "/metrics",
                     "/mounts", "/catalog", "/trace",
                     "/browse", "/config", "/logs"],
@@ -65,6 +66,26 @@ def _dashboard_html() -> bytes:
     row(ct, ['database','tables'], true);
     for (const [db, tables] of Object.entries(c.databases))
       row(ct, [db, tables.join(', ')]);
+    // input doctor: rank loader input waits by serving tier
+    // (Cluster.* roll-up when clients report, else this process's own)
+    const met = (await j('/metrics')).metrics;
+    const st = document.getElementById('stall');
+    row(st, ['tier','waits','stalled (s)','share'], true);
+    const buckets = {};
+    for (const [k, v] of Object.entries(met)) {
+      const m2 = k.match(/^(?:Cluster|Client)\\.InputStall(Us|Count)\\.(\\w+)$/);
+      if (!m2) continue;
+      const b = buckets[m2[2]] = buckets[m2[2]] || {us: 0, count: 0};
+      if (m2[1] === 'Us') b.us = Math.max(b.us, v);
+      else b.count = Math.max(b.count, v);
+    }
+    const totalUs = Object.values(buckets).reduce((a, b) => a + b.us, 0);
+    const ranked = Object.entries(buckets).sort((a, b) => b[1].us - a[1].us);
+    for (const [name, b] of ranked)
+      row(st, [name, String(b.count), (b.us / 1e6).toFixed(3),
+               totalUs ? (100 * b.us / totalUs).toFixed(1) + '%' : '-']);
+    if (!ranked.length)
+      row(st, ['(no input-stall samples recorded)', '', '', '']);
 """)
 
 
@@ -242,10 +263,18 @@ class MasterWebServer:
                         db: tm.list_tables(db)
                         for db in tm.list_databases()}}
                 if route == "/api/v1/master/trace":
-                    from alluxio_tpu.utils.tracing import tracer
+                    from alluxio_tpu.utils.tracing import (
+                        stitch_spans, tracer,
+                    )
 
-                    return {"enabled": tracer().enabled,
-                            "spans": tracer().snapshot()}
+                    mm = getattr(mp, "metrics_master", None)
+                    stitched = stitch_spans(
+                        mm.traces if mm is not None else None,
+                        limit=int(self.query.get("limit", "500") or 500),
+                        prefix=self.query.get("prefix", ""),
+                        trace_id=self.query.get("trace_id", ""),
+                        local_source="master")
+                    return {"enabled": tracer().enabled, **stitched}
                 if route == "/api/v1/master/browse":
                     path = self.query.get("path", "/") or "/"
                     entries = mp.fs_master.list_status(path, wire=True)

@@ -1,6 +1,10 @@
-"""Metrics (reference: ``core/common/.../metrics``)."""
+"""Metrics (reference: ``core/common/.../metrics``).
+
+Cluster-level aggregation lives in ``master/metrics_master.py``
+(``MetricsStore``) — the one authoritative implementation; the old
+``ClusterAggregator`` duplicate is gone.
+"""
 
 from alluxio_tpu.metrics.registry import (  # noqa: F401
-    ClusterAggregator, Counter, Meter, MetricsRegistry, Timer, metrics,
-    reset_metrics,
+    Counter, Meter, MetricsRegistry, Timer, metrics, reset_metrics,
 )

@@ -12,10 +12,11 @@ metrics to the master for cluster-level aggregation
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 class Counter:
@@ -76,10 +77,19 @@ class Meter:
 class Timer:
     """Latency histogram (reservoir of recent samples) + throughput count."""
 
+    #: classic Prometheus latency bucket bounds (seconds); lifetime
+    #: cumulative counts are kept per bound so the exposition series
+    #: stay monotonic across scrapes (a sliding-reservoir histogram
+    #: would DECREASE when samples age out — PromQL reads that as a
+    #: counter reset and inflates every rate()/quantile)
+    HISTOGRAM_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                         1.0, 2.5, 5.0, 10.0)
+
     def __init__(self, reservoir: int = 1028) -> None:
         self._samples: deque = deque(maxlen=reservoir)
         self._count = 0
         self._total_s = 0.0
+        self._bucket_counts = [0] * len(self.HISTOGRAM_BUCKETS)
         self._lock = threading.Lock()
 
     def update(self, seconds: float) -> None:
@@ -87,6 +97,9 @@ class Timer:
             self._count += 1
             self._total_s += seconds
             self._samples.append(seconds)
+            for i, le in enumerate(self.HISTOGRAM_BUCKETS):
+                if seconds <= le:
+                    self._bucket_counts[i] += 1
 
     class _Ctx:
         def __init__(self, timer: "Timer") -> None:
@@ -117,9 +130,31 @@ class Timer:
         return s[idx]
 
     def snapshot(self) -> Dict[str, float]:
-        return {"count": self.count, "p50": self.percentile(50),
-                "p95": self.percentile(95), "p99": self.percentile(99),
-                "mean": (self._total_s / self._count) if self._count else 0.0}
+        # ONE locked copy of (samples, count, total): reading _total_s /
+        # _count piecemeal outside the lock tore the mean under a
+        # concurrent update() (count incremented between the two reads)
+        with self._lock:
+            samples = sorted(self._samples)
+            count = self._count
+            total = self._total_s
+
+        def pct(p: float) -> float:
+            if not samples:
+                return 0.0
+            return samples[min(len(samples) - 1,
+                               int(p / 100.0 * len(samples)))]
+
+        return {"count": count, "p50": pct(50), "p95": pct(95),
+                "p99": pct(99),
+                "mean": (total / count) if count else 0.0}
+
+    def histogram(self) -> "tuple[List[int], float, int]":
+        """Lifetime cumulative bucket counts plus (sum, count) — one
+        consistent monotonic series for Prometheus exposition."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+            counts.append(self._count)  # +Inf
+            return counts, self._total_s, self._count
 
 
 class MetricsRegistry:
@@ -180,12 +215,57 @@ class MetricsRegistry:
                 pass
         return out
 
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        """Exposition-legal metric name: ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+        metric = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+        if metric and metric[0].isdigit():
+            metric = "_" + metric
+        return metric
+
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format (# HELP/# TYPE preambles,
+        ``_total``-suffixed counters, timer histograms with
+        bucket/sum/count — what promtool check metrics accepts)."""
+        with self._lock:
+            counters = dict(self._counters)
+            meters = dict(self._meters)
+            timers = dict(self._timers)
+            gauges = dict(self._gauges)
         lines: List[str] = []
-        for name, value in sorted(self.snapshot().items()):
-            metric = name.replace(".", "_").replace("-", "_")
+
+        def emit(name: str, kind: str, help_text: str) -> str:
+            metric = self._prom_name(name)
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} {kind}")
+            return metric
+
+        for name, c in sorted(counters.items()):
+            metric = emit(name + "_total", "counter",
+                          f"counter {name}")
+            lines.append(f"{metric} {c.count}")
+        for name, m in sorted(meters.items()):
+            metric = emit(name + "_total", "counter", f"meter {name}")
+            lines.append(f"{metric} {m.count}")
+            metric = emit(name + "_rate1m", "gauge",
+                          f"1-minute rate of {name}")
+            lines.append(f"{metric} {m.one_minute_rate}")
+        for name, g in sorted(gauges.items()):
+            try:
+                value = float(g())
+            except Exception:  # noqa: BLE001 - dead gauge: skip
+                continue
+            metric = emit(name, "gauge", f"gauge {name}")
             lines.append(f"{metric} {value}")
+        for name, t in sorted(timers.items()):
+            counts, total, n = t.histogram()
+            metric = emit(name + "_seconds", "histogram",
+                          f"latency histogram of {name}")
+            for le, cum in zip(t.HISTOGRAM_BUCKETS, counts):
+                lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {counts[-1]}')
+            lines.append(f"{metric}_sum {total}")
+            lines.append(f"{metric}_count {n}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -194,37 +274,6 @@ class MetricsRegistry:
             self._meters.clear()
             self._timers.clear()
             self._gauges.clear()
-
-
-class ClusterAggregator:
-    """Aggregates metric snapshots reported by workers/clients into
-    ``Cluster.*`` metrics (reference: ``MetricsStore`` +
-    ``DefaultMetricsMaster``)."""
-
-    def __init__(self) -> None:
-        self._reports: Dict[str, Dict[str, float]] = {}
-        self._lock = threading.Lock()
-
-    def report(self, source_id: str, snapshot: Dict[str, float]) -> None:
-        with self._lock:
-            self._reports[source_id] = dict(snapshot)
-
-    def clear_source(self, source_id: str) -> None:
-        with self._lock:
-            self._reports.pop(source_id, None)
-
-    def cluster_snapshot(self) -> Dict[str, float]:
-        agg: Dict[str, float] = {}
-        with self._lock:
-            reports = [dict(r) for r in self._reports.values()]
-        for snap in reports:
-            for name, value in snap.items():
-                if name.endswith(".p50") or name.endswith(".p95") or \
-                        name.endswith(".p99") or name.endswith(".mean"):
-                    continue
-                key = "Cluster." + name.split(".", 1)[-1]
-                agg[key] = agg.get(key, 0.0) + value
-        return agg
 
 
 _default: Optional[MetricsRegistry] = None

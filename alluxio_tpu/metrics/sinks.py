@@ -15,6 +15,7 @@ import json
 import logging
 import os
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -93,13 +94,28 @@ class GraphiteSink(Sink):
     ``metrics/sink/GraphiteSink.java``): one ``<prefix>.<name> <value>
     <unix-ts>\\n`` line per metric over TCP. The socket reconnects per
     report tick — Carbon treats connections as cheap and a long-lived
-    one would silently die across Carbon restarts."""
+    one would silently die across Carbon restarts.
+
+    The TCP send runs on a dedicated sender thread with a bounded
+    connect/send deadline: ``report()`` only enqueues, so a dead carbon
+    host can never stall the shared sink heartbeat (which would starve
+    EVERY other sink for the full connect timeout each tick). The queue
+    keeps only the newest pending snapshot — under backpressure stale
+    ticks are dropped, latest wins."""
 
     def __init__(self, host: str, port: int,
-                 prefix: str = "alluxio-tpu") -> None:
+                 prefix: str = "alluxio-tpu",
+                 timeout_s: float = 5.0) -> None:
+        import queue
+
         self._host = host
         self._port = port
         self._prefix = prefix.rstrip(".")
+        self._timeout_s = timeout_s
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._sender = threading.Thread(target=self._run, daemon=True,
+                                        name="graphite-sink")
+        self._sender.start()
 
     @staticmethod
     def _sanitize(name: str) -> str:
@@ -108,7 +124,7 @@ class GraphiteSink(Sink):
         return name.replace(" ", "_")
 
     def report(self, snapshot: Dict[str, float]) -> None:
-        import socket
+        import queue
 
         ts = int(time.time())
         lines = [f"{self._prefix}.{self._sanitize(n)} {v} {ts}\n"
@@ -116,9 +132,50 @@ class GraphiteSink(Sink):
                  if isinstance(v, (int, float))]
         if not lines:
             return
-        with socket.create_connection((self._host, self._port),
-                                      timeout=10) as s:
-            s.sendall("".join(lines).encode())
+        payload = "".join(lines).encode()
+        while True:
+            try:
+                self._queue.put_nowait(payload)
+                return
+            except queue.Full:  # sender wedged on a dead host
+                try:
+                    self._queue.get_nowait()
+                    LOG.debug("graphite sink backlogged; dropped one "
+                              "stale snapshot")
+                except queue.Empty:
+                    pass
+
+    def _run(self) -> None:
+        import socket
+
+        while True:
+            payload = self._queue.get()
+            if payload is None:
+                return
+            try:
+                with socket.create_connection(
+                        (self._host, self._port),
+                        timeout=self._timeout_s) as s:
+                    s.sendall(payload)
+            except OSError:
+                LOG.warning("graphite sink send to %s:%s failed",
+                            self._host, self._port, exc_info=True)
+
+    def close(self) -> None:
+        import queue
+
+        # same drop-oldest discipline as report(): a wedged sender must
+        # not let close() block behind a full queue
+        while True:
+            try:
+                self._queue.put_nowait(None)
+                break
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+        self._sender.join(timeout=self._timeout_s + 1.0)
 
 
 class SinkManager:
@@ -170,7 +227,9 @@ class SinkManager:
                 self.sinks.append(GraphiteSink(
                     host, int(port),
                     prefix=conf.get(
-                        Keys.METRICS_SINK_GRAPHITE_PREFIX)))
+                        Keys.METRICS_SINK_GRAPHITE_PREFIX),
+                    timeout_s=conf.get_duration_s(
+                        Keys.METRICS_SINK_GRAPHITE_TIMEOUT)))
             else:
                 LOG.warning("unknown metrics sink %r (known: console, "
                             "csv, jsonl, graphite)", name)

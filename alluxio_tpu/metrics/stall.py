@@ -1,0 +1,28 @@
+"""Input-doctor vocabulary shared across layers.
+
+The serving-tier buckets every block read is attributed to, and the
+operator advice keyed by the top-ranked stall bucket. Lives here (not in
+the jax client) so the shell and web surfaces can rank a stall report
+without importing the device-loader stack.
+"""
+
+from __future__ import annotations
+
+#: serving-tier buckets the input doctor attributes waits to:
+#: hbm (device-resident hit), shm (same-host /dev/shm mmap ~= DRAM),
+#: remote (cached on a remote worker), ufs (cold read-through)
+STALL_BUCKETS = ("hbm", "shm", "remote", "ufs", "unknown")
+
+#: per-bucket operator hint, ranked bottleneck -> what to turn
+BUCKET_ADVICE = {
+    "ufs": "cold UFS reads dominate — warm the cache or enable "
+           "clairvoyant prefetch (atpu.prefetch.*)",
+    "remote": "remote-worker reads dominate — co-locate the client "
+              "with its workers or raise replication",
+    "shm": "short-circuit host reads dominate — raise HBM retention "
+           "(hbm_bytes) or loader prefetch depth",
+    "hbm": "waits are HBM-resident hits — the input path keeps up; "
+           "the job is compute-bound",
+    "unknown": "waits could not be attributed — check worker version "
+               "(source tagging) and loader wiring",
+}

@@ -346,8 +346,10 @@ class MetaMasterClient(_BaseClient):
         return self._call("set_trace_enabled",
                           {"enabled": enabled, "clear": clear})
 
-    def get_trace(self, *, limit: int = 500, prefix: str = "") -> dict:
-        return self._call("get_trace", {"limit": limit, "prefix": prefix})
+    def get_trace(self, *, limit: int = 500, prefix: str = "",
+                  trace_id: str = "") -> dict:
+        return self._call("get_trace", {"limit": limit, "prefix": prefix,
+                                        "trace_id": trace_id})
 
     def get_quorum_info(self) -> dict:
         return self._call("get_quorum_info", {})
@@ -374,11 +376,14 @@ class MetaMasterClient(_BaseClient):
                                           "config": config})
 
     def metrics_heartbeat(self, source: str,
-                          metrics: Dict[str, float]) -> None:
-        """Ship a node's metric snapshot for cluster aggregation
-        (reference: ``metric_master.proto`` ClientMasterSync)."""
+                          metrics: Dict[str, float],
+                          spans: Optional[List[dict]] = None) -> None:
+        """Ship a node's metric snapshot — and any completed trace spans
+        drained from its ring — for cluster aggregation / trace
+        stitching (reference: ``metric_master.proto`` ClientMasterSync)."""
         self._call("metrics_heartbeat", {"source": source,
-                                         "metrics": metrics})
+                                         "metrics": metrics,
+                                         "spans": spans or []})
 
     def get_config_report(self) -> dict:
         return self._call("get_config_report", {})

@@ -27,10 +27,26 @@ import grpc
 import msgpack
 
 from alluxio_tpu.utils.exceptions import AlluxioTpuError, UnavailableError
+from alluxio_tpu.utils.tracing import (
+    TRACEPARENT_KEY, bind_remote_parent, current_traceparent,
+    reset_remote_parent, tracer,
+)
 
 LOG = logging.getLogger(__name__)
 
 _ERROR_KEY = "atpu-error-bin"
+
+
+def _bind_trace(context: grpc.ServicerContext):
+    """Extract an inbound traceparent and bind it as this handler's
+    parent context, so the server span joins the caller's trace.
+    Returns a reset token (None when tracing is off / no header)."""
+    if not tracer().enabled:
+        return None
+    for k, v in (context.invocation_metadata() or ()):
+        if k == TRACEPARENT_KEY:
+            return bind_remote_parent(v)
+    return None
 
 _CODE_TO_GRPC = {
     "NOT_FOUND": grpc.StatusCode.NOT_FOUND,
@@ -79,9 +95,8 @@ def _unbind_user(token) -> None:
 def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
                 span_name: str = "") -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
-        from alluxio_tpu.utils.tracing import tracer
-
         token = None
+        trace_token = _bind_trace(context)
         try:
             with tracer().span(span_name or "rpc.unary"):
                 token = _bind_user(context, authenticator)
@@ -95,6 +110,7 @@ def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
         finally:
             _unbind_user(token)
+            reset_remote_parent(trace_token)
 
     return handler
 
@@ -102,9 +118,8 @@ def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
 def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
                      authenticator=None, span_name: str = "") -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
-        from alluxio_tpu.utils.tracing import tracer
-
         token = None
+        trace_token = _bind_trace(context)
         try:
             with tracer().span(span_name or "rpc.stream_out"):
                 token = _bind_user(context, authenticator)
@@ -118,6 +133,7 @@ def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
         finally:
             _unbind_user(token)
+            reset_remote_parent(trace_token)
 
     return handler
 
@@ -125,9 +141,8 @@ def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
 def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any],
                     authenticator=None, span_name: str = "") -> Callable:
     def handler(request_iterator, context: grpc.ServicerContext):
-        from alluxio_tpu.utils.tracing import tracer
-
         token = None
+        trace_token = _bind_trace(context)
         try:
             with tracer().span(span_name or "rpc.stream_in"):
                 token = _bind_user(context, authenticator)
@@ -141,6 +156,7 @@ def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any],
             context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
         finally:
             _unbind_user(token)
+            reset_remote_parent(trace_token)
 
     return handler
 
@@ -288,13 +304,22 @@ class RpcChannel:
                 RpcChannel._pool[address] = ch
             self._channel = ch
 
+    def _call_metadata(self) -> Tuple[Tuple[str, str], ...]:
+        """Per-call metadata: the channel identity plus the caller's
+        trace context, so the server span joins the caller's trace."""
+        tp = current_traceparent()
+        if tp is None:
+            return self.metadata
+        return self.metadata + ((TRACEPARENT_KEY, tp),)
+
     def call(self, service: str, method: str, request: dict,
              timeout: Optional[float] = 30.0) -> Any:
         fn = self._channel.unary_unary(
             f"/{service}/{method}", request_serializer=pack,
             response_deserializer=unpack)
         try:
-            return fn(request, timeout=timeout, metadata=self.metadata)
+            return fn(request, timeout=timeout,
+                      metadata=self._call_metadata())
         except grpc.RpcError as e:
             _raise_typed(e)
 
@@ -304,7 +329,8 @@ class RpcChannel:
             f"/{service}/{method}", request_serializer=pack,
             response_deserializer=unpack)
         try:
-            yield from fn(request, timeout=timeout, metadata=self.metadata)
+            yield from fn(request, timeout=timeout,
+                          metadata=self._call_metadata())
         except grpc.RpcError as e:
             _raise_typed(e)
 
@@ -315,7 +341,8 @@ class RpcChannel:
             f"/{service}/{method}", request_serializer=pack,
             response_deserializer=unpack)
         try:
-            return fn(requests, timeout=timeout, metadata=self.metadata)
+            return fn(requests, timeout=timeout,
+                      metadata=self._call_metadata())
         except grpc.RpcError as e:
             _raise_typed(e)
 

@@ -142,8 +142,11 @@ class FastPathServer:
                         frame = _read_frame(self.rfile)
                         if frame is None:
                             return  # clean disconnect
-                        service, method, request = msgpack.unpackb(
+                        parts = msgpack.unpackb(
                             frame, raw=False, strict_map_key=False)
+                        service, method, request = parts[:3]
+                        # optional 4th element: the caller's traceparent
+                        traceparent = parts[3] if len(parts) > 3 else None
                         fn = methods.get((service, method))
                         if fn is None:
                             _send_frame(self.connection, {"err": {
@@ -152,12 +155,20 @@ class FastPathServer:
                                            f"fastpath handler"}})
                             continue
                         try:
-                            from alluxio_tpu.utils.tracing import tracer
+                            from alluxio_tpu.utils.tracing import (
+                                bind_remote_parent, reset_remote_parent,
+                                tracer,
+                            )
 
                             # span parity with the gRPC wrapper: admin
-                            # tracing must see fastpath RPCs too
-                            with tracer().span(f"{service}.{method}"):
-                                result = fn(request or {})
+                            # tracing must see fastpath RPCs too, joined
+                            # to the caller's trace
+                            trace_token = bind_remote_parent(traceparent)
+                            try:
+                                with tracer().span(f"{service}.{method}"):
+                                    result = fn(request or {})
+                            finally:
+                                reset_remote_parent(trace_token)
                             _send_frame(self.connection, {"ok": result})
                         except AlluxioTpuError as e:
                             _send_frame(self.connection,
@@ -267,7 +278,15 @@ class FastPathChannel:
                 # per-call deadline, matching the gRPC path's semantics
                 sock.settimeout(timeout if timeout else 30.0)
                 self._tl.timeout = timeout
-            _send_frame(sock, [service, method, request])
+            from alluxio_tpu.utils.tracing import current_traceparent
+
+            # optional 4th frame element: the caller's trace context.
+            # Safe to extend the frame shape: fastpath is SAME-HOST by
+            # construction (socket discovery), so client and server
+            # always come from the same install
+            tp = current_traceparent()
+            _send_frame(sock, [service, method, request] +
+                        ([tp] if tp else []))
             resp = _read_frame(self._tl.rfile)
         except (ConnectionError, socket.timeout, OSError) as e:
             self.close_thread_connection()

@@ -318,12 +318,15 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
         return {"enabled": on}
 
     def _get_trace(r):
-        from alluxio_tpu.utils.tracing import tracer
+        from alluxio_tpu.utils.tracing import stitch_spans, tracer
 
-        return {"enabled": tracer().enabled,
-                "spans": tracer().snapshot(
-                    limit=int(r.get("limit") or 500),
-                    prefix=r.get("prefix") or "")}
+        stitched = stitch_spans(
+            metrics_master.traces if metrics_master is not None else None,
+            limit=int(r.get("limit") or 500),
+            prefix=r.get("prefix") or "",
+            trace_id=r.get("trace_id") or "",
+            local_source="master")
+        return {"enabled": tracer().enabled, **stitched}
 
     svc.unary("set_trace_enabled", _set_trace_enabled)
     svc.unary("get_trace", _get_trace)

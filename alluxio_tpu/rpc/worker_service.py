@@ -67,17 +67,28 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
 
     # ---------------------------------------------------------- read stream
     def read_block(req: dict) -> Iterator[dict]:
+        """Chunks carry ``source`` — the serving tier alias (MEM/SSD/...)
+        or ``UFS`` for a cold read-through — so clients can attribute
+        every byte to the tier that produced it (input doctor)."""
+        from alluxio_tpu.metrics import metrics
+
         block_id = req["block_id"]
         offset = req.get("offset", 0)
         length = req.get("length", -1)
         chunk = req.get("chunk_size", DEFAULT_CHUNK)
+        m = metrics()
         if worker.store.has_block(block_id):
             with worker.open_reader(block_id) as r:
+                tier = r.tier_alias or "MEM"
+                m.counter(f"Worker.BlocksServed.{tier}").inc()
+                served = m.counter(f"Worker.BytesServed.{tier}")
                 end = r.length if length < 0 else min(r.length, offset + length)
                 pos = offset
                 while pos < end:  # the reference's hot loop
                     n = min(chunk, end - pos)
-                    yield {"data": r.read(pos, n), "offset": pos}
+                    yield {"data": r.read(pos, n), "offset": pos,
+                           "source": tier}
+                    served.inc(n)
                     pos += n
             return
         ufs = req.get("ufs")
@@ -89,11 +100,15 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
             offset=ufs["offset"], length=ufs["length"],
             mount_id=ufs.get("mount_id", 0))
         data = worker.read_ufs_block(desc, cache=req.get("cache", True))
+        m.counter("Worker.BlocksServed.UFS").inc()
+        served = m.counter("Worker.BytesServed.UFS")
         end = len(data) if length < 0 else min(len(data), offset + length)
         pos = offset
         while pos < end:
             n = min(chunk, end - pos)
-            yield {"data": data[pos:pos + n], "offset": pos}
+            yield {"data": data[pos:pos + n], "offset": pos,
+                   "source": "UFS"}
+            served.inc(n)
             pos += n
 
     svc.stream_out("read_block", read_block)

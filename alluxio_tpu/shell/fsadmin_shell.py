@@ -22,12 +22,12 @@ ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 class ReportCommand(Command):
     name = "report"
     description = ("Report cluster summary|capacity|ufs|metrics|"
-                   "jobservice.")
+                   "jobservice|stall.")
 
     def configure(self, p):
         p.add_argument("category", nargs="?", default="summary",
                        choices=["summary", "capacity", "ufs", "metrics",
-                                "jobservice"])
+                                "jobservice", "stall"])
 
     def run(self, args, ctx):
         return getattr(self, f"_{args.category}")(ctx)
@@ -100,6 +100,67 @@ class ReportCommand(Command):
         snap = ctx.meta_client().get_metrics()
         for k in sorted(snap):
             ctx.print(f"{k}  {snap[k]}")
+        return 0
+
+    def _stall(self, ctx):
+        """Input doctor: ranked per-tier attribution of loader input
+        waits (``Client.InputStall*`` metrics, shipped to the master on
+        the metrics heartbeat and summed into ``Cluster.*``)."""
+        snap = ctx.meta_client().get_metrics()
+
+        def bucket_stats(kind):
+            # prefer the cluster roll-up (sums every reporting client);
+            # fall back to this master's own Client.* metrics (the
+            # in-process / single-node case)
+            out = {}
+            for prefix in (f"Cluster.InputStall{kind}.",
+                           f"Client.InputStall{kind}."):
+                for k, v in snap.items():
+                    if k.startswith(prefix):
+                        out[k[len(prefix):]] = v
+                if out:
+                    return out
+            return out
+
+        waits_us = bucket_stats("Us")
+        counts = bucket_stats("Count")
+        sizes = bucket_stats("Bytes")
+        total_s = sum(waits_us.values()) / 1e6
+        ctx.print("Input-stall attribution (input doctor):")
+        if not waits_us:
+            ctx.print("    no input-stall samples recorded — run a "
+                      "DeviceBlockLoader epoch with metrics collection "
+                      "enabled (atpu.user.metrics.collection.enabled)")
+            return 0
+        ctx.print(f"    {'tier':<10s} {'waits':>8s} {'stalled':>12s} "
+                  f"{'bytes':>12s} {'share':>7s}")
+        named_s = 0.0
+        for b, us in sorted(waits_us.items(), key=lambda kv: -kv[1]):
+            s = us / 1e6
+            if b != "unknown":
+                named_s += s
+            share = (100.0 * s / total_s) if total_s else 0.0
+            ctx.print(f"    {b:<10s} {int(counts.get(b, 0)):>8d} "
+                      f"{s:>11.3f}s "
+                      f"{human_size(int(sizes.get(b, 0))):>12s} "
+                      f"{share:>6.1f}%")
+        attributed = (100.0 * named_s / total_s) if total_s else 100.0
+        ctx.print(f"    attributed to a named tier: {attributed:.1f}% "
+                  f"of {total_s:.3f}s total wait")
+        # cluster mean first (the fleet view, averaged across reporting
+        # clients); the master's own gauge only exists when a loader
+        # ran in-process and would shadow the fleet with a stale 0.0
+        frac = snap.get("Cluster.InputBoundFraction",
+                        snap.get("Client.InputBoundFraction"))
+        if frac is not None:
+            ctx.print(f"    rolling input-bound fraction: {frac:.2f}")
+        top = max(waits_us, key=waits_us.get)
+        from alluxio_tpu.metrics.stall import BUCKET_ADVICE
+
+        share = (100.0 * waits_us[top] / 1e6 / total_s) if total_s else 0.0
+        ctx.print(f"Verdict: top bottleneck is '{top}' ({share:.0f}% of "
+                  f"stall) — "
+                  f"{BUCKET_ADVICE.get(top, 'no advice for this tier')}")
         return 0
 
     def _jobservice(self, ctx):
@@ -479,9 +540,17 @@ class TraceCommand(Command):
         for s in resp["spans"]:
             dur = s["duration_ms"]
             shown = "-" if dur is None else f"{round(dur, 2)}"
+            tid = (s.get("trace_id") or "")[:8]
             ctx.print(f"  {s['name']:<40} {shown:>9} ms  "
+                      f"trace={tid} src={s.get('source', 'local')} "
                       f"thread={s['thread']}"
                       + (f"  ERROR {s['error']}" if s["error"] else ""))
+        for t in resp.get("traces", [])[:10]:
+            dur = t.get("duration_ms")
+            ctx.print(f"  trace {t['trace_id'][:8]}: {t['spans']} spans "
+                      f"across {','.join(t['sources'])} "
+                      f"root={t.get('root') or '?'} "
+                      f"({'-' if dur is None else round(dur, 2)} ms)")
         return 0
 
 

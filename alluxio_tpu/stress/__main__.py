@@ -85,6 +85,16 @@ def build_parser() -> argparse.ArgumentParser:
     wr.add_argument("--file-mb", type=int, default=8)
     wr.add_argument("--mem-mb", type=int, default=64)
 
+    ob = sub.add_parser("obs", help="tracing overhead: spans/sec + "
+                                    "enabled-vs-disabled read latency")
+    ob.add_argument("--file-mb", type=int, default=4)
+    ob.add_argument("--reads", type=int, default=60,
+                    help="reads per alternating batch")
+    ob.add_argument("--batches", type=int, default=5)
+    ob.add_argument("--span-iterations", type=int, default=100_000)
+    ob.add_argument("--max-overhead-pct", type=float, default=2.0,
+                    help="fail the bench above this tracing overhead")
+
     sub.add_parser("suite", help="run the whole BASELINE config family")
     rp = sub.add_parser("report",
                         help="render suite JSON to a single-file HTML "
@@ -124,6 +134,7 @@ SUITE = (
                               "--epochs", "2"]),
     ("table-projection", ["table"]),
     ("write-eviction", ["write"]),
+    ("obs-tracing-overhead", ["obs"]),
 )
 
 
@@ -279,6 +290,13 @@ def main(argv=None) -> int:
         r = run(threads=args.threads, num_files=args.num_files,
                 file_bytes=args.file_mb << 20,
                 mem_bytes=args.mem_mb << 20)
+    elif args.bench == "obs":
+        from alluxio_tpu.stress.obs_bench import run
+
+        r = run(file_mb=args.file_mb, reads=args.reads,
+                batches=args.batches,
+                span_iterations=args.span_iterations,
+                max_overhead_pct=args.max_overhead_pct)
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1

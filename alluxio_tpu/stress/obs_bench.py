@@ -1,0 +1,98 @@
+"""Observability overhead bench (``make bench-obs``).
+
+Measures what the tracing layer costs when it is ON — the number that
+justifies leaving it compiled into the hot path:
+
+- **spans/sec**: raw span open/close throughput of the process tracer
+  (the per-RPC fixed cost).
+- **read latency delta**: median end-to-end cached-read latency through
+  a live in-process cluster, tracing disabled vs enabled, interleaved
+  in alternating batches so host-speed drift cancels out.
+
+The suite row FAILS (``errors=1``) when the enabled-vs-disabled delta
+exceeds ``--max-overhead-pct`` (default 2%), which is the budget the
+"cheap enough to leave compiled in" claim makes.
+"""
+
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+from alluxio_tpu.stress.base import BenchResult
+
+
+def _median_read_s(fs, path: str, n: int) -> float:
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fs.read_all(path)
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def _span_throughput(iterations: int) -> float:
+    from alluxio_tpu.utils.tracing import set_tracing_enabled, tracer
+
+    set_tracing_enabled(True)
+    t = tracer()
+    t.clear()
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        with t.span("bench.noop"):
+            pass
+    elapsed = time.perf_counter() - t0
+    t.clear()
+    set_tracing_enabled(False)
+    return iterations / elapsed if elapsed > 0 else 0.0
+
+
+def run(*, file_mb: int = 4, reads: int = 60, batches: int = 5,
+        span_iterations: int = 100_000,
+        max_overhead_pct: float = 2.0) -> BenchResult:
+    import tempfile
+
+    from alluxio_tpu.minicluster.local_cluster import LocalCluster
+    from alluxio_tpu.utils.tracing import set_tracing_enabled, tracer
+
+    t_start = time.monotonic()
+    spans_per_s = _span_throughput(span_iterations)
+    off_batches, on_batches = [], []
+    with tempfile.TemporaryDirectory(prefix="atpu-obs-") as base:
+        with LocalCluster(base, num_workers=1,
+                          worker_mem_bytes=4 * (file_mb << 20)) as c:
+            fs = c.file_system()
+            path = "/obs-bench.bin"
+            fs.write_all(path, b"\xab" * (file_mb << 20))
+            _median_read_s(fs, path, reads)  # warm: cache + codepaths
+            # alternate off/on batches: the container's per-core speed
+            # drifts mid-run, and a sequential A-then-B layout folds
+            # that drift straight into the delta
+            for _ in range(batches):
+                set_tracing_enabled(False)
+                off_batches.append(_median_read_s(fs, path, reads))
+                set_tracing_enabled(True)
+                on_batches.append(_median_read_s(fs, path, reads))
+                tracer().clear()  # bound ring memory between batches
+            set_tracing_enabled(False)
+    lat_off_s = statistics.median(off_batches)
+    lat_on_s = statistics.median(on_batches)
+    overhead_pct = (100.0 * (lat_on_s - lat_off_s) / lat_off_s) \
+        if lat_off_s > 0 else 0.0
+    ok = overhead_pct <= max_overhead_pct
+    if not ok:
+        print(f"[obs] tracing overhead {overhead_pct:.2f}% exceeds the "
+              f"{max_overhead_pct}% budget", file=sys.stderr)
+    return BenchResult(
+        bench="obs-tracing-overhead",
+        params={"file_mb": file_mb, "reads_per_batch": reads,
+                "batches": batches, "span_iterations": span_iterations,
+                "max_overhead_pct": max_overhead_pct},
+        metrics={"spans_per_s": round(spans_per_s, 1),
+                 "read_p50_off_ms": round(lat_off_s * 1e3, 4),
+                 "read_p50_on_ms": round(lat_on_s * 1e3, 4),
+                 "overhead_pct": round(overhead_pct, 3),
+                 "overhead_ok": ok},
+        errors=0 if ok else 1,
+        duration_s=time.monotonic() - t_start)

@@ -286,6 +286,7 @@ def run_clairvoyant(*, num_workers: int = 1, num_files: int = 4,
                         svc.wait_ready(min(lookahead_blocks,
                                            len(loader)), timeout_s=60.0)
             finally:
+                stall = loader.stall_report()  # input doctor, pre-close
                 loader.close()
                 svc.close()
             stats = svc.stats()
@@ -294,6 +295,12 @@ def run_clairvoyant(*, num_workers: int = 1, num_files: int = 4,
             late = stats["late"] - base_stats["late"]
             misses = stats["misses"] - base_stats["misses"]
             consumed = hits + late + misses
+            stall_metrics = {
+                f"stall_{b}_s": v["wait_s"]
+                for b, v in stall["buckets"].items()}
+            stall_metrics["input_bound_fraction"] = \
+                stall["input_bound_fraction"]
+            stall_metrics["stall_verdict"] = stall["verdict"]
             return BenchResult(
                 bench="clairvoyant-prefetch",
                 params={"num_workers": num_workers,
@@ -314,5 +321,6 @@ def run_clairvoyant(*, num_workers: int = 1, num_files: int = 4,
                              ready.percentile(99) * 1e3, 3),
                          "gb_per_s": round(
                              consumed_bytes / wall / 1e9, 3),
-                         "blocks_per_epoch": len(loader)},
+                         "blocks_per_epoch": len(loader),
+                         **stall_metrics},
                 errors=misses, duration_s=wall)

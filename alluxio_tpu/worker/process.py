@@ -93,8 +93,10 @@ def build_store_from_conf(conf: Configuration) -> TieredBlockStore:
 
 
 class _MetricsReporter:
-    """Ships this worker's metric snapshot to the master each tick for
-    cluster aggregation (reference: worker side of metric_master.proto)."""
+    """Ships this worker's metric snapshot — plus any completed trace
+    spans drained from the local ring — to the master each tick for
+    cluster aggregation and trace stitching (reference: worker side of
+    metric_master.proto)."""
 
     def __init__(self, meta_client, source: str) -> None:
         self._client = meta_client
@@ -102,11 +104,16 @@ class _MetricsReporter:
 
     def heartbeat(self) -> None:
         from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils.tracing import tracer
 
+        spans = tracer().drain(500) if tracer().enabled else []
         try:
             self._client.metrics_heartbeat(self._source,
-                                           metrics().snapshot())
+                                           metrics().snapshot(),
+                                           spans=spans)
         except Exception:  # noqa: BLE001 master transition: retry next tick
+            # spans riding this tick are dropped — tracing is telemetry,
+            # re-queueing could double-ship on a late-delivered RPC
             LOG.debug("metrics heartbeat failed", exc_info=True)
 
     def close(self) -> None:
@@ -160,9 +167,12 @@ class BlockWorker:
         """Register then start heartbeats
         (reference: ``DefaultBlockWorker.start:197-242``)."""
         from alluxio_tpu.utils.pause_monitor import ensure_process_monitor
-        from alluxio_tpu.utils.tracing import set_tracing_enabled
+        from alluxio_tpu.utils.tracing import (
+            apply_trace_conf, set_tracing_enabled,
+        )
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
+        apply_trace_conf(self._conf)
         ensure_process_monitor()
         self._master_sync.register_with_master()
         if self._meta_client is not None:

@@ -250,6 +250,9 @@ class TieredBlockStore:
             raise
         self.annotator.on_access(block_id)
         self._m.counter("Worker.BlocksAccessed").inc()
+        # per-tier access split: the input doctor's worker-side view of
+        # which tier actually serves reads (MEM on /dev/shm ~= host DRAM)
+        self._m.counter(f"Worker.BlocksAccessed.{meta.tier_alias}").inc()
         return reader
 
     def pin_block(self, block_id: int) -> Optional[BlockLock]:

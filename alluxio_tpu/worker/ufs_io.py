@@ -48,7 +48,15 @@ class UfsBlockReader:
                    cache: bool = True, tier_alias: str = "") -> bytes:
         """Fetch the whole block (the TPU read path wants whole pages into
         a staging buffer, not tiny chunks)."""
-        data = ufs.read_range(desc.ufs_path, desc.offset, desc.length)
+        from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils.tracing import tracer
+
+        with tracer().span("atpu.worker.ufs_read",
+                           block_id=desc.block_id, bytes=desc.length):
+            data = ufs.read_range(desc.ufs_path, desc.offset, desc.length)
+        m = metrics()
+        m.counter("Worker.UfsBlocksRead").inc()
+        m.counter("Worker.UfsBytesRead").inc(len(data))
         if cache:
             self.cache_block(desc.block_id, data, tier_alias)
         return data

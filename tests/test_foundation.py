@@ -259,7 +259,9 @@ class TestMetrics:
         r = MetricsRegistry("Master")
         r.counter("FilesCreated").inc()
         text = r.to_prometheus()
-        assert "Master_FilesCreated 1" in text
+        # exposition format: TYPE preamble + counter _total suffix
+        assert "# TYPE Master_FilesCreated_total counter" in text
+        assert "Master_FilesCreated_total 1" in text
 
 
 class TestHeartbeat:

@@ -4,6 +4,9 @@ read-only HTTP state endpoint (reference: ``metrics/sink/*Sink.java``,
 
 import json
 import logging
+import re
+import threading
+import time
 import urllib.request
 
 import pytest
@@ -410,3 +413,419 @@ class TestWorkerDashboard:
         assert code == 200
         assert b"<!doctype html>" in body
         assert b"/api/v1/worker" in body
+
+
+class TestTraceparent:
+    """W3C-style trace-context propagation primitives."""
+
+    def test_parse_inject_roundtrip(self):
+        from alluxio_tpu.utils import tracing as T
+
+        ctx = T.TraceContext(T.new_trace_id(), T.new_span_id(), True)
+        back = T.parse_traceparent(T.format_traceparent(ctx))
+        assert back == ctx
+        unsampled = ctx._replace(sampled=False)
+        assert T.parse_traceparent(
+            T.format_traceparent(unsampled)) == unsampled
+
+    def test_parse_rejects_malformed(self):
+        from alluxio_tpu.utils import tracing as T
+
+        good = f"00-{'a' * 32}-{'b' * 16}-01"
+        assert T.parse_traceparent(good) is not None
+        for bad in (None, "", "garbage",
+                    f"ff-{'a' * 32}-{'b' * 16}-01",   # reserved version
+                    f"00-{'0' * 32}-{'b' * 16}-01",   # all-zero trace
+                    f"00-{'a' * 32}-{'0' * 16}-01",   # all-zero span
+                    f"00-{'a' * 31}-{'b' * 16}-01",   # short trace id
+                    f"00-{'a' * 32}-{'b' * 16}"):     # missing flags
+            assert T.parse_traceparent(bad) is None, bad
+
+    def test_span_joins_remote_parent(self):
+        from alluxio_tpu.utils import tracing as T
+
+        T.set_tracing_enabled(True)
+        try:
+            t = T.tracer()
+            t.clear()
+            parent = T.TraceContext(T.new_trace_id(), T.new_span_id(),
+                                    True)
+            token = T.bind_remote_parent(T.format_traceparent(parent))
+            try:
+                with t.span("server.handler") as s:
+                    assert s.trace_id == parent.trace_id
+                    assert s.parent == parent.span_id
+                    # the context an outbound call would inject
+                    inner = T.parse_traceparent(T.current_traceparent())
+                    assert inner.trace_id == parent.trace_id
+                    assert inner.span_id == s.span_id
+            finally:
+                T.reset_remote_parent(token)
+            # outside the binding a new span is a fresh root
+            with t.span("root") as r:
+                assert r.parent is None
+                assert r.trace_id != parent.trace_id
+        finally:
+            T.set_tracing_enabled(False)
+
+    def test_sample_rate_zero_drops_roots_but_propagates(self):
+        from alluxio_tpu.utils import tracing as T
+
+        T.set_tracing_enabled(True)
+        t = T.tracer()
+        try:
+            t.clear()
+            t.configure(sample_rate=0.0)
+            with t.span("unsampled.root") as s:
+                assert s is not None and not s.sampled
+                # context still propagates (flags=00) so downstream
+                # spans inherit the drop decision instead of tearing
+                assert T.current_traceparent().endswith("-00")
+                with t.span("unsampled.child") as c:
+                    assert not c.sampled
+            assert t.snapshot() == []
+        finally:
+            t.configure(sample_rate=1.0)
+            T.set_tracing_enabled(False)
+
+    def test_drain_and_store_dedupe(self):
+        from alluxio_tpu.master.metrics_master import MetricsMaster
+        from alluxio_tpu.utils import tracing as T
+
+        T.set_tracing_enabled(True)
+        t = T.tracer()
+        try:
+            t.clear()
+            with t.span("shipped.op"):
+                pass
+            batch = t.drain(10)
+            assert [s["name"] for s in batch] == ["shipped.op"]
+            assert t.snapshot() == []  # drained off the ring
+            mm = MetricsMaster()
+            mm.handle_heartbeat({"source": "worker-1", "metrics": {},
+                                 "spans": batch})
+            # re-delivery (retried heartbeat) must not duplicate
+            mm.handle_heartbeat({"source": "worker-1", "metrics": {},
+                                 "spans": batch})
+            stitched = T.stitch_spans(mm.traces)
+            shipped = [s for s in stitched["spans"]
+                       if s["name"] == "shipped.op"]
+            assert len(shipped) == 1
+            assert shipped[0]["source"] == "worker-1"
+        finally:
+            t.clear()
+            T.set_tracing_enabled(False)
+
+
+class TestTracePropagation:
+    def test_minicluster_read_yields_one_stitched_trace(self, cluster):
+        """A read through the minicluster produces a SINGLE trace at
+        /api/v1/master/trace: one trace_id, client + worker spans with
+        parent links (the acceptance criterion for cross-process
+        stitching — in-process the RPC still crosses real gRPC metadata
+        and thread boundaries)."""
+        from alluxio_tpu.utils.tracing import (
+            set_tracing_enabled, tracer,
+        )
+
+        fs = cluster.file_system()
+        fs.write_all("/traceprop/x", b"q" * 8192)
+        set_tracing_enabled(True)
+        try:
+            tracer().clear()
+            with tracer().span("client.read-step") as root:
+                data = fs.read_all("/traceprop/x")
+            assert len(data) == 8192
+            trace_id = root.trace_id
+            code, body = _get(
+                cluster,
+                f"/api/v1/master/trace?trace_id={trace_id}")
+            assert code == 200
+            view = json.loads(body)
+            spans = view["spans"]
+            assert spans and all(s["trace_id"] == trace_id
+                                 for s in spans)
+            by_id = {s["span_id"]: s for s in spans}
+            names = {s["name"] for s in spans}
+            assert "client.read-step" in names
+            worker_spans = [s for s in spans
+                            if s["name"].startswith("atpu.BlockWorker.")]
+            assert worker_spans, names
+            # parent links: every non-root span's parent is in-trace
+            for s in spans:
+                if s["parent"] is not None:
+                    assert s["parent"] in by_id, s
+            (summary,) = [t for t in view["traces"]
+                          if t["trace_id"] == trace_id]
+            assert summary["spans"] >= 2
+            assert summary["root"] == "client.read-step"
+        finally:
+            set_tracing_enabled(False)
+
+
+class TestStallAttribution:
+    def test_step_stats_bucket_accounting(self):
+        from alluxio_tpu.client.jax_io import StepStats
+
+        st = StepStats(window=16)
+        st.record("ufs", 0.8, 1 << 20, 1.0)
+        st.record("shm", 0.1, 1 << 20, 0.5)
+        st.record("not-a-tier", 0.1, 64, 0.2)  # folds into unknown
+        rep = st.report()
+        assert rep["ranked"][0] == "ufs"
+        assert rep["buckets"]["ufs"]["count"] == 1
+        assert rep["buckets"]["unknown"]["count"] == 1
+        assert abs(rep["total_wait_s"] - 1.0) < 1e-9
+        assert abs(rep["buckets"]["ufs"]["share"] - 0.8) < 1e-9
+        # window: 1.0s waited of 1.7s elapsed (report rounds to 4dp)
+        assert abs(rep["input_bound_fraction"] - 1.0 / 1.7) < 1e-3
+        assert "ufs" in rep["verdict"]
+
+    def test_loader_attributes_waits_to_named_tiers(self, cluster):
+        """An epoch through the real loader attributes >=95% of its wait
+        time (and every block) to a NAMED tier bucket."""
+        pytest.importorskip("jax")
+        from alluxio_tpu.client.jax_io import DeviceBlockLoader
+
+        fs = cluster.file_system()
+        paths = []
+        for i in range(2):
+            p = f"/stall/f-{i}"
+            fs.write_all(p, bytes([i]) * (2 << 20))  # 2 blocks each
+            paths.append(p)
+        loader = DeviceBlockLoader(fs, paths, prefetch=1)
+        try:
+            blocks = sum(1 for _ in loader.epoch())
+            assert blocks == len(loader) == 4
+            rep = loader.stall_report()
+            counted = sum(b["count"] for b in rep["buckets"].values())
+            assert counted == blocks
+            named = sum(v["wait_s"] for b, v in rep["buckets"].items()
+                        if b != "unknown")
+            assert named >= 0.95 * rep["total_wait_s"]
+            # the minicluster worker is same-host: short-circuit mmap
+            assert rep["buckets"]["shm"]["count"] == blocks
+            # additive roll-up metrics exist for the stall report
+            from alluxio_tpu.metrics import metrics
+
+            snap = metrics().snapshot()
+            assert snap.get("Client.InputStallCount.shm", 0) >= blocks
+        finally:
+            loader.close()
+        # a closed loader stops feeding the process-level gauge — its
+        # frozen fraction must not shadow future loaders
+        from alluxio_tpu.metrics import metrics as _m
+
+        assert _m().snapshot().get("Client.InputBoundFraction") == 0.0
+
+    def test_fsadmin_report_stall(self, cluster):
+        import io
+
+        from alluxio_tpu.client.jax_io import StepStats
+        from alluxio_tpu.shell.command import ShellContext
+        from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+
+        # seed stall metrics in-process (the master serves its own
+        # Client.* metrics when no remote clients report)
+        st = StepStats()
+        st.record("ufs", 0.75, 4 << 20, 1.0)
+        st.record("shm", 0.05, 4 << 20, 0.3)
+        conf = cluster.conf.copy()
+        conf.set(Keys.MASTER_HOSTNAME, "localhost")
+        conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+        out = io.StringIO()
+        assert ADMIN_SHELL.run(["report", "stall"],
+                               ShellContext(conf, out=out)) == 0
+        text = out.getvalue()
+        assert "Input-stall attribution" in text
+        assert "ufs" in text and "shm" in text
+        assert "Verdict: top bottleneck is 'ufs'" in text
+        assert "clairvoyant prefetch" in text  # the ufs advice
+
+    def test_statuspage_has_input_doctor_section(self, cluster):
+        code, body = _get(cluster, "/")
+        assert code == 200
+        assert b"Input doctor" in body
+        assert b"InputStall" in body
+
+
+class TestPrometheusExposition:
+    _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+    def _validate(self, text):
+        """Minimal exposition-format validator: TYPE before samples,
+        legal names, histogram bucket consistency."""
+        types = {}
+        samples = []
+        for line in text.strip().splitlines():
+            if line.startswith("# HELP"):
+                continue
+            if line.startswith("# TYPE"):
+                _, _, name, kind = line.split(None, 3)
+                assert name not in types, f"duplicate TYPE for {name}"
+                types[name] = kind
+                continue
+            name, _, value = line.partition(" ")
+            base = name.partition("{")[0]
+            assert self._NAME_RE.match(base), base
+            float(value)  # every sample parses as a number
+            samples.append((name, float(value)))
+        by_name = dict(samples)
+        for name, value in samples:
+            base = name.partition("{")[0]
+            family = base
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and \
+                        base[:-len(suffix)] in types:
+                    family = base[:-len(suffix)]
+            assert family in types, f"sample {name} has no TYPE"
+            if types[family] == "counter":
+                assert family.endswith("_total"), family
+        # histogram consistency: buckets cumulative, +Inf == _count
+        for family, kind in types.items():
+            if kind != "histogram":
+                continue
+            buckets = [(n, v) for n, v in samples
+                       if n.startswith(family + "_bucket")]
+            assert buckets, family
+            values = [v for _, v in buckets]
+            assert values == sorted(values), f"{family} not cumulative"
+            inf = next(v for n, v in buckets if 'le="+Inf"' in n)
+            assert inf == by_name[family + "_count"]
+        return types
+
+    def test_registry_output_is_compliant(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry
+
+        r = MetricsRegistry("Master")
+        r.counter("Master.FilesCreated").inc(5)
+        r.counter("Master.Weird-name.4xx").inc()
+        r.meter("Master.OpsRate").mark(7)
+        r.register_gauge("Master.UsedPct", lambda: 0.42)
+        t = r.timer("Master.rpc.get_status")
+        for v in (0.001, 0.004, 0.03, 0.2, 1.4, 7.0, 30.0):
+            t.update(v)
+        types = self._validate(r.to_prometheus())
+        assert types["Master_FilesCreated_total"] == "counter"
+        assert types["Master_OpsRate_total"] == "counter"
+        assert types["Master_UsedPct"] == "gauge"
+        assert types["Master_rpc_get_status_seconds"] == "histogram"
+
+    def test_leading_digit_sanitized(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry
+
+        r = MetricsRegistry("9fleet")
+        r.counter("9fleet.reads").inc()
+        types = self._validate(r.to_prometheus())
+        assert "_9fleet_9fleet_reads_total" in types
+
+    def test_timer_snapshot_not_torn_under_update(self):
+        """Regression: snapshot() used to read _total_s and _count in
+        separate unlocked steps — a concurrent update() between them
+        skewed the mean. With constant samples the mean must be exact."""
+        import threading as th
+
+        from alluxio_tpu.metrics.registry import Timer
+
+        t = Timer()
+        stop = th.Event()
+
+        def hammer():
+            while not stop.is_set():
+                t.update(1.0)
+
+        workers = [th.Thread(target=hammer) for _ in range(2)]
+        for w in workers:
+            w.start()
+        try:
+            deadline = time.monotonic() + 0.3
+            while time.monotonic() < deadline:
+                snap = t.snapshot()
+                if snap["count"]:
+                    assert snap["mean"] == 1.0, snap
+        finally:
+            stop.set()
+            for w in workers:
+                w.join()
+
+    def test_histogram_is_lifetime_cumulative(self):
+        """Buckets must never decrease across scrapes: a reservoir-
+        windowed histogram reads as a counter reset to PromQL."""
+        from alluxio_tpu.metrics.registry import Timer
+
+        t = Timer(reservoir=8)
+        for _ in range(100):
+            t.update(0.002)
+        counts, total, n = t.histogram()
+        assert n == 100 and counts[-1] == 100  # not the 8-slot window
+        assert counts[0] == 100  # all <= 0.005
+        assert abs(total - 0.2) < 1e-9
+        t.update(100.0)  # beyond the largest bound
+        counts2, _, n2 = t.histogram()
+        assert n2 == 101 and counts2[-1] == 101
+        assert all(b >= a for a, b in zip(counts, counts2))
+
+    def test_input_bound_fraction_averaged_into_cluster(self):
+        from alluxio_tpu.master.metrics_master import MetricsStore
+
+        store = MetricsStore()
+        for i in range(4):
+            store.report(f"client-{i}",
+                         {"Client.InputBoundFraction": 0.8,
+                          "Client.InputStallUs.ufs": 1000})
+        agg = store.cluster_metrics()
+        # fractions average across sources — never an impossible 3.2
+        assert abs(agg["Cluster.InputBoundFraction"] - 0.8) < 1e-9
+        assert agg["Cluster.InputStallUs.ufs"] == 4000
+
+    def test_cluster_aggregator_is_gone(self):
+        """The duplicate aggregator was deleted; MetricsStore in
+        master/metrics_master.py is the one implementation."""
+        import alluxio_tpu.metrics as m
+        import alluxio_tpu.metrics.registry as reg
+
+        assert not hasattr(m, "ClusterAggregator")
+        assert not hasattr(reg, "ClusterAggregator")
+
+
+class TestGraphiteOffHeartbeat:
+    def test_report_never_blocks_on_dead_host(self, monkeypatch,
+                                              registry):
+        """report() must only enqueue: a carbon host that hangs in
+        connect() stalls the SENDER thread, not the shared sink
+        heartbeat."""
+        import socket as socket_mod
+
+        from alluxio_tpu.metrics.sinks import GraphiteSink
+
+        started = threading.Event()
+        release = threading.Event()
+
+        def stuck_connect(*a, **k):
+            started.set()
+            release.wait(5.0)
+            raise OSError("dead carbon host")
+
+        monkeypatch.setattr(socket_mod, "create_connection",
+                            stuck_connect)
+        sink = GraphiteSink("203.0.113.9", 2003, timeout_s=0.2)
+        try:
+            t0 = time.monotonic()
+            for _ in range(3):
+                sink.report(registry.snapshot())
+            assert time.monotonic() - t0 < 0.5  # no network on caller
+            assert started.wait(2.0)  # the sender thread took the hit
+        finally:
+            release.set()
+            sink.close()
+
+    def test_manager_passes_configured_timeout(self, conf, registry):
+        from alluxio_tpu.metrics.sinks import SinkManager
+
+        conf.set(Keys.METRICS_SINKS, "graphite")
+        conf.set(Keys.METRICS_SINK_GRAPHITE_ADDRESS, "carbon:2003")
+        conf.set(Keys.METRICS_SINK_GRAPHITE_TIMEOUT, "700ms")
+        mgr = SinkManager(conf, registry)
+        assert len(mgr.sinks) == 1
+        assert abs(mgr.sinks[0]._timeout_s - 0.7) < 1e-9
+        mgr.close()

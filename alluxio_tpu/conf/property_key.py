@@ -564,6 +564,39 @@ class Keys:
                         description="Backing dir for the MEM tier; files here are "
                                     "mmap-able by same-host clients for the "
                                     "short-circuit zero-copy read path.")
+    WORKER_UFS_FETCH_STRIPE_SIZE = _k(
+        "atpu.worker.ufs.fetch.stripe.size", KeyType.BYTES, default="4MB",
+        scope=Scope.WORKER,
+        description="Stripe size for striped parallel cold UFS block "
+                    "fetches; also the streaming read-through's "
+                    "time-to-first-byte unit (a waiter gets its first "
+                    "chunk after one stripe lands, not the whole block).")
+    WORKER_UFS_FETCH_CONCURRENCY = _k(
+        "atpu.worker.ufs.fetch.concurrency", KeyType.INT, default=4,
+        scope=Scope.WORKER,
+        description="Stripes of one block fetched concurrently. "
+                    "Effective parallelism is also bounded by "
+                    "atpu.worker.ufs.fetch.per.mount.limit.")
+    WORKER_UFS_FETCH_PER_MOUNT_LIMIT = _k(
+        "atpu.worker.ufs.fetch.per.mount.limit", KeyType.INT, default=16,
+        scope=Scope.WORKER,
+        description="Concurrent UFS stripe reads per mount across ALL "
+                    "in-flight block fetches — the worker's connection "
+                    "budget against one backing store.")
+    WORKER_ASYNC_CACHE_QUEUE_MAX = _k(
+        "atpu.worker.async.cache.queue.max", KeyType.INT, default=512,
+        scope=Scope.WORKER,
+        description="Pending passive-cache requests held before new "
+                    "submissions are rejected (counted in "
+                    "Worker.AsyncCacheRejected). Passive caching is "
+                    "advisory; an unbounded backlog only delays it "
+                    "past usefulness.")
+    WORKER_ASYNC_CACHE_THREADS = _k(
+        "atpu.worker.async.cache.threads", KeyType.INT, default=2,
+        scope=Scope.WORKER,
+        description="Worker threads draining the passive-cache queue "
+                    "(reference: alluxio.worker.network.async.cache."
+                    "manager.threads.max).")
 
     # --- client / user ---
     USER_FILE_WRITE_TYPE_DEFAULT = _k(

@@ -75,7 +75,9 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
         block_id = req["block_id"]
         offset = req.get("offset", 0)
         length = req.get("length", -1)
-        chunk = req.get("chunk_size", DEFAULT_CHUNK)
+        # clamp: chunk_size<=0 from a buggy client would spin the
+        # cached-tier loop forever without advancing pos
+        chunk = max(1, req.get("chunk_size", DEFAULT_CHUNK))
         m = metrics()
         if worker.store.has_block(block_id):
             with worker.open_reader(block_id) as r:
@@ -99,17 +101,29 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
             block_id=block_id, ufs_path=ufs["ufs_path"],
             offset=ufs["offset"], length=ufs["length"],
             mount_id=ufs.get("mount_id", 0))
-        data = worker.read_ufs_block(desc, cache=req.get("cache", True))
+        # streaming read-through: chunks go out as stripes land, so the
+        # client's first byte costs one stripe, not the whole block; the
+        # tiered-store fill proceeds in parallel inside the fetch
+        fetch = worker.open_ufs_fetch(desc, cache=req.get("cache", True))
         m.counter("Worker.BlocksServed.UFS").inc()
         served = m.counter("Worker.BytesServed.UFS")
-        end = len(data) if length < 0 else min(len(data), offset + length)
+        end = desc.length if length < 0 else min(desc.length,
+                                                 offset + length)
         pos = offset
-        while pos < end:
-            n = min(chunk, end - pos)
-            yield {"data": data[pos:pos + n], "offset": pos,
-                   "source": "UFS"}
-            served.inc(n)
-            pos += n
+        for data in fetch.iter_range(offset, max(0, end - offset),
+                                     chunk_size=chunk):
+            yield {"data": data, "offset": pos, "source": "UFS"}
+            served.inc(len(data))
+            pos += len(data)
+        # the cache-fill commit trails the last stripe; close the
+        # stream only once it lands so "read completed" keeps implying
+        # "block cached" for clients and heartbeats (seed semantics).
+        # A fetch that FAILED after serving this sub-range fails the
+        # stream too (the old whole-block path failed such reads); a
+        # slow commit alone (timeout, error is None) stays best-effort
+        if not fetch.wait_done(30.0) and fetch.error is not None:
+            raise fetch.error if isinstance(fetch.error, Exception) \
+                else IOError(str(fetch.error))
 
     svc.stream_out("read_block", read_block)
 

@@ -95,6 +95,24 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--max-overhead-pct", type=float, default=2.0,
                     help="fail the bench above this tracing overhead")
 
+    uc = sub.add_parser("ufscold", help="striped vs single-stream cold "
+                                        "UFS reads (connection-limited "
+                                        "UFS model)")
+    uc.add_argument("--block-mb", type=int, default=2)
+    uc.add_argument("--stripe-kb", type=int, default=512)
+    uc.add_argument("--blocks-per-reader", type=int, default=3)
+    uc.add_argument("--rtt-ms", type=float, default=25.0,
+                    help="modeled per-connection round trip; must dwarf "
+                         "the host's thread-wake jitter")
+    uc.add_argument("--conn-mbps", type=float, default=4.0,
+                    help="modeled per-connection UFS bandwidth")
+    uc.add_argument("--concurrency", type=int, default=4,
+                    help="stripes in flight per block")
+    uc.add_argument("--per-mount-limit", type=int, default=64)
+    uc.add_argument("--min-speedup", type=float, default=1.5,
+                    help="fail below this striped/single throughput "
+                         "ratio at 4 concurrent readers")
+
     sub.add_parser("suite", help="run the whole BASELINE config family")
     rp = sub.add_parser("report",
                         help="render suite JSON to a single-file HTML "
@@ -135,6 +153,7 @@ SUITE = (
     ("table-projection", ["table"]),
     ("write-eviction", ["write"]),
     ("obs-tracing-overhead", ["obs"]),
+    ("ufs-cold-read", ["ufscold"]),
 )
 
 
@@ -297,6 +316,15 @@ def main(argv=None) -> int:
                 batches=args.batches,
                 span_iterations=args.span_iterations,
                 max_overhead_pct=args.max_overhead_pct)
+    elif args.bench == "ufscold":
+        from alluxio_tpu.stress.ufs_cold_bench import run
+
+        r = run(block_mb=args.block_mb, stripe_kb=args.stripe_kb,
+                blocks_per_reader=args.blocks_per_reader,
+                rtt_ms=args.rtt_ms, conn_mbps=args.conn_mbps,
+                concurrency=args.concurrency,
+                per_mount_limit=args.per_mount_limit,
+                min_speedup=args.min_speedup)
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1

@@ -5,4 +5,7 @@ from alluxio_tpu.worker.meta import (  # noqa: F401
 )
 from alluxio_tpu.worker.process import BlockWorker, build_store_from_conf  # noqa: F401
 from alluxio_tpu.worker.tiered_store import TieredBlockStore  # noqa: F401
+from alluxio_tpu.worker.ufs_fetch import (  # noqa: F401
+    BlockFetch, FetchConf, UfsBlockFetcher,
+)
 from alluxio_tpu.worker.ufs_io import UfsBlockDescriptor  # noqa: F401

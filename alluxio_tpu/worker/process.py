@@ -28,9 +28,10 @@ from alluxio_tpu.worker.master_sync import (
 from alluxio_tpu.worker.management import ManagementTaskCoordinator
 from alluxio_tpu.worker.meta import BlockMetadataManager
 from alluxio_tpu.worker.tiered_store import BlockReader, TieredBlockStore
-from alluxio_tpu.worker.ufs_io import (
-    AsyncCacheManager, UfsBlockDescriptor, UfsBlockReader,
+from alluxio_tpu.worker.ufs_fetch import (
+    BlockFetch, FetchConf, UfsBlockFetcher,
 )
+from alluxio_tpu.worker.ufs_io import AsyncCacheManager, UfsBlockDescriptor
 
 LOG = logging.getLogger(__name__)
 
@@ -150,11 +151,15 @@ class BlockWorker:
             promote=conf.get_bool(Keys.WORKER_MANAGEMENT_TIER_PROMOTE_ENABLED),
             quota_percent=conf.get_int(
                 Keys.WORKER_MANAGEMENT_PROMOTE_QUOTA_PERCENT))
-        self._ufs_reader = UfsBlockReader(self.store)
+        self.ufs_fetcher = UfsBlockFetcher(self.store,
+                                           FetchConf.from_conf(conf))
         self.web_server = None
         self.web_port: Optional[int] = None
         self.async_cache = AsyncCacheManager(
-            self.store, lambda mount_id: self.ufs_manager.get(mount_id))
+            self.store, lambda mount_id: self.ufs_manager.get(mount_id),
+            num_threads=conf.get_int(Keys.WORKER_ASYNC_CACHE_THREADS),
+            queue_max=conf.get_int(Keys.WORKER_ASYNC_CACHE_QUEUE_MAX),
+            fetcher=self.ufs_fetcher)
         self._threads: List[HeartbeatThread] = []
         self._started = False
 
@@ -238,6 +243,7 @@ class BlockWorker:
             self.web_server.stop()
             self.web_server = None
         self.async_cache.close()
+        self.ufs_fetcher.close()
 
     # -- data-plane API (called by the data server / local clients) --------
     def create_block(self, session_id: int, block_id: int, *,
@@ -296,11 +302,21 @@ class BlockWorker:
             raise BlockDoesNotExistError(f"block {block_id} not cached")
         return LocalBlockLease(meta.path, meta.length, lock)
 
+    def open_ufs_fetch(self, desc: UfsBlockDescriptor, *,
+                       cache: bool = True) -> BlockFetch:
+        """Start (or join) the striped cold fetch of a block; the
+        returned handle streams chunks as stripes land — the data
+        server serves from it while the tiered store fills in
+        parallel."""
+        ufs = self.ufs_manager.get(desc.mount_id)
+        return self.ufs_fetcher.fetch(ufs, desc, cache=cache)
+
     def read_ufs_block(self, desc: UfsBlockDescriptor, *,
                        cache: bool = True) -> bytes:
-        """Cold read-through (reference: UnderFileSystemBlockReader)."""
-        ufs = self.ufs_manager.get(desc.mount_id)
-        return self._ufs_reader.read_block(ufs, desc, cache=cache)
+        """Cold read-through, whole block at once (reference:
+        UnderFileSystemBlockReader). Rides the same striped/coalesced
+        pipeline as :meth:`open_ufs_fetch`."""
+        return self.open_ufs_fetch(desc, cache=cache).result()
 
     def persist_file(self, ufs_path: str, block_ids: List[int],
                      mount_id: int) -> str:

@@ -92,6 +92,55 @@ class BlockReader:
         return False
 
 
+class CacheFill:
+    """Incremental read-through fill: a temp block the UFS fetch
+    pipeline appends to as stripes land (in frontier order), committed
+    when the block completes. Best-effort like every cache fill: any
+    failure aborts the temp block and reports False — the fetch keeps
+    serving waiters from its own buffer."""
+
+    def __init__(self, store: "TieredBlockStore", session_id: int,
+                 block_id: int, writer: BlockWriter) -> None:
+        self._store = store
+        self._session = session_id
+        self._block_id = block_id
+        self._writer: Optional[BlockWriter] = writer
+
+    def append(self, data: bytes) -> bool:
+        if self._writer is None:
+            return False
+        try:
+            self._writer.append(data)
+            return True
+        except Exception:  # noqa: BLE001 - cache fill is best-effort
+            self.abort()
+            return False
+
+    def commit(self) -> bool:
+        if self._writer is None:
+            return False
+        try:
+            self._writer.close()
+            self._writer = None
+            self._store.commit_block(self._session, self._block_id)
+            return True
+        except Exception:  # noqa: BLE001
+            self.abort()
+            return False
+
+    def abort(self) -> None:
+        w, self._writer = self._writer, None
+        if w is not None:
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self._store.abort_block(self._session, self._block_id)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 class TieredBlockStore:
     def __init__(self, meta: BlockMetadataManager, allocator: Allocator,
                  annotator: BlockAnnotator,
@@ -296,6 +345,36 @@ class TieredBlockStore:
 
     def access_block(self, block_id: int) -> None:
         self.annotator.on_access(block_id)
+
+    def open_cache_fill(self, block_id: int, length: int,
+                        tier_alias: str = "") -> Optional[CacheFill]:
+        """Start an incremental read-through fill for a cold block the
+        fetch pipeline is streaming (reserves the full length up front
+        so per-stripe appends never allocate). None when the block
+        already exists, is being filled, or space cannot be found —
+        the fetch then serves without caching."""
+        from alluxio_tpu.utils import ids as id_utils
+
+        session = id_utils.create_session_id()
+        try:
+            self.create_block(session, block_id,
+                              initial_bytes=max(1, length),
+                              tier_alias=tier_alias)
+            return CacheFill(self, session, block_id,
+                             self.get_temp_writer(session, block_id))
+        except AlreadyExistsError:
+            return None
+        except Exception:  # noqa: BLE001 - cache fill is best-effort
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "cache fill for block %s failed to start", block_id,
+                exc_info=True)
+            try:
+                self.abort_block(session, block_id)
+            except Exception:  # noqa: BLE001
+                pass
+            return None
 
     # -- removal / movement -------------------------------------------------
     def remove_block(self, block_id: int, timeout: Optional[float] = 5.0) -> None:

@@ -39,7 +39,11 @@ class UfsBlockDescriptor:
 
 
 class UfsBlockReader:
-    """Read-through: serve from UFS while caching into the local store."""
+    """Single-range read-through: serve from UFS while caching into the
+    local store. This is the *unstriped* path — one blocking connection,
+    first byte after the last — kept as the striped pipeline's fallback
+    and as the bench baseline; the hot cold-read path is
+    ``ufs_fetch.UfsBlockFetcher``."""
 
     def __init__(self, store: TieredBlockStore) -> None:
         self._store = store
@@ -90,40 +94,85 @@ class AsyncCacheManager:
     """Executes passive-cache requests off the read path
     (reference: ``AsyncCacheRequestManager.java:88``). A client that read a
     block remotely (or straight from UFS) asks its local worker to cache it
-    in the background."""
+    in the background.
+
+    The queue is bounded (``atpu.worker.async.cache.queue.max``): a burst
+    of cache requests beyond it is *rejected* (counted in
+    ``Worker.AsyncCacheRejected``) instead of growing the backlog without
+    limit — passive caching is advisory, the client already has the bytes.
+    When a ``UfsBlockFetcher`` is wired in, cache fills ride the same
+    coalescing registry as foreground reads, so a background fill never
+    duplicates an in-flight foreground fetch of the same block."""
 
     def __init__(self, store: TieredBlockStore,
                  ufs_resolver: Callable[[int], UnderFileSystem],
-                 num_threads: int = 1) -> None:
+                 num_threads: int = 1, queue_max: int = 512,
+                 fetcher=None) -> None:
         self._store = store
         self._reader = UfsBlockReader(store)
         self._ufs_resolver = ufs_resolver
-        self._queue: "queue.Queue[Optional[UfsBlockDescriptor]]" = queue.Queue()
+        self._fetcher = fetcher  # Optional[ufs_fetch.UfsBlockFetcher]
+        self._queue: "queue.Queue[Optional[UfsBlockDescriptor]]" = \
+            queue.Queue(maxsize=max(1, queue_max))
         self._inflight: Dict[int, bool] = {}
         self._lock = threading.Lock()
+        self._closed = False
         self._threads = [threading.Thread(target=self._run, daemon=True,
                                           name=f"async-cache-{i}")
-                         for i in range(num_threads)]
+                         for i in range(max(1, num_threads))]
         for t in self._threads:
             t.start()
 
     def submit(self, desc: UfsBlockDescriptor) -> bool:
+        from alluxio_tpu.metrics import metrics
+
         with self._lock:
-            if desc.block_id in self._inflight or \
+            if self._closed or desc.block_id in self._inflight or \
                     self._store.has_block(desc.block_id):
                 return False
+            if self._fetcher is not None and \
+                    self._fetcher.caching_in_flight(desc.block_id):
+                # a foreground read-through is already CACHING this
+                # block (an in-flight cache=False fetch is not enough
+                # to stand down — joining it upgrades it instead)
+                return False
             self._inflight[desc.block_id] = True
-        self._queue.put(desc)
+        try:
+            self._queue.put_nowait(desc)
+        except queue.Full:
+            with self._lock:
+                self._inflight.pop(desc.block_id, None)
+            metrics().counter("Worker.AsyncCacheRejected").inc()
+            return False
         return True
 
     def _run(self) -> None:
         while True:
-            desc = self._queue.get()
-            if desc is None:
+            try:
+                desc = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if self._closed:
+                # shutdown drops the backlog: passive caching is
+                # advisory and must not delay worker stop
+                self._queue.task_done()
                 return
             try:
+                if self._store.has_block(desc.block_id):
+                    continue  # cached while queued
                 ufs = self._ufs_resolver(desc.mount_id)
-                self._reader.read_block(ufs, desc, cache=True)
+                if self._fetcher is not None:
+                    # coalesces with any concurrent fetch of this block;
+                    # joining a cache=False fetch upgrades it, and if
+                    # even that was too late, cache from the bytes
+                    data = self._fetcher.fetch(ufs, desc,
+                                               cache=True).result()
+                    if not self._store.has_block(desc.block_id):
+                        self._reader.cache_block(desc.block_id, data)
+                else:
+                    self._reader.read_block(ufs, desc, cache=True)
             except Exception:  # noqa: BLE001
                 LOG.debug("async cache of block %s failed", desc.block_id,
                           exc_info=True)
@@ -146,5 +195,9 @@ class AsyncCacheManager:
         return False
 
     def close(self) -> None:
-        for _ in self._threads:
-            self._queue.put(None)
+        # flag-based shutdown: workers poll the flag between short
+        # blocking gets, so no poison pills are needed — pills on a
+        # BOUNDED queue either deadlock (queue full) or corrupt the
+        # unfinished-task accounting wait_idle() relies on
+        with self._lock:
+            self._closed = True

@@ -1,0 +1,563 @@
+"""Striped/streaming/coalescing cold-read pipeline tests
+(``worker/ufs_fetch.py``):
+
+- stripe reassembly is byte-identical to a single-range read over odd
+  block/stripe size combinations (property-style sweep);
+- a waiter streams its first chunk before the block finishes, and a
+  second reader attaches to the pipeline mid-flight;
+- a UFS that rejects ranged reads demotes the fetch to one full-range
+  read (and the mount is remembered);
+- N concurrent cold readers of one block share exactly one UFS fetch;
+- the async cache manager is bounded (rejections counted) and dedupes
+  against in-flight foreground fetches.
+"""
+
+import random
+import threading
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.underfs.delegating import DelegatingUnderFileSystem
+from alluxio_tpu.underfs.local import LocalUnderFileSystem
+from alluxio_tpu.worker.process import build_store_from_conf
+from alluxio_tpu.worker.ufs_fetch import (
+    BlockFetch, FetchConf, FetchError, UfsBlockFetcher, plan_stripes,
+)
+from alluxio_tpu.worker.ufs_io import AsyncCacheManager, UfsBlockDescriptor
+
+KB = 1024
+
+
+class RecordingUfs(DelegatingUnderFileSystem):
+    """Counts every ranged read; optionally gates offsets behind events
+    or rejects sub-block ranges (an object store without range GETs)."""
+
+    def __init__(self, delegate, block_length=None):
+        super().__init__(delegate)
+        self.calls = []  # (offset, length)
+        self.lock = threading.Lock()
+        self.gates = {}  # offset -> threading.Event
+        self.gate_all = None  # Event gating every read when set
+        self.reject_ranged_below = None  # lengths < this raise
+        self.fail_all = False
+
+    def read_range(self, path, offset, length):
+        with self.lock:
+            self.calls.append((offset, length))
+        gate = self.gates.get(offset) or self.gate_all
+        if gate is not None:
+            assert gate.wait(20), "test gate never released"
+        if self.fail_all:
+            raise OSError("UFS down")
+        if self.reject_ranged_below is not None and \
+                length < self.reject_ranged_below:
+            raise OSError("ranged reads unsupported")
+        return super().read_range(path, offset, length)
+
+
+@pytest.fixture()
+def store(conf):
+    conf.set(Keys.WORKER_RAMDISK_SIZE, 64 << 20)
+    return build_store_from_conf(conf)
+
+
+@pytest.fixture()
+def ufs_dir(tmp_path):
+    d = tmp_path / "ufs"
+    d.mkdir()
+    return d
+
+
+def _write(ufs_dir, name, length, seed=0):
+    payload = random.Random(seed).randbytes(length)
+    (ufs_dir / name).write_bytes(payload)
+    return str(ufs_dir / name), payload
+
+
+def _counter(name):
+    return metrics().counter(name).count
+
+
+# --------------------------------------------------------------- reassembly
+@pytest.mark.parametrize("length,stripe", [
+    (1, 1), (5, 2), (1023, 100), (4097, 512), (8192, 8192),
+    (10_000, 3_333), (777, 1_000), (65_537, 4_096), (0, 64),
+])
+def test_stripe_reassembly_matches_single_range(store, ufs_dir,
+                                                length, stripe):
+    path, payload = _write(ufs_dir, f"obj-{length}-{stripe}", length,
+                           seed=length * 31 + stripe)
+    ufs = LocalUnderFileSystem(str(ufs_dir))
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=stripe, concurrency=3, per_mount_limit=4))
+    try:
+        bid = length * 100_003 + stripe
+        desc = UfsBlockDescriptor(block_id=bid, ufs_path=path,
+                                  offset=0, length=length)
+        assert fetcher.fetch(ufs, desc, cache=True).result() == payload
+        if length > 0:
+            # the parallel cache fill committed byte-identical content
+            with store.get_reader(bid) as r:
+                assert r.read(0, length) == payload
+        # odd sub-ranges stream back the same bytes a pread would give
+        rng = random.Random(7)
+        desc2 = UfsBlockDescriptor(block_id=bid + 1, ufs_path=path,
+                                   offset=0, length=length)
+        fetch = fetcher.fetch(ufs, desc2, cache=False)
+        for _ in range(4):
+            off = rng.randrange(0, length + 1) if length else 0
+            ln = rng.randrange(0, length - off + 1) if length else 0
+            got = b"".join(fetch.iter_range(off, ln, chunk_size=97))
+            assert got == payload[off:off + ln]
+    finally:
+        fetcher.close()
+
+
+def test_block_interior_offset(store, ufs_dir):
+    """A block that starts mid-file (non-zero UFS offset) stripes over
+    file coordinates but serves block-relative bytes."""
+    path, payload = _write(ufs_dir, "big", 10_000, seed=3)
+    ufs = LocalUnderFileSystem(str(ufs_dir))
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=700, concurrency=2, per_mount_limit=4))
+    try:
+        desc = UfsBlockDescriptor(block_id=42, ufs_path=path,
+                                  offset=1234, length=5000)
+        assert fetcher.fetch(ufs, desc, cache=False).result() == \
+            payload[1234:6234]
+    finally:
+        fetcher.close()
+
+
+# ---------------------------------------------------------------- streaming
+def test_first_chunk_streams_before_block_completes(store, ufs_dir):
+    path, payload = _write(ufs_dir, "gated", 400, seed=1)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    release = threading.Event()
+    for off in (100, 200, 300):  # stripe 0 flows; the rest are held
+        ufs.gates[off] = release
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=100, concurrency=1, per_mount_limit=2))
+    try:
+        desc = UfsBlockDescriptor(block_id=9, ufs_path=path,
+                                  offset=0, length=400)
+        fetch = fetcher.fetch(ufs, desc, cache=True)
+        it = fetch.iter_range(0, 400, chunk_size=100)
+        first = next(it)  # must arrive while stripes 1..3 are blocked
+        assert first == payload[:100]
+        assert not fetch.done
+
+        # a second cold reader attaches to the SAME in-flight fetch
+        coalesced0 = _counter("Worker.UfsFetchCoalesced")
+        again = fetcher.fetch(ufs, desc, cache=True)
+        assert again is fetch
+        assert fetch.waiters == 2
+        assert _counter("Worker.UfsFetchCoalesced") == coalesced0 + 1
+
+        out = [first]
+        got = {}
+
+        def drain_b():
+            got["b"] = b"".join(again.iter_range(0, 400, chunk_size=64))
+
+        tb = threading.Thread(target=drain_b)
+        tb.start()
+        release.set()
+        out.extend(it)
+        tb.join(10)
+        assert b"".join(out) == payload
+        assert got["b"] == payload
+        # each stripe was read from the UFS exactly once
+        assert sorted(o for o, _ in ufs.calls) == [0, 100, 200, 300]
+        assert fetch.wait_done(10)  # cache commit trails the last byte
+        assert store.has_block(9)
+    finally:
+        release.set()
+        fetcher.close()
+
+
+# ----------------------------------------------------------------- fallback
+def test_ranged_rejection_falls_back_to_single_range(store, ufs_dir):
+    path, payload = _write(ufs_dir, "noranged", 4_000, seed=2)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    ufs.reject_ranged_below = 4_000  # every sub-block range errors
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=1_000, concurrency=2, per_mount_limit=4))
+    try:
+        fb0 = _counter("Worker.UfsFetchFallbacks")
+        desc = UfsBlockDescriptor(block_id=11, ufs_path=path,
+                                  offset=0, length=4_000, mount_id=5)
+        fetch = fetcher.fetch(ufs, desc, cache=True)
+        assert fetch.result() == payload
+        assert fetch.fallback
+        assert _counter("Worker.UfsFetchFallbacks") == fb0 + 1
+        assert store.has_block(11)
+        # one full-range read happened, after >=1 failed stripe attempt
+        assert (0, 4_000) in ufs.calls
+        # the mount is remembered: the next fetch goes straight to a
+        # single whole-block read, no doomed striping attempt
+        ufs.calls.clear()
+        desc2 = UfsBlockDescriptor(block_id=12, ufs_path=path,
+                                   offset=0, length=4_000, mount_id=5)
+        assert fetcher.fetch(ufs, desc2, cache=False).result() == payload
+        assert ufs.calls == [(0, 4_000)]
+    finally:
+        fetcher.close()
+
+
+def test_total_failure_raises_for_every_waiter_then_retries(store, ufs_dir):
+    path, payload = _write(ufs_dir, "down", 2_000, seed=4)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    ufs.fail_all = True
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=500, concurrency=2, per_mount_limit=4))
+    try:
+        desc = UfsBlockDescriptor(block_id=13, ufs_path=path,
+                                  offset=0, length=2_000)
+        fetch = fetcher.fetch(ufs, desc, cache=True)
+        with pytest.raises(FetchError):
+            fetch.result()
+        with pytest.raises(FetchError):
+            b"".join(fetch.iter_range(0, 10))
+        assert not store.has_block(13)  # cache fill aborted, no temp leak
+        for _ in range(400):  # registry cleanup trails the error wake-up
+            if not fetcher.in_flight(13):
+                break
+            threading.Event().wait(0.01)
+        assert not fetcher.in_flight(13)  # registry cleaned for retries
+        ufs.fail_all = False
+        assert fetcher.fetch(ufs, desc, cache=True).result() == payload
+        assert store.has_block(13)
+    finally:
+        fetcher.close()
+
+
+# --------------------------------------------------------------- coalescing
+def test_concurrent_cold_readers_share_one_ufs_fetch(store, ufs_dir):
+    path, payload = _write(ufs_dir, "hot", 4_000, seed=5)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    release = threading.Event()
+    ufs.gate_all = release
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=1_000, concurrency=4, per_mount_limit=8))
+    try:
+        started0 = _counter("Worker.UfsFetchStarted")
+        coalesced0 = _counter("Worker.UfsFetchCoalesced")
+        desc = UfsBlockDescriptor(block_id=21, ufs_path=path,
+                                  offset=0, length=4_000)
+        first = fetcher.fetch(ufs, desc, cache=True)
+        results = []
+
+        def read():
+            results.append(fetcher.fetch(ufs, desc, cache=True).result())
+
+        threads = [threading.Thread(target=read) for _ in range(8)]
+        for t in threads:
+            t.start()
+        deadline = threading.Event()
+        for _ in range(400):  # all 8 must attach BEFORE any byte lands
+            if first.waiters == 9:
+                break
+            deadline.wait(0.01)
+        assert first.waiters == 9
+        release.set()
+        for t in threads:
+            t.join(10)
+        assert results == [payload] * 8
+        assert first.result() == payload
+        # exactly one UFS fetch: one read per stripe, no duplicates
+        assert sorted(o for o, _ in ufs.calls) == [0, 1_000, 2_000, 3_000]
+        assert _counter("Worker.UfsFetchStarted") == started0 + 1
+        assert _counter("Worker.UfsFetchCoalesced") == coalesced0 + 8
+        assert store.has_block(21)
+    finally:
+        release.set()
+        fetcher.close()
+
+
+def test_shrunk_ufs_object_serves_available_bytes(store, ufs_dir):
+    """Block metadata says 2000B but the UFS object shrank to 1500B:
+    legacy single-range semantics — serve and cache what exists, do not
+    fail every waiter, do not demote the mount."""
+    path, payload = _write(ufs_dir, "shrunk", 1_500, seed=11)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=500, concurrency=2, per_mount_limit=4))
+    try:
+        desc = UfsBlockDescriptor(block_id=70, ufs_path=path,
+                                  offset=0, length=2_000, mount_id=9)
+        fetch = fetcher.fetch(ufs, desc, cache=True)
+        assert fetch.result() == payload  # 1500B, not zero-padded
+        assert b"".join(fetch.iter_range(0, 2_000)) == payload
+        assert fetch.wait_done(10)
+        with store.get_reader(70) as r:
+            assert r.length == 1_500
+            assert r.read(0, 1_500) == payload
+        # stripes 0-1 succeeded, so this is not a range-rejecting
+        # mount: striping stays enabled for it
+        assert 9 not in fetcher._unstriped_mounts
+        # even when EVERY stripe lies past EOF (no stripe succeeds,
+        # truncated fallback does), a shrunk object is not the
+        # range-rejection signature and must not demote the mount
+        desc2 = UfsBlockDescriptor(block_id=72, ufs_path=path,
+                                   offset=1_400, length=2_000, mount_id=9)
+        fetch2 = fetcher.fetch(ufs, desc2, cache=False)
+        assert fetch2.result() == payload[1_400:]
+        assert not fetch2.any_stripe_ok and fetch2.fallback_ok
+        assert 9 not in fetcher._unstriped_mounts
+        assert fetch2.wait_done(10)
+        assert not store.has_block(72)  # cache=False stays uncached
+    finally:
+        fetcher.close()
+
+
+def test_transient_stripe_error_does_not_demote_mount(store, ufs_dir):
+    path, payload = _write(ufs_dir, "flaky", 2_000, seed=12)
+
+    class FlakyUfs(RecordingUfs):
+        trips = 0
+
+        def read_range(self, p, o, length):
+            # fail BOTH attempts of stripe +1000 (a single failure is
+            # absorbed by the per-stripe retry and never falls back)
+            if o == 1_000 and self.trips < 2:
+                self.trips += 1
+                with self.lock:
+                    self.calls.append((o, length))
+                raise OSError("transient 500")
+            return super().read_range(p, o, length)
+
+    ufs = FlakyUfs(LocalUnderFileSystem(str(ufs_dir)))
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=500, concurrency=1, per_mount_limit=4))
+    try:
+        desc = UfsBlockDescriptor(block_id=71, ufs_path=path,
+                                  offset=0, length=2_000, mount_id=8)
+        fetch = fetcher.fetch(ufs, desc, cache=False)
+        assert fetch.result() == payload  # fallback rescued the read
+        assert fetch.fallback
+        # other stripes succeeded -> one flaky read must NOT collapse
+        # the mount to single-connection fetches for 10 minutes
+        assert 8 not in fetcher._unstriped_mounts
+
+        # a SINGLE transient error is absorbed by the per-stripe retry:
+        # no fallback, no whole-block re-download
+        ufs.trips = 1  # next +1000 read fails once, then succeeds
+        desc2 = UfsBlockDescriptor(block_id=73, ufs_path=path,
+                                   offset=0, length=2_000, mount_id=8)
+        fetch2 = fetcher.fetch(ufs, desc2, cache=False)
+        assert fetch2.result() == payload
+        assert not fetch2.fallback
+    finally:
+        fetcher.close()
+
+
+def test_async_cache_close_stops_all_threads_with_tiny_queue(store, ufs_dir):
+    """queue.max smaller than the thread count: close() must still stop
+    every worker (one relayed poison pill), without draining first."""
+    path, _ = _write(ufs_dir, "pill", 100, seed=13)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    mgr = _mk_async(store, ufs, None, num_threads=3, queue_max=1)
+    mgr.close()
+    for t in mgr._threads:
+        t.join(5)
+    assert not any(t.is_alive() for t in mgr._threads)
+    assert not mgr.submit(UfsBlockDescriptor(
+        block_id=80, ufs_path=path, offset=0, length=100))  # closed
+
+
+def test_caching_join_upgrades_noncache_fetch(store, ufs_dir):
+    """A cache=True reader joining an in-flight cache=False fetch must
+    still get the block cached (the join upgrades the fetch)."""
+    path, payload = _write(ufs_dir, "upgrade", 2_000, seed=9)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    release = threading.Event()
+    ufs.gate_all = release
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=500, concurrency=2, per_mount_limit=4))
+    try:
+        desc = UfsBlockDescriptor(block_id=60, ufs_path=path,
+                                  offset=0, length=2_000)
+        first = fetcher.fetch(ufs, desc, cache=False)
+        joined = fetcher.fetch(ufs, desc, cache=True)
+        assert joined is first
+        release.set()
+        assert joined.result() == payload
+        assert joined.wait_done(10)
+        assert store.has_block(60)
+        # still exactly one UFS fetch
+        assert sorted(o for o, _ in ufs.calls) == [0, 500, 1_000, 1_500]
+    finally:
+        release.set()
+        fetcher.close()
+
+
+def test_late_caching_join_fills_from_buffer(store, ufs_dir):
+    """A caching reader that joins after stripes passed the frontier
+    cannot attach the incremental fill — finalize caches the completed
+    buffer instead, without a second UFS read."""
+    path, payload = _write(ufs_dir, "lateupg", 400, seed=10)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    release = threading.Event()
+    for off in (100, 200, 300):  # stripe 0 lands; the rest held
+        ufs.gates[off] = release
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=100, concurrency=1, per_mount_limit=2))
+    try:
+        desc = UfsBlockDescriptor(block_id=61, ufs_path=path,
+                                  offset=0, length=400)
+        first = fetcher.fetch(ufs, desc, cache=False)
+        it = first.iter_range(0, 400, chunk_size=100)
+        assert next(it) == payload[:100]  # frontier has moved
+        joined = fetcher.fetch(ufs, desc, cache=True)
+        assert joined is first
+        release.set()
+        assert joined.result() == payload
+        assert joined.wait_done(10)
+        assert store.has_block(61)
+        with store.get_reader(61) as r:
+            assert r.read(0, 400) == payload
+        assert sorted(o for o, _ in ufs.calls) == [0, 100, 200, 300]
+    finally:
+        release.set()
+        fetcher.close()
+
+
+# -------------------------------------------------------------- async cache
+def _mk_async(store, ufs, fetcher, **kw):
+    return AsyncCacheManager(store, lambda mount_id: ufs,
+                             fetcher=fetcher, **kw)
+
+
+def test_async_cache_bounded_queue_rejects_and_counts(store, ufs_dir):
+    path, _ = _write(ufs_dir, "q", 1_000, seed=6)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    release = threading.Event()
+    ufs.gate_all = release
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=1_000, concurrency=1, per_mount_limit=2))
+    mgr = _mk_async(store, ufs, fetcher, num_threads=1, queue_max=1)
+    try:
+        rej0 = _counter("Worker.AsyncCacheRejected")
+        descs = [UfsBlockDescriptor(block_id=30 + i, ufs_path=path,
+                                    offset=0, length=1_000)
+                 for i in range(3)]
+        assert mgr.submit(descs[0])
+        for _ in range(400):  # worker thread takes descs[0] off the queue
+            if mgr._queue.qsize() == 0:
+                break
+            threading.Event().wait(0.01)
+        assert mgr._queue.qsize() == 0
+        assert mgr.submit(descs[1])       # fills the length-1 queue
+        assert not mgr.submit(descs[2])   # bounded: rejected, counted
+        assert _counter("Worker.AsyncCacheRejected") == rej0 + 1
+        release.set()
+        assert mgr.wait_idle()
+        assert store.has_block(30) and store.has_block(31)
+        assert not store.has_block(32)
+    finally:
+        release.set()
+        mgr.close()
+        fetcher.close()
+
+
+def test_async_cache_dedupes_against_foreground_fetch(store, ufs_dir):
+    path, payload = _write(ufs_dir, "dedupe", 2_000, seed=7)
+    ufs = RecordingUfs(LocalUnderFileSystem(str(ufs_dir)))
+    release = threading.Event()
+    ufs.gate_all = release
+    fetcher = UfsBlockFetcher(store, FetchConf(
+        stripe_size=500, concurrency=2, per_mount_limit=4))
+    mgr = _mk_async(store, ufs, fetcher, num_threads=1, queue_max=8)
+    try:
+        desc = UfsBlockDescriptor(block_id=50, ufs_path=path,
+                                  offset=0, length=2_000)
+        foreground = fetcher.fetch(ufs, desc, cache=True)
+        # a passive-cache request for a block already being read through
+        # is a no-op, not a second UFS fetch
+        assert not mgr.submit(desc)
+        release.set()
+        assert foreground.result() == payload
+        assert sorted(o for o, _ in ufs.calls) == [0, 500, 1_000, 1_500]
+        assert store.has_block(50)
+    finally:
+        release.set()
+        mgr.close()
+        fetcher.close()
+
+
+# ------------------------------------------------------------------- config
+def test_conf_defaults_registered(conf):
+    fc = FetchConf.from_conf(conf)
+    assert fc.stripe_size == 4 << 20
+    assert fc.concurrency == 4
+    assert fc.per_mount_limit == 16
+    assert conf.get_int(Keys.WORKER_ASYNC_CACHE_QUEUE_MAX) == 512
+    assert conf.get_int(Keys.WORKER_ASYNC_CACHE_THREADS) == 2
+
+
+def test_plan_stripes_covers_exactly():
+    for length in (0, 1, 99, 100, 101, 1_000_003):
+        for stripe in (1, 7, 100, 1 << 20):
+            plan = plan_stripes(length, stripe)
+            assert plan[0][0] == 0
+            covered = 0
+            for off, ln in plan:
+                assert off == covered
+                covered += ln
+            assert covered == max(0, length)
+
+
+# ------------------------------------------------------------ RPC streaming
+def test_cold_read_block_rpc_streams_and_caches(conf, tmp_path):
+    """End-to-end: the worker ``read_block`` stream serves a cold block
+    chunk-by-chunk tagged ``source=UFS`` and the block is cached after."""
+    from alluxio_tpu.journal import NoopJournalSystem
+    from alluxio_tpu.master import BlockMaster, FileSystemMaster
+    from alluxio_tpu.rpc.worker_service import worker_service
+    from alluxio_tpu.worker import BlockWorker
+    from alluxio_tpu.worker.master_sync import InProcessBlockMasterClient
+
+    conf.set(Keys.WORKER_RAMDISK_SIZE, 16 * KB)
+    journal = NoopJournalSystem()
+    bm = BlockMaster(journal)
+    fsm = FileSystemMaster(bm, journal, default_block_size=KB)
+    fsm.start(str(tmp_path / "root_ufs"))
+    worker = BlockWorker(conf, InProcessBlockMasterClient(bm),
+                         ufs_manager=fsm.ufs_manager)
+    worker._master_sync.register_with_master()
+    try:
+        ufs_dir = tmp_path / "ext"
+        ufs_dir.mkdir()
+        payload = random.Random(8).randbytes(3 * KB)
+        (ufs_dir / "obj").write_bytes(payload)
+        fsm.mount("/ext", str(ufs_dir))
+        st = fsm.get_status("/ext/obj")
+        bid = st.block_ids[0]
+        from alluxio_tpu.utils.uri import AlluxioURI
+
+        mount_id = fsm.mount_table.resolve(
+            AlluxioURI("/ext/obj")).mount_id
+        svc = worker_service(worker)
+        read_block = svc.methods["read_block"][0]
+        chunks = list(read_block({
+            "block_id": bid, "chunk_size": 512,
+            "ufs": {"ufs_path": str(ufs_dir / "obj"), "offset": 0,
+                    "length": KB, "mount_id": mount_id}}))
+        assert all(c["source"] == "UFS" for c in chunks)
+        assert len(chunks) == 2  # KB block / 512B chunks
+        assert b"".join(c["data"] for c in chunks) == payload[:KB]
+        for _ in range(500):  # commit trails the streamed last chunk
+            if worker.store.has_block(bid):
+                break
+            threading.Event().wait(0.01)
+        assert worker.store.has_block(bid)
+        # warm re-read now serves from the tiered store
+        chunks2 = list(read_block({"block_id": bid}))
+        assert chunks2[0]["source"] != "UFS"  # a tier alias (MEM/SSD)
+        assert b"".join(c["data"] for c in chunks2) == payload[:KB]
+    finally:
+        worker.async_cache.close()
+        worker.ufs_fetcher.close()

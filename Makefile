@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-ufs-cold sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-ufs-cold bench-remote-read sdist clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -27,6 +27,9 @@ bench-obs:  ## tracing overhead: spans/sec + on-vs-off read latency (<2% budget)
 
 bench-ufs-cold:  ## cold UFS reads: striped vs single-stream GB/s + ttfb (1.5x gate at c=4)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress ufscold
+
+bench-remote-read:  ## warm remote reads: striped vs single-stream GB/s + hedged straggler drill (1.5x gate at 4 stripes)
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress remoteread
 
 sdist:
 	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist

@@ -18,6 +18,7 @@ from alluxio_tpu.client.block_streams import (
     LocalBlockInStream, LocalBlockOutStream, is_local_worker,
 )
 from alluxio_tpu.client.policy import BlockLocationPolicy
+from alluxio_tpu.client.remote_read import RemoteReadConf, RemoteReadRuntime
 from alluxio_tpu.rpc.clients import BlockMasterClient, WorkerClient
 from alluxio_tpu.utils import ids as id_utils
 from alluxio_tpu.utils.exceptions import UnavailableError
@@ -36,7 +37,14 @@ class BlockStoreClient:
                  ufs_read_policy: Optional[BlockLocationPolicy] = None,
                  short_circuit: bool = True,
                  passive_cache: bool = True,
-                 write_unavailable_window_s: float = 15.0) -> None:
+                 write_unavailable_window_s: float = 15.0,
+                 streaming_chunk_size: int = 1 << 20,
+                 remote_read: Optional[RemoteReadConf] = None) -> None:
+        """``streaming_chunk_size``: per-message chunk of the gRPC read
+        streams (``atpu.user.streaming.reader.chunk.size.bytes``);
+        ``remote_read``: striped-read tuning — the default conf stripes
+        large remote reads, ``RemoteReadConf(stripe_size=0)`` pins the
+        legacy single-stream path."""
         self._bm = block_master
         self._identity = identity or TieredIdentity.from_spec(
             None, hostname=socket.gethostname())
@@ -49,6 +57,11 @@ class BlockStoreClient:
         self._short_circuit = short_circuit
         self._passive_cache = passive_cache
         self._write_unavailable_window_s = write_unavailable_window_s
+        self._chunk_size = max(1, streaming_chunk_size)
+        #: the parallel remote-read runtime every GrpcBlockInStream of
+        #: this store shares: stripe executor + per-worker latency EWMAs
+        #: (hedging learns across reads, so it lives here, not per-stream)
+        self.remote_read = RemoteReadRuntime(remote_read)
         self.session_id = id_utils.create_session_id()
         #: worker that served the most recent write (sync-persist targets it;
         #: LOCAL_FIRST keeps one file's blocks on one worker)
@@ -142,9 +155,18 @@ class BlockStoreClient:
                 idx = self._identity.nearest(
                     [a.tiered_identity for a in addrs])
                 address = addrs[idx if idx is not None else 0]
+                # the whole healthy replica set rides along, nearest
+                # first: striped reads fan stripes out across it, and a
+                # replica dying mid-read re-routes instead of failing
+                replicas = [address] + [a for a in addrs
+                                        if a.key() != address.key()]
                 stream = GrpcBlockInStream(
                     self.worker_client(address), info.block_id, info.length,
-                    ufs=ufs_info, cache=cache_cold_reads)
+                    ufs=ufs_info, cache=cache_cold_reads,
+                    chunk_size=self._chunk_size,
+                    remote_read=self.remote_read, replicas=replicas,
+                    client_factory=self.worker_client,
+                    on_failed=self.mark_failed)
                 stream.address = address
                 metrics().counter("Client.BlockOpens.remote").inc()
                 self._maybe_passive_cache(info, ufs_info)
@@ -159,9 +181,16 @@ class BlockStoreClient:
                                              block_size=info.length)
         if address is None:
             raise UnavailableError("no live workers for UFS read")
+        # striping still applies on the cold path: the stripes coalesce
+        # into ONE worker-side UFS fetch (ufs_fetch.py registry) but
+        # stream back over pooled channels
         stream = GrpcBlockInStream(self.worker_client(address),
                                    info.block_id, info.length, ufs=ufs_info,
-                                   cache=cache_cold_reads)
+                                   cache=cache_cold_reads,
+                                   chunk_size=self._chunk_size,
+                                   remote_read=self.remote_read,
+                                   client_factory=self.worker_client,
+                                   on_failed=self.mark_failed)
         stream.address = address
         metrics().counter("Client.BlockOpens.ufs").inc()
         return stream
@@ -238,6 +267,7 @@ class BlockStoreClient:
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:
+        self.remote_read.close()
         for c in self._workers.values():
             try:
                 c.cleanup_session(self.session_id)

@@ -34,13 +34,30 @@ from alluxio_tpu.utils.exceptions import UnavailableError
 from alluxio_tpu.utils.wire import BlockInfo, WorkerNetAddress
 
 
+#: cached ``metrics()`` accessor: the import machinery (sys.modules
+#: lookup + attribute walk) was paid inside ``_record_read`` on EVERY
+#: read — hot-path cost for a value that never changes. The function
+#: (not the registry) is cached so ``reset_metrics()`` in tests still
+#: takes effect.
+_metrics_fn = None
+
+
+def _metrics():
+    global _metrics_fn
+    if _metrics_fn is None:
+        # deferred: alluxio_tpu.metrics imports are cyclic at module
+        # load time (metrics sinks reach back into client config)
+        from alluxio_tpu.metrics import metrics as fn
+
+        _metrics_fn = fn
+    return _metrics_fn()
+
+
 def _record_read(bucket: str, nbytes: int) -> None:
     """Per-source read accounting: ``Client.BytesRead.<bucket>`` /
     ``Client.BlocksRead.<bucket>`` counters (additive — they roll up to
     ``Cluster.*`` on the metrics heartbeat)."""
-    from alluxio_tpu.metrics import metrics
-
-    m = metrics()
+    m = _metrics()
     m.counter(f"Client.BytesRead.{bucket}").inc(nbytes)
     m.counter(f"Client.BlocksRead.{bucket}").inc()
 
@@ -158,21 +175,90 @@ class LocalBlockInStream(BlockInStream):
 
 
 class GrpcBlockInStream(BlockInStream):
-    """Remote read over the gRPC chunk stream
-    (reference: ``GrpcDataReader.java:49``)."""
+    """Remote read over gRPC chunk streams
+    (reference: ``GrpcDataReader.java:49``).
+
+    Reads larger than one stripe ride the parallel data plane
+    (``client/remote_read.py``): concurrent range streams across the
+    block's replica set — or pooled channels to a single worker — with
+    hedged stragglers and zero-join ``memoryview`` assembly into one
+    preallocated buffer. Smaller reads (and a runtime configured with
+    ``stripe.size=0``) take the legacy single-stream loop, byte for
+    byte what the seed shipped."""
 
     source = "REMOTE"
 
     def __init__(self, worker: WorkerClient, block_id: int, length: int,
                  *, ufs: Optional[dict] = None, cache: bool = True,
-                 chunk_size: int = 1 << 20) -> None:
+                 chunk_size: int = 1 << 20, remote_read=None,
+                 replicas: Optional[list] = None, client_factory=None,
+                 on_failed=None) -> None:
+        """``remote_read``: a ``RemoteReadRuntime`` (None = legacy only);
+        ``replicas``: the block's location addresses, nearest first;
+        ``client_factory``: address -> WorkerClient for replica fan-out;
+        ``on_failed``: callback(address) when a worker dies mid-stripe
+        (``BlockStoreClient.mark_failed`` plumbing)."""
         super().__init__(block_id, length)
         self._worker = worker
         self._ufs = ufs
         self._cache = cache
         self._chunk = chunk_size
+        self._remote_read = remote_read
+        self._replicas = replicas or []
+        self._client_factory = client_factory
+        self._on_failed = on_failed
+
+    # -- parallel data plane -------------------------------------------------
+    def _striped_sources(self, conf):
+        """Build the stripe fan-out: one source per replica (rotating
+        onto pooled channels when concurrency exceeds the replica
+        count), or ``concurrency`` pooled channels to the single
+        serving worker."""
+        from alluxio_tpu.client.remote_read import (
+            MAX_POOLED_CHANNELS, GrpcReadSource,
+        )
+
+        addrs = [a for a in self._replicas if a is not None]
+        if not addrs:
+            if self.address is None:
+                return []
+            addrs = [self.address]
+        fan_out = max(len(addrs), min(conf.concurrency,
+                                      MAX_POOLED_CHANNELS * len(addrs)))
+        sources = []
+        for i in range(fan_out):
+            addr = addrs[i % len(addrs)]
+            channel = i // len(addrs)
+            if self.address is not None and addr.key() == self.address.key():
+                worker = self._worker
+            elif self._client_factory is not None:
+                worker = self._client_factory(addr)
+            else:
+                continue
+            sources.append(GrpcReadSource(
+                worker, addr, channel, block_id=self.block_id,
+                ufs=self._ufs, cache=self._cache))
+        return sources
+
+    def _striped_read(self, offset: int, n: int):
+        rt = self._remote_read
+        read = rt.read(block_id=self.block_id,
+                       sources=self._striped_sources(rt.conf),
+                       offset=offset, length=n, chunk_size=self._chunk,
+                       on_failed=self._on_failed)
+        view = read.read_view()
+        self.last_source = read.source_tag or "REMOTE"
+        _record_read(self.source_bucket(), len(view))
+        return view
+
+    def _use_striped(self, n: int) -> bool:
+        rt = self._remote_read
+        return rt is not None and rt.enabled and n > rt.conf.stripe_size
 
     def pread(self, offset: int, n: int) -> bytes:
+        n = max(0, min(n, self.length - offset))
+        if self._use_striped(n):
+            return bytes(self._striped_read(offset, n))
         out = bytearray()
         source = None
         for msg in self._worker.read_block(
@@ -186,6 +272,15 @@ class GrpcBlockInStream(BlockInStream):
         self.last_source = source or "REMOTE"
         _record_read(self.source_bucket(), len(out))
         return bytes(out)
+
+    def read_all_view(self) -> memoryview:
+        """The whole block as a buffer view: striped reads hand back
+        their preallocated assembly buffer with NO final copy —
+        ``numpy.frombuffer``/``jax.device_put`` consume it zero-copy.
+        The legacy path wraps its joined bytes (one view, same data)."""
+        if self._use_striped(self.length):
+            return self._striped_read(0, self.length)
+        return memoryview(self.pread(0, self.length))
 
     @property
     def is_ufs_fallback(self) -> bool:

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from alluxio_tpu.client.block_store import BlockStoreClient
 from alluxio_tpu.client.policy import BlockLocationPolicy
+from alluxio_tpu.client.remote_read import RemoteReadConf
 from alluxio_tpu.client.streams import FileInStream, FileOutStream, WriteType
 from alluxio_tpu.conf import Configuration, Keys
 from alluxio_tpu.rpc.clients import (
@@ -102,7 +103,10 @@ class FileSystem:
             passive_cache=self._conf.get_bool(
                 Keys.USER_FILE_PASSIVE_CACHE_ENABLED),
             write_unavailable_window_s=self._conf.get_duration_s(
-                Keys.USER_BLOCK_WRITE_UNAVAILABLE_WINDOW))
+                Keys.USER_BLOCK_WRITE_UNAVAILABLE_WINDOW),
+            streaming_chunk_size=self._conf.get_bytes(
+                Keys.USER_STREAMING_READER_CHUNK_SIZE),
+            remote_read=RemoteReadConf.from_conf(self._conf))
         # pull cluster defaults once at start (reference: clients load
         # cluster-default config via the meta master on first connect)
         self._path_conf: Dict[str, Dict[str, str]] = {}

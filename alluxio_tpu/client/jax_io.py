@@ -279,7 +279,12 @@ class DeviceBlockLoader:
             self._tls.last_bucket = "shm"
             return view(dtype=self._dtype)
         self._m.counter("Client.JaxStreamedBlocks").inc()
-        data = np.frombuffer(stream.read_all(), dtype=self._dtype)
+        # striped remote reads expose their assembly buffer as a view:
+        # frombuffer wraps it zero-copy, so the bytes go straight from
+        # the stripe streams into device_put with no join pass
+        reader = getattr(stream, "read_all_view", None)
+        buf = reader() if reader is not None else stream.read_all()
+        data = np.frombuffer(buf, dtype=self._dtype)
         # AFTER the read: a stale location can self-heal into a UFS
         # read-through mid-call, and only the stream knows what served
         self._tls.last_bucket = stream.source_bucket()

@@ -634,6 +634,36 @@ class Keys:
     USER_STREAMING_WRITER_CHUNK_SIZE = _k(
         "atpu.user.streaming.writer.chunk.size.bytes", KeyType.BYTES, default="1MB",
         scope=Scope.CLIENT)
+    USER_REMOTE_READ_STRIPE_SIZE = _k(
+        "atpu.user.remote.read.stripe.size", KeyType.BYTES, default="4MB",
+        scope=Scope.CLIENT,
+        description="Stripe size for parallel remote (DCN) block reads: a "
+                    "read larger than one stripe is split into ranges "
+                    "fetched over concurrent read_block streams across "
+                    "replicas / pooled channels. 0 disables striping "
+                    "(byte-identical legacy single-stream reads).")
+    USER_REMOTE_READ_CONCURRENCY = _k(
+        "atpu.user.remote.read.concurrency", KeyType.INT, default=4,
+        scope=Scope.CLIENT,
+        description="Stripes of one remote read in flight concurrently; "
+                    "also bounds the pooled-channel fan-out to a single "
+                    "worker.")
+    USER_REMOTE_READ_WINDOW_BYTES = _k(
+        "atpu.user.remote.read.window.bytes", KeyType.BYTES, default="32MB",
+        scope=Scope.CLIENT,
+        description="In-flight window for striped remote reads: stripes "
+                    "are only issued while their offset is within this "
+                    "many bytes of the consumer's drain point, capping "
+                    "readahead past the contiguous frontier. 0 removes "
+                    "the cap (concurrency still bounds in-flight "
+                    "stripes).")
+    USER_REMOTE_READ_HEDGE_QUANTILE = _k(
+        "atpu.user.remote.read.hedge.quantile", KeyType.FLOAT, default=0.95,
+        scope=Scope.CLIENT,
+        description="A stripe outliving this latency quantile of its "
+                    "worker's rolling EWMA is re-issued to another "
+                    "replica/channel; first answer wins, the loser is "
+                    "cancelled. 0 disables hedging.")
     USER_CLIENT_CACHE_ENABLED = _k("atpu.user.client.cache.enabled", KeyType.BOOL,
                                    default=False, scope=Scope.CLIENT)
     USER_CLIENT_CACHE_SIZE = _k("atpu.user.client.cache.size", KeyType.BYTES,

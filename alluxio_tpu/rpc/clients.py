@@ -9,6 +9,7 @@ rest of the stack cannot tell transport from direct calls.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterator, List, Optional
 
 from alluxio_tpu.rpc.core import RpcChannel
@@ -56,6 +57,7 @@ class _BaseClient:
                 ch = HybridChannel(ch, fastpath_dir=fast_dir)
             self._channels.append(ch)
         self._active = 0
+        self._metadata = metadata
         self._retry_duration_s = retry_duration_s
         self._base_sleep_s = base_sleep_s
         self._max_sleep_s = max_sleep_s
@@ -397,9 +399,35 @@ class MetaMasterClient(_BaseClient):
 
 class WorkerClient(_BaseClient):
     """Data-plane client for one worker (reference: block streams +
-    short-circuit RPCs in ``client/block/stream``)."""
+    short-circuit RPCs in ``client/block/stream``).
+
+    Beyond the default channel, the client can mint **pooled channels**
+    — distinct TCP connections to the same worker — so the striped
+    remote-read path fans stripes of one block out over several
+    connections instead of serializing them behind one HTTP/2 flow-
+    control window (reference: GrpcConnectionPool's per-NetworkGroup
+    channel multiplicity)."""
 
     service = WORKER_SERVICE
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._pooled: Dict[int, "RpcChannel"] = {}
+        self._pooled_lock = threading.Lock()
+
+    def pooled_channel(self, index: int) -> RpcChannel:
+        """Channel for pool slot ``index`` (0 = the default channel).
+        Channels are created lazily and cached for the client's life;
+        the process-wide gRPC channel pool dedupes across clients."""
+        if index == 0:
+            return self._channel
+        with self._pooled_lock:
+            ch = self._pooled.get(index)
+            if ch is None:
+                ch = RpcChannel(self._channels[0].address,
+                                metadata=self._metadata, pool_index=index)
+                self._pooled[index] = ch
+            return ch
 
     def read_block(self, block_id: int, *, offset: int = 0, length: int = -1,
                    chunk_size: int = 1 << 20,
@@ -408,6 +436,18 @@ class WorkerClient(_BaseClient):
         return self._channel.call_stream(self.service, "read_block", {
             "block_id": block_id, "offset": offset, "length": length,
             "chunk_size": chunk_size, "ufs": ufs, "cache": cache})
+
+    def read_block_stream(self, block_id: int, *, offset: int = 0,
+                          length: int = -1, chunk_size: int = 1 << 20,
+                          ufs: Optional[dict] = None, cache: bool = True,
+                          channel: int = 0):
+        """Cancellable ``read_block`` range stream over pool slot
+        ``channel`` — the striped read path's transport (it must abort
+        hedge losers mid-transfer, which plain ``read_block`` cannot)."""
+        return self.pooled_channel(channel).open_stream(
+            self.service, "read_block", {
+                "block_id": block_id, "offset": offset, "length": length,
+                "chunk_size": chunk_size, "ufs": ufs, "cache": cache})
 
     def read_block_bytes(self, block_id: int, **kwargs) -> bytes:
         return b"".join(msg["data"] for msg in

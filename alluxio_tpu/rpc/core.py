@@ -278,10 +278,40 @@ def default_client_metadata() -> Tuple[Tuple[str, str], ...]:
     return (("atpu-user", get_os_user()),)
 
 
+class StreamCall:
+    """A cancellable server-stream: iterate for decoded messages, call
+    :meth:`cancel` to abort the underlying HTTP/2 stream mid-flight
+    (hedged reads cancel the losing transfer instead of draining it).
+    A self-cancelled stream ends iteration quietly; every other gRPC
+    error is re-raised typed like the plain ``call_stream`` path."""
+
+    __slots__ = ("_call", "cancelled")
+
+    def __init__(self, call) -> None:
+        self._call = call
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        self._call.cancel()
+
+    def __iter__(self) -> Iterator[Any]:
+        try:
+            yield from self._call
+        except grpc.RpcError as e:
+            if self.cancelled and e.code() == grpc.StatusCode.CANCELLED:
+                return
+            _raise_typed(e)
+
+
 class RpcChannel:
     """A pooled channel + method invokers (reference: GrpcConnectionPool
     multiplexes channels per NetworkGroup; grpc-python already multiplexes
-    streams on one HTTP/2 connection, so one channel per address suffices).
+    streams on one HTTP/2 connection, so one channel per address suffices
+    — except for the parallel data plane, where ``pool_index`` > 0 mints
+    additional channels with their own subchannel pool, i.e. their own
+    TCP connections, so striped reads are not serialized behind one
+    connection's flow-control window).
     ``metadata``: identity/credential tuples attached to every call
     (reference: the SASL-authenticated channel carrying the user)."""
 
@@ -289,19 +319,26 @@ class RpcChannel:
     _pool_lock = threading.Lock()
 
     def __init__(self, address: str,
-                 metadata: Optional[Tuple[Tuple[str, str], ...]] = None
-                 ) -> None:
+                 metadata: Optional[Tuple[Tuple[str, str], ...]] = None,
+                 pool_index: int = 0) -> None:
         self.address = address
         self.metadata = tuple(metadata) if metadata is not None \
             else default_client_metadata()
+        key = address if pool_index == 0 else f"{address}#{pool_index}"
         with RpcChannel._pool_lock:
-            ch = RpcChannel._pool.get(address)
+            ch = RpcChannel._pool.get(key)
             if ch is None:
-                ch = grpc.insecure_channel(address, options=[
+                options = [
                     ("grpc.max_send_message_length", 64 << 20),
                     ("grpc.max_receive_message_length", 64 << 20),
-                ])
-                RpcChannel._pool[address] = ch
+                ]
+                if pool_index:
+                    # opt out of gRPC's global subchannel sharing:
+                    # identical-args channels would otherwise coalesce
+                    # onto the same TCP connection, defeating the pool
+                    options.append(("grpc.use_local_subchannel_pool", 1))
+                ch = grpc.insecure_channel(address, options=options)
+                RpcChannel._pool[key] = ch
             self._channel = ch
 
     def _call_metadata(self) -> Tuple[Tuple[str, str], ...]:
@@ -333,6 +370,18 @@ class RpcChannel:
                           metadata=self._call_metadata())
         except grpc.RpcError as e:
             _raise_typed(e)
+
+    def open_stream(self, service: str, method: str, request: dict,
+                    timeout: Optional[float] = 300.0) -> StreamCall:
+        """Like :meth:`call_stream` but returns the live call wrapped as
+        a :class:`StreamCall`, so the caller can ``cancel()`` it — the
+        parallel read path races stripe transfers and must be able to
+        abort the losers without draining them."""
+        fn = self._channel.unary_stream(
+            f"/{service}/{method}", request_serializer=pack,
+            response_deserializer=unpack)
+        return StreamCall(fn(request, timeout=timeout,
+                             metadata=self._call_metadata()))
 
     def call_stream_in(self, service: str, method: str,
                        requests: Iterator[dict],

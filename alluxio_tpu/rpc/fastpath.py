@@ -344,6 +344,9 @@ class HybridChannel:
     def call_stream(self, *args, **kwargs):
         return self._grpc.call_stream(*args, **kwargs)
 
+    def open_stream(self, *args, **kwargs):
+        return self._grpc.open_stream(*args, **kwargs)
+
     def call_stream_in(self, *args, **kwargs):
         return self._grpc.call_stream_in(*args, **kwargs)
 

@@ -113,6 +113,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fail below this striped/single throughput "
                          "ratio at 4 concurrent readers")
 
+    rr = sub.add_parser("remoteread",
+                        help="striped vs single-stream warm remote reads "
+                             "(bandwidth-limited-per-connection worker "
+                             "model) + hedged straggler drill")
+    rr.add_argument("--block-mb", type=int, default=4)
+    rr.add_argument("--stripe-kb", type=int, default=1024)
+    rr.add_argument("--stripes", type=int, default=4,
+                    help="concurrent range streams per read")
+    rr.add_argument("--rtt-ms", type=float, default=20.0,
+                    help="modeled per-stream round trip; must dwarf the "
+                         "host's thread-wake jitter")
+    rr.add_argument("--conn-mbps", type=float, default=16.0,
+                    help="modeled per-connection worker bandwidth")
+    rr.add_argument("--blocks", type=int, default=3,
+                    help="blocks read per variant")
+    rr.add_argument("--hedge-quantile", type=float, default=0.95)
+    rr.add_argument("--stall-ms", type=float, default=300.0,
+                    help="injected straggler stall before first byte")
+    rr.add_argument("--min-speedup", type=float, default=1.5,
+                    help="fail below this striped/single throughput ratio")
+
     sub.add_parser("suite", help="run the whole BASELINE config family")
     rp = sub.add_parser("report",
                         help="render suite JSON to a single-file HTML "
@@ -154,6 +175,7 @@ SUITE = (
     ("write-eviction", ["write"]),
     ("obs-tracing-overhead", ["obs"]),
     ("ufs-cold-read", ["ufscold"]),
+    ("remote-warm-read", ["remoteread"]),
 )
 
 
@@ -325,6 +347,14 @@ def main(argv=None) -> int:
                 concurrency=args.concurrency,
                 per_mount_limit=args.per_mount_limit,
                 min_speedup=args.min_speedup)
+    elif args.bench == "remoteread":
+        from alluxio_tpu.stress.remote_read_bench import run
+
+        r = run(block_mb=args.block_mb, stripe_kb=args.stripe_kb,
+                stripes=args.stripes, rtt_ms=args.rtt_ms,
+                conn_mbps=args.conn_mbps, blocks=args.blocks,
+                hedge_quantile=args.hedge_quantile,
+                stall_ms=args.stall_ms, min_speedup=args.min_speedup)
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1

@@ -1,0 +1,20 @@
+"""Stripe planning shared by the worker's cold-fetch pipeline
+(``worker/ufs_fetch.py``) and the client's parallel remote reads
+(``client/remote_read.py``) — one implementation so a future change to
+the striping math (alignment, rounding) cannot silently diverge
+between the two halves of the data plane."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def plan_stripes(length: int, stripe_size: int) -> List[Tuple[int, int]]:
+    """(range-relative offset, length) per stripe; empty for
+    ``length <= 0`` — callers that need a completion event for empty
+    ranges add their own sentinel."""
+    if length <= 0:
+        return []
+    stripe_size = max(1, stripe_size)
+    return [(off, min(stripe_size, length - off))
+            for off in range(0, length, stripe_size)]

@@ -43,6 +43,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from alluxio_tpu.metrics import metrics
 from alluxio_tpu.underfs.base import UnderFileSystem
 from alluxio_tpu.utils import tracing as _tracing
+from alluxio_tpu.utils.striping import plan_stripes as _plan_stripes
 from alluxio_tpu.worker.tiered_store import CacheFill, TieredBlockStore
 from alluxio_tpu.worker.ufs_io import UfsBlockDescriptor
 
@@ -82,12 +83,10 @@ class FetchConf:
 def plan_stripes(length: int, stripe_size: int) -> List[Tuple[int, int]]:
     """(block-relative offset, length) per stripe; never empty — a
     zero-length block still needs one completion event to close the
-    pipeline."""
+    pipeline (the shared planner returns [] there)."""
     if length <= 0:
         return [(0, 0)]
-    stripe_size = max(1, stripe_size)
-    return [(off, min(stripe_size, length - off))
-            for off in range(0, length, stripe_size)]
+    return _plan_stripes(length, stripe_size)
 
 
 class FetchError(IOError):

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-ufs-cold bench-remote-read sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-ufs-cold bench-remote-read sdist clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -24,6 +24,9 @@ bench-prefetch:  ## clairvoyant prefetch: hit-rate + p50/p99 block-ready latenes
 
 bench-obs:  ## tracing overhead: spans/sec + on-vs-off read latency (<2% budget)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs
+
+bench-health:  ## metrics-history ingestion: heartbeat hot-path overhead (<5% gate, fake clock)
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress health
 
 bench-ufs-cold:  ## cold UFS reads: striped vs single-stream GB/s + ttfb (1.5x gate at c=4)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress ufscold

@@ -766,6 +766,84 @@ class Keys:
         description="Completed spans retained per process (oldest "
                     "evicted first). Workers/clients drain the ring to "
                     "the master on the metrics heartbeat.")
+    MASTER_METRICS_MAX_SOURCES = _k(
+        "atpu.master.metrics.max.sources", KeyType.INT, default=4096,
+        scope=Scope.MASTER,
+        description="Distinct reporting sources the master's metrics "
+                    "store accepts; reports from new sources beyond it "
+                    "are dropped (counted in "
+                    "Master.MetricsReportsDropped) — bounds memory "
+                    "against spoofed source-name floods.")
+    MASTER_METRICS_HISTORY_ENABLED = _k(
+        "atpu.master.metrics.history.enabled", KeyType.BOOL, default=True,
+        scope=Scope.MASTER,
+        description="Keep bounded per-(source, metric) time series of "
+                    "the metric snapshots arriving on the metrics "
+                    "heartbeat (raw + 1m/10m rollups), served at "
+                    "/api/v1/master/metrics/history and `fsadmin "
+                    "report history`.")
+    MASTER_METRICS_HISTORY_CAPACITY = _k(
+        "atpu.master.metrics.history.capacity", KeyType.INT, default=360,
+        scope=Scope.MASTER,
+        description="Samples retained per series per resolution (raw, "
+                    "1m, 10m) — oldest evicted first. Total history "
+                    "memory is bounded by max.series x 3 x capacity "
+                    "points.")
+    MASTER_METRICS_HISTORY_RETENTION = _k(
+        "atpu.master.metrics.history.retention", KeyType.DURATION,
+        default="1h", scope=Scope.MASTER,
+        description="Raw samples older than this are pruned (1m "
+                    "rollups keep 10x, 10m rollups 60x, still capped "
+                    "by capacity).")
+    MASTER_METRICS_HISTORY_MAX_SERIES = _k(
+        "atpu.master.metrics.history.max.series", KeyType.INT,
+        default=4096, scope=Scope.MASTER,
+        description="Hard cap on distinct (source, metric) series; "
+                    "samples for series beyond it (or outside the "
+                    "prefix allowlist) are dropped and counted in "
+                    "Master.MetricsHistorySamplesDropped — bounds "
+                    "memory against metric-name cardinality floods.")
+    MASTER_METRICS_HISTORY_ALLOW_PREFIXES = _k(
+        "atpu.master.metrics.history.allow.prefixes", KeyType.STRING,
+        default="Cluster.,Master.,Worker.,Client.,JobMaster.,"
+                "JobWorker.,Process.",
+        scope=Scope.MASTER,
+        description="Comma-separated metric-name prefixes admitted "
+                    "into the history store; anything else (e.g. a "
+                    "spoofed-name flood) is dropped before it can "
+                    "mint a series.")
+    MASTER_HEALTH_ENABLED = _k(
+        "atpu.master.health.enabled", KeyType.BOOL, default=True,
+        scope=Scope.MASTER,
+        description="Continuously evaluate the declarative health "
+                    "rules (cluster doctor) over the metrics history; "
+                    "verdicts at /api/v1/master/health and `fsadmin "
+                    "report health`.")
+    MASTER_HEALTH_EVAL_INTERVAL = _k(
+        "atpu.master.health.eval.interval", KeyType.DURATION,
+        default="10s", scope=Scope.MASTER,
+        description="Period of the master's health-rule evaluation "
+                    "heartbeat.")
+    MASTER_HEALTH_STALL_THRESHOLD = _k(
+        "atpu.master.health.stall.threshold", KeyType.FLOAT, default=0.5,
+        scope=Scope.MASTER,
+        description="InputBoundFraction above this (sustained over the "
+                    "stall window) fires the input-stall alert.")
+    MASTER_HEALTH_STALL_WINDOW = _k(
+        "atpu.master.health.stall.window", KeyType.DURATION,
+        default="60s", scope=Scope.MASTER,
+        description="Evidence window the input-stall rule averages "
+                    "over.")
+    MASTER_HEALTH_FIRE_AFTER = _k(
+        "atpu.master.health.fire.after", KeyType.DURATION, default="30s",
+        scope=Scope.MASTER,
+        description="Debounce: a rule must stay violated this long "
+                    "before its alert moves pending -> firing.")
+    MASTER_HEALTH_RESOLVE_AFTER = _k(
+        "atpu.master.health.resolve.after", KeyType.DURATION,
+        default="60s", scope=Scope.MASTER,
+        description="Debounce: a firing alert must stay clean this "
+                    "long before it resolves.")
     METRICS_SINKS = _k(
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,

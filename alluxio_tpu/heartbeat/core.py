@@ -28,6 +28,7 @@ class HeartbeatContext:
     MASTER_JOURNAL_SPACE_MONITOR = "Master.JournalSpaceMonitor"
     MASTER_TABLE_TRANSFORM_MONITOR = "Master.TableTransformMonitor"
     MASTER_METRICS_SINKS = "Master.MetricsSinks"
+    MASTER_HEALTH_CHECK = "Master.HealthCheck"
     MASTER_UPDATE_CHECK = "Master.UpdateCheck"
     WORKER_METRICS_SINKS = "Worker.MetricsSinks"
     WORKER_BLOCK_SYNC = "Worker.BlockSync"

@@ -116,6 +116,11 @@ class BlockMaster(Journaled):
         self._lost_blocks: Set[int] = set()
         #: listeners fired on worker loss (elastic re-replication hook)
         self.lost_worker_listeners: List = []
+        #: listeners fired on full (re-)registration — the only signal
+        #: that a lost worker is genuinely back serving blocks (its
+        #: metrics heartbeat alone is not: a worker whose block-sync
+        #: thread is wedged keeps shipping metrics while serving nothing)
+        self.registered_worker_listeners: List = []
 
     #: container ids are journaled as a high-water mark in chunks of this
     #: size: one BLOCK_CONTAINER_ID entry covers the next N allocations,
@@ -216,6 +221,11 @@ class BlockMaster(Journaled):
                     else:
                         # master doesn't know this block -> tell worker to drop
                         info.to_remove_blocks.add(bid)
+        for listener in self.registered_worker_listeners:
+            try:
+                listener(info)
+            except Exception:  # noqa: BLE001
+                pass
 
     def worker_heartbeat(self, worker_id: int,
                          used_bytes_on_tiers: Dict[str, int],

@@ -131,6 +131,9 @@ class MasterProcess:
             conf.get(Keys.MASTER_MOUNT_TABLE_ROOT_UFS) or \
             conf.get(Keys.HOME) + "/underFSStorage"
         self.rpc_server: Optional[RpcServer] = None
+        self.metrics_master = None
+        self.health_monitor = None
+        self._worker_lost_listener_installed = False
         self.web_server = None
         self.update_checker = None
         self.web_port: Optional[int] = None
@@ -175,6 +178,7 @@ class MasterProcess:
         self._safe_mode_until = time.monotonic() + self._conf.get_duration_s(
             Keys.MASTER_SAFEMODE_WAIT)
         metrics("Master")
+        self._init_metrics_master()
         self._start_heartbeats()
         from alluxio_tpu.security.audit import AsyncAuditLogWriter
         from alluxio_tpu.security.authentication import Authenticator
@@ -190,13 +194,11 @@ class MasterProcess:
             self.fs_master, active_sync=self.active_sync,
             audit_writer=self.audit_writer))
         self.rpc_server.add_service(block_master_service(self.block_master))
-        from alluxio_tpu.master.metrics_master import MetricsMaster
         from alluxio_tpu.rpc.table_service import table_master_service
 
         self.rpc_server.add_service(table_master_service(
             self.table_master,
             permission_checker=self.permission_checker))
-        self.metrics_master = MetricsMaster()
         self.rpc_server.add_service(meta_master_service(
             self._conf, cluster_id=self.cluster_id,
             start_time_ms=self.start_time_ms,
@@ -204,7 +206,8 @@ class MasterProcess:
             path_properties=self.path_properties,
             config_checker=self.config_checker,
             permission_checker=self.permission_checker,
-            metrics_master=self.metrics_master))
+            metrics_master=self.metrics_master,
+            health_monitor=self.health_monitor))
         self.rpc_port = self.rpc_server.start()
         if self._conf.get_bool(Keys.MASTER_FASTPATH_ENABLED):
             from alluxio_tpu.rpc.fastpath import (
@@ -227,6 +230,148 @@ class MasterProcess:
                 bind_host=self._conf.get(Keys.MASTER_WEB_BIND_HOST))
             self.web_port = self.web_server.start()
         return self.rpc_port
+
+    def _init_metrics_master(self) -> None:
+        """Metrics history + health-rule engine (cluster doctor),
+        assembled before the heartbeats that tick them.  A lost worker
+        leaves the aggregates immediately: its snapshot is cleared and
+        its history series get an explicit end marker instead of
+        lingering for the source TTL."""
+        conf = self._conf
+        from alluxio_tpu.master.metrics_master import (
+            MetricsMaster, MetricsStore,
+        )
+
+        max_sources = conf.get_int(Keys.MASTER_METRICS_MAX_SOURCES)
+        store = MetricsStore(max_sources=max_sources)
+        history = None
+        if conf.get_bool(Keys.MASTER_METRICS_HISTORY_ENABLED):
+            import math
+
+            from alluxio_tpu.metrics.history import MetricsHistory
+
+            prefixes = tuple(
+                p.strip() for p in str(conf.get(
+                    Keys.MASTER_METRICS_HISTORY_ALLOW_PREFIXES)).split(",")
+                if p.strip())
+            # bound the offer queue by what can actually accumulate
+            # between two drain ticks under the operator's conf: one
+            # offer per source per report interval, over the drain
+            # (health-eval) period, 2x for interval jitter — a raised
+            # source cap or a slowed eval interval must not turn into
+            # silent per-cycle tick drops
+            report_s = max(0.001, min(
+                conf.get_duration_s(Keys.WORKER_METRICS_HEARTBEAT_INTERVAL),
+                conf.get_duration_s(Keys.USER_METRICS_HEARTBEAT_INTERVAL)))
+            drains_behind = max(1, math.ceil(conf.get_duration_s(
+                Keys.MASTER_HEALTH_EVAL_INTERVAL) / report_s))
+            history = MetricsHistory(
+                capacity=conf.get_int(Keys.MASTER_METRICS_HISTORY_CAPACITY),
+                retention_s=conf.get_duration_s(
+                    Keys.MASTER_METRICS_HISTORY_RETENTION),
+                max_series=conf.get_int(
+                    Keys.MASTER_METRICS_HISTORY_MAX_SERIES),
+                allow_prefixes=prefixes,
+                pending_max=2 * max_sources * drains_behind)
+            reg = metrics()
+            reg.register_gauge("Master.MetricsHistorySeries",
+                               lambda: float(history.series_count()))
+            reg.register_gauge("Master.MetricsHistorySamplesDropped",
+                               lambda: float(history.dropped_samples))
+            reg.register_gauge("Master.MetricsHistoryTicksDropped",
+                               lambda: float(history.dropped_ticks))
+        self.metrics_master = MetricsMaster(store=store, history=history)
+        self.health_monitor = None
+        if conf.get_bool(Keys.MASTER_HEALTH_ENABLED):
+            from alluxio_tpu.master.health import (
+                HealthMonitor, default_rules,
+            )
+
+            rules = default_rules(
+                stall_threshold=conf.get_float(
+                    Keys.MASTER_HEALTH_STALL_THRESHOLD),
+                stall_window_s=conf.get_duration_s(
+                    Keys.MASTER_HEALTH_STALL_WINDOW))
+            if history is None:
+                # don't advertise rules that silently no-op without
+                # the history store: the report must only list rules
+                # that are genuinely watching
+                dropped = [r.name for r in rules if r.needs_history]
+                rules = [r for r in rules if not r.needs_history]
+                LOG.warning(
+                    "health enabled without metrics history "
+                    "(atpu.master.metrics.history.enabled=false): "
+                    "rules %s are disabled, only %s remain active",
+                    dropped, [r.name for r in rules])
+            def _expected_worker_sources():
+                # LIVE registered workers only (a lost worker is the
+                # worker-lost rule's business) with time since their
+                # LAST registration (stamped by the listener below) —
+                # NOT start_time_ms, which survives loss/recovery and
+                # would false-fire the missing-source staleness alert
+                # for the whole grace window after every routine
+                # worker re-registration.  Unknown sources read as
+                # age 0 (alert suppressed): conservative until their
+                # registration is observed.
+                now = time.time()
+                reg = self._worker_registered_at
+                out = []
+                for i in self.block_master.get_worker_infos():
+                    src = f"worker-{i.address.host}:" \
+                          f"{i.address.rpc_port}"
+                    at = reg.get(src)
+                    out.append((src, max(0.0, now - at)
+                                if at is not None else 0.0))
+                return out
+
+            self.health_monitor = HealthMonitor(
+                self.metrics_master,
+                rules=rules,
+                fire_after_s=conf.get_duration_s(
+                    Keys.MASTER_HEALTH_FIRE_AFTER),
+                resolve_after_s=conf.get_duration_s(
+                    Keys.MASTER_HEALTH_RESOLVE_AFTER),
+                eval_interval_s=conf.get_duration_s(
+                    Keys.MASTER_HEALTH_EVAL_INTERVAL),
+                worker_sources_fn=_expected_worker_sources)
+
+        # source -> wall time of its last full registration; reset on
+        # (re-)init conservatively — ages restart at 0, which only
+        # delays the missing-source staleness alert by its grace
+        self._worker_registered_at = {}
+
+        def _on_worker_lost(info) -> None:
+            source = f"worker-{info.address.host}:{info.address.rpc_port}"
+            self._worker_registered_at.pop(source, None)
+            # block=True: a lost-but-chatty worker's metrics heartbeats
+            # must not re-admit its snapshot into Cluster.* aggregates
+            self.metrics_master.store.clear_source(source, block=True)
+            if self.metrics_master.history is not None:
+                # fold still-queued offers first so a pre-death
+                # heartbeat drained later cannot clear the end marker
+                self.metrics_master.drain_history()
+                self.metrics_master.history.end_source(source)
+
+        def _on_worker_registered(info) -> None:
+            # full block-list re-registration is the only revival
+            # signal: metrics heartbeats alone must not clear the end
+            # marker or unblock the store (a lost worker with a wedged
+            # block-sync thread still ships metrics while serving
+            # nothing)
+            source = f"worker-{info.address.host}:{info.address.rpc_port}"
+            self._worker_registered_at[source] = time.time()
+            self.metrics_master.store.unblock_source(source)
+            if self.metrics_master.history is not None:
+                self.metrics_master.history.revive_source(source)
+
+        # once per process: _start_serving re-runs on every HA
+        # re-promotion, and the closures resolve self.metrics_master at
+        # call time, so a second registration would only duplicate work
+        if not self._worker_lost_listener_installed:
+            self.block_master.lost_worker_listeners.append(_on_worker_lost)
+            self.block_master.registered_worker_listeners.append(
+                _on_worker_registered)
+            self._worker_lost_listener_installed = True
 
     def _start_heartbeats(self) -> None:
         conf = self._conf
@@ -262,6 +407,19 @@ class MasterProcess:
                 _Exec(self.ufs_cleaner.heartbeat),
                 conf.get_duration_s(Keys.MASTER_UFS_CLEANUP_INTERVAL)),
         ]
+        if self.health_monitor is not None:
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.MASTER_HEALTH_CHECK,
+                _Exec(self.health_monitor.evaluate),
+                conf.get_duration_s(Keys.MASTER_HEALTH_EVAL_INTERVAL)))
+        elif self.metrics_master.history is not None:
+            # health disabled but history on: its evaluate() normally
+            # drains the pending offers, so tick the drain directly or
+            # the bounded pending queue overflows between queries
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.MASTER_HEALTH_CHECK,
+                _Exec(self.metrics_master.drain_history),
+                conf.get_duration_s(Keys.MASTER_HEALTH_EVAL_INTERVAL)))
         if conf.get_bool(Keys.MASTER_UPDATE_CHECK_ENABLED):
             url = conf.get(Keys.MASTER_UPDATE_CHECK_URL) or ""
             if not url:

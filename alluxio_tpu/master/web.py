@@ -40,10 +40,12 @@ def _dashboard_html() -> bytes:
         "alluxio-tpu master", "/api/v1/master",
         sections=[("Cluster", "info"), ("Workers", "workers"),
                   ("Mounts", "mounts"), ("Catalog", "catalog"),
+                  ("Cluster health", "health"),
                   ("Input doctor", "stall")],
         raw_routes=["/api/v1/master/info", "/capacity", "/metrics",
-                    "/mounts", "/catalog", "/trace",
-                    "/browse", "/config", "/logs"],
+                    "/metrics/history", "/health", "/mounts",
+                    "/catalog", "/trace", "/browse", "/config",
+                    "/logs"],
         js_body="""
     const info = await j('/info');
     const t = document.getElementById('info');
@@ -66,6 +68,17 @@ def _dashboard_html() -> bytes:
     row(ct, ['database','tables'], true);
     for (const [db, tables] of Object.entries(c.databases))
       row(ct, [db, tables.join(', ')]);
+    // cluster doctor: ranked verdicts from the health-rule engine
+    const h = await j('/health');
+    const ht = document.getElementById('health');
+    row(ht, ['status: ' + h.status, '', '', ''], true);
+    row(ht, ['severity', 'rule', 'subject', 'verdict'], true);
+    for (const a of h.alerts)
+      row(ht, [a.severity, a.rule, a.subject,
+               a.summary + ' — ' + a.remediation]);
+    if (!h.alerts.length)
+      row(ht, ['(no alerts firing — ' + h.rules.length +
+               ' rules watching)', '', '', '']);
     // input doctor: rank loader input waits by serving tier
     // (Cluster.* roll-up when clients report, else this process's own)
     const met = (await j('/metrics')).metrics;
@@ -251,6 +264,19 @@ class MasterWebServer:
                     if mm is not None:
                         snap = mm.merged_snapshot(snap)
                     return {"metrics": snap}
+                if route == "/api/v1/master/metrics/history":
+                    mm = getattr(mp, "metrics_master", None)
+                    if mm is None or mm.history is None:
+                        return {"error": "metrics history is disabled",
+                                "series": [], "names": []}
+                    return mm.history_report(self.query)
+                if route == "/api/v1/master/health":
+                    hm = getattr(mp, "health_monitor", None)
+                    if hm is None:
+                        return {"status": "DISABLED", "alerts": [],
+                                "pending": [], "recently_resolved": [],
+                                "rules": []}
+                    return hm.fresh_report()
                 if route == "/api/v1/master/mounts":
                     return {"mounts": [
                         {"path": m.alluxio_path, "ufs": m.ufs_uri,

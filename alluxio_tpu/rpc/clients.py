@@ -387,6 +387,24 @@ class MetaMasterClient(_BaseClient):
                                          "metrics": metrics,
                                          "spans": spans or []})
 
+    def get_metrics_history(self, name: str = "", *, source: str = "",
+                            resolution: str = "raw", since: float = 0.0,
+                            rate: bool = False, limit: int = 0,
+                            prefix: str = "") -> dict:
+        """Time-resolved metric series from the master's history store.
+        No ``name`` -> ``{"names": [...], "stats": {...}}``; with one ->
+        ``{"series": [{source, name, resolution, points, ended_at}],
+        "stats": {...}}``."""
+        return self._call("get_metrics_history", {
+            "name": name, "source": source, "resolution": resolution,
+            "since": since, "rate": rate, "limit": limit,
+            "prefix": prefix})
+
+    def get_health(self, *, evaluate: bool = True) -> dict:
+        """Ranked alerts from the master's health-rule engine
+        (cluster doctor)."""
+        return self._call("get_health", {"evaluate": evaluate})
+
     def get_config_report(self) -> dict:
         return self._call("get_config_report", {})
 

@@ -252,7 +252,8 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         path_properties=None,
                         config_checker=None,
                         permission_checker=None,
-                        metrics_master=None) -> ServiceDefinition:
+                        metrics_master=None,
+                        health_monitor=None) -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
     config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
@@ -352,8 +353,36 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
             return metrics_master.handle_heartbeat(r)
         return {}
 
+    def _get_metrics_history(r):
+        """Time-resolved series out of the master's history store
+        (`fsadmin report history`, /api/v1/master/metrics/history).
+        Without a ``name`` it lists the recorded metric names + store
+        stats; with one it returns matching series at the requested
+        resolution, optionally derived as a per-second rate."""
+        from alluxio_tpu.utils.exceptions import FailedPreconditionError
+
+        if metrics_master is None or metrics_master.history is None:
+            raise FailedPreconditionError(
+                "metrics history is disabled on this master "
+                "(atpu.master.metrics.history.enabled)")
+        return metrics_master.history_report(r)
+
+    def _get_health(r):
+        """Ranked health verdicts from the continuous rule engine.
+        ``evaluate`` (default true) runs a fresh evaluation pass first
+        so the report never serves a stale lifecycle state."""
+        from alluxio_tpu.utils.exceptions import FailedPreconditionError
+
+        if health_monitor is None:
+            raise FailedPreconditionError(
+                "the health-rule engine is disabled on this master "
+                "(atpu.master.health.enabled)")
+        return health_monitor.fresh_report(bool(r.get("evaluate", True)))
+
     svc.unary("get_metrics", _get_metrics)
     svc.unary("metrics_heartbeat", _metrics_heartbeat)
+    svc.unary("get_metrics_history", _get_metrics_history)
+    svc.unary("get_health", _get_health)
 
     def _checkpoint(r):
         _require_admin()

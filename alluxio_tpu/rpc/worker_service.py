@@ -30,6 +30,13 @@ from alluxio_tpu.worker.ufs_io import UfsBlockDescriptor
 WORKER_SERVICE = "atpu.BlockWorker"
 
 DEFAULT_CHUNK = 1 << 20
+#: Worker.ReadBlockTime (per-MiB warm produce time, feeds the
+#: read-latency-p99-regression health rule) is only sampled for reads
+#: of at least this many bytes served in chunks of at least this size:
+#: below either bound, the fixed per-read-call cost dominates the
+#: normalized figure and bills a client's configuration to the host
+P99_SAMPLE_MIN_BYTES = 1 << 18
+P99_SAMPLE_MIN_CHUNK = 1 << 16
 
 
 class _LeaseRegistry:
@@ -69,9 +76,27 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
     def read_block(req: dict) -> Iterator[dict]:
         """Chunks carry ``source`` — the serving tier alias (MEM/SSD/...)
         or ``UFS`` for a cold read-through — so clients can attribute
-        every byte to the tier that produced it (input doctor)."""
+        every byte to the tier that produced it (input doctor).
+        Warm serving speed is timed into ``Worker.ReadBlockTime``: its
+        per-worker ``.p99`` rides the metrics heartbeat and is what the
+        master's read-latency-regression health rule compares against
+        the fleet median, so the sample must isolate *this host's*
+        serving speed — only the tier ``r.read`` calls are timed (per-
+        chunk RPC framing is excluded, or a client's small-chunk config
+        would inflate this host's number), one sample per stream
+        normalized to seconds-per-MiB, excluding yield suspension (the
+        client paces its own drain), the post-last-chunk cache-fill
+        commit wait, and UFS-sourced chunks (cold read-through latency
+        is the UFS's, tracked by ``Worker.UfsFetch*``).  One generator,
+        no wrapper: stream cancel (hedged remote reads cancel losers
+        routinely) closes it directly, the ``with`` releases the block
+        reader's eviction pin NOW, and the ``finally`` still records
+        the partial progress."""
+        import time as _time
+
         from alluxio_tpu.metrics import metrics
 
+        clock = _time.monotonic
         block_id = req["block_id"]
         offset = req.get("offset", 0)
         length = req.get("length", -1)
@@ -80,18 +105,38 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
         chunk = max(1, req.get("chunk_size", DEFAULT_CHUNK))
         m = metrics()
         if worker.store.has_block(block_id):
-            with worker.open_reader(block_id) as r:
-                tier = r.tier_alias or "MEM"
-                m.counter(f"Worker.BlocksServed.{tier}").inc()
-                served = m.counter(f"Worker.BytesServed.{tier}")
-                end = r.length if length < 0 else min(r.length, offset + length)
-                pos = offset
-                while pos < end:  # the reference's hot loop
-                    n = min(chunk, end - pos)
-                    yield {"data": r.read(pos, n), "offset": pos,
-                           "source": tier}
-                    served.inc(n)
-                    pos += n
+            produce_s = 0.0
+            produced_b = 0
+            try:
+                with worker.open_reader(block_id) as r:
+                    tier = r.tier_alias or "MEM"
+                    m.counter(f"Worker.BlocksServed.{tier}").inc()
+                    served = m.counter(f"Worker.BytesServed.{tier}")
+                    end = r.length if length < 0 \
+                        else min(r.length, offset + length)
+                    pos = offset
+                    while pos < end:  # the reference's hot loop
+                        n = min(chunk, end - pos)
+                        t0 = clock()
+                        data = r.read(pos, n)
+                        produce_s += clock() - t0
+                        produced_b += len(data)
+                        yield {"data": data, "offset": pos,
+                               "source": tier}
+                        served.inc(n)
+                        pos += n
+            finally:
+                # sample only reads whose per-MiB figure the fixed
+                # per-read-call overhead cannot skew: a client-chosen
+                # tiny chunk size multiplies that fixed cost into
+                # ms/MiB (1 KiB chunks = 1024 calls/MiB), and a tiny
+                # read scales one call's cost by up to 2^20/bytes —
+                # either would false-fire the p99 fleet-regression
+                # rule against a healthy host
+                if produced_b >= P99_SAMPLE_MIN_BYTES and \
+                        chunk >= P99_SAMPLE_MIN_CHUNK:
+                    m.timer("Worker.ReadBlockTime").update(
+                        produce_s * ((1 << 20) / produced_b))
             return
         ufs = req.get("ufs")
         if not ufs:

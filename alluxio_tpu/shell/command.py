@@ -211,6 +211,25 @@ def human_size(n: float) -> str:
     return f"{n:.2f}PB"
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 60) -> str:
+    """ASCII(ish) sparkline of a numeric series, last ``width`` points
+    (`fsadmin report history`).  Flat series render as a low bar, not a
+    divide-by-zero."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(vals)
+    top = len(_SPARK_BLOCKS) - 1
+    return "".join(_SPARK_BLOCKS[int(round((v - lo) / span * top))]
+                   for v in vals)
+
+
 def mode_string(info: FileInfo) -> str:
     kind = "d" if info.folder else "-"
     bits = ""

@@ -12,7 +12,7 @@ import time
 
 from alluxio_tpu.conf import Source
 from alluxio_tpu.shell.command import (
-    Command, CommandError, Shell, human_size,
+    Command, CommandError, Shell, human_size, sparkline,
 )
 
 ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
@@ -22,14 +22,42 @@ ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 class ReportCommand(Command):
     name = "report"
     description = ("Report cluster summary|capacity|ufs|metrics|"
-                   "jobservice|stall.")
+                   "jobservice|stall|history|health.")
 
     def configure(self, p):
         p.add_argument("category", nargs="?", default="summary",
                        choices=["summary", "capacity", "ufs", "metrics",
-                                "jobservice", "stall"])
+                                "jobservice", "stall", "history",
+                                "health"])
+        p.add_argument("metric", nargs="?", default="",
+                       help="history: metric name (omit to list "
+                            "recorded names)")
+        p.add_argument("--source", default="",
+                       help="history: only this reporting source")
+        p.add_argument("--resolution", default="raw",
+                       choices=["raw", "1m", "10m"],
+                       help="history: sample tier to print")
+        p.add_argument("--rate", action="store_true",
+                       help="history: derive a per-second rate "
+                            "(counters)")
 
     def run(self, args, ctx):
+        if args.category == "history":
+            return self._history(ctx, args)
+        # history-only arguments must not be silently swallowed for the
+        # other categories (`report metrics Worker.X` is a usage error,
+        # not the full unfiltered dump)
+        extras = [what for what, given in (
+            (f"metric '{args.metric}'", args.metric),
+            ("--source", args.source),
+            ("--resolution", args.resolution != "raw"),
+            ("--rate", args.rate)) if given]
+        if extras:
+            ctx.eprint(f"report {args.category} does not take "
+                       f"{', '.join(extras)} (history-only)")
+            return 2
+        if args.category == "health":
+            return self._health(ctx, args)
         return getattr(self, f"_{args.category}")(ctx)
 
     def _summary(self, ctx):
@@ -100,7 +128,126 @@ class ReportCommand(Command):
         snap = ctx.meta_client().get_metrics()
         for k in sorted(snap):
             ctx.print(f"{k}  {snap[k]}")
+        dropped = snap.get("Master.MetricsReportsDropped", 0)
+        if dropped:
+            ctx.print(f"WARN: {int(dropped)} metric reports dropped by "
+                      f"the source cap — raise "
+                      f"atpu.master.metrics.max.sources or hunt the "
+                      f"source-name flood")
+        blocked = snap.get("Master.MetricsReportsBlocked", 0)
+        if blocked:
+            ctx.print(f"WARN: {int(blocked)} metric reports refused "
+                      f"from lost workers that never re-registered — "
+                      f"run `fsadmin report health` and restart or "
+                      f"remove the dead workers")
         return 0
+
+    def _history(self, ctx, args):
+        """Time-resolved view of one metric: ASCII sparkline over the
+        requested resolution plus a rollup table per reporting
+        source."""
+        mc = ctx.meta_client()
+        if not args.metric:
+            # same no-silent-swallow rule as run() applies across
+            # categories: list mode ignores the series filters, so
+            # accepting them would print the full unfiltered name
+            # list as if they had applied
+            extras = [what for what, given in (
+                ("--source", args.source),
+                ("--resolution", args.resolution != "raw"),
+                ("--rate", args.rate)) if given]
+            if extras:
+                ctx.eprint(f"report history without a metric name "
+                           f"lists recorded metrics and does not take "
+                           f"{', '.join(extras)}")
+                return 2
+            resp = mc.get_metrics_history()
+            st = resp.get("stats", {})
+            ctx.print(f"{len(resp.get('names', []))} metrics recorded "
+                      f"({st.get('series', 0)}/{st.get('max_series', 0)}"
+                      f" series, {st.get('points', 0)} points)")
+            for n in resp.get("names", []):
+                ctx.print(f"    {n}")
+            if st.get("dropped_samples"):
+                ctx.print(f"WARN: {st['dropped_samples']} samples "
+                          f"dropped by the series cap/allowlist")
+            return 0
+        resp = mc.get_metrics_history(
+            args.metric, source=args.source,
+            resolution=args.resolution, rate=args.rate)
+        series = resp.get("series", [])
+        if not series:
+            ctx.print(f"no history recorded for '{args.metric}'"
+                      + (f" from source '{args.source}'"
+                         if args.source else ""))
+            return 1
+        suffix = "/s" if args.rate else ""
+        for s in series:
+            pts = s["points"]
+            if s["resolution"] == "raw" or args.rate:
+                values = [v for _, v in pts]
+            else:
+                values = [b["mean"] for b in pts]
+            head = (f"{s['name']} [{s['source']}] "
+                    f"({s['resolution']}, {len(pts)} points)")
+            if s.get("ended_at"):
+                head += "  [source ENDED — worker lost]"
+            ctx.print(head)
+            if not values:
+                ctx.print("    (empty window)")
+                continue
+            ctx.print(f"    {sparkline(values)}")
+            if s["resolution"] == "raw" or args.rate:
+                lo, hi, last = min(values), max(values), values[-1]
+            else:
+                # true per-bucket extremes and final value, not the
+                # means the sparkline plots — a one-bucket spike must
+                # not understate the headline max, and the headline
+                # last must match the rollup table's last column below
+                lo = min(b["min"] for b in pts)
+                hi = max(b["max"] for b in pts)
+                last = pts[-1]["last"]
+            ctx.print(f"    min={lo:.4g}{suffix} "
+                      f"max={hi:.4g}{suffix} "
+                      f"last={last:.4g}{suffix}")
+            if s["resolution"] != "raw" and not args.rate:
+                ctx.print(f"    {'bucket':<21s} {'count':>6s} "
+                          f"{'mean':>10s} {'min':>10s} {'max':>10s} "
+                          f"{'last':>10s}")
+                for b in pts[-12:]:
+                    when = time.strftime("%m-%d %H:%M:%S",
+                                         time.localtime(b["ts"]))
+                    ctx.print(f"    {when:<21s} {b['count']:>6d} "
+                              f"{b['mean']:>10.4g} {b['min']:>10.4g} "
+                              f"{b['max']:>10.4g} {b['last']:>10.4g}")
+        return 0
+
+    def _health(self, ctx, args):
+        """Ranked verdicts from the master's continuous health-rule
+        engine (the cluster doctor)."""
+        resp = ctx.meta_client().get_health()
+        ctx.print(f"Cluster health: {resp['status']}")
+        alerts = resp.get("alerts", [])
+        for a in alerts:
+            dur = ""
+            if a.get("fired_at") and resp.get("evaluated_at"):
+                dur = (f" (firing "
+                       f"{max(0, resp['evaluated_at'] - a['fired_at']):.0f}s)")
+            ctx.print(f"  [{a['severity'].upper()}] {a['rule']} "
+                      f"on {a['subject']}{dur}")
+            ctx.print(f"      {a['summary']}")
+            ctx.print(f"      value {a['value']:.4g} vs threshold "
+                      f"{a['threshold']:.4g} over {a['window_s']:.0f}s")
+            ctx.print(f"      remediation: {a['remediation']}")
+        for a in resp.get("pending", []):
+            ctx.print(f"  [pending] {a['rule']} on {a['subject']}: "
+                      f"{a['summary']}")
+        for a in resp.get("recently_resolved", []):
+            ctx.print(f"  [resolved] {a['rule']} on {a['subject']}")
+        if not alerts:
+            ctx.print(f"  no alerts firing — "
+                      f"{len(resp.get('rules', []))} rules watching")
+        return 0 if resp["status"] in ("OK", "WARN") else 1
 
     def _stall(self, ctx):
         """Input doctor: ranked per-tier attribution of loader input

@@ -95,6 +95,19 @@ def build_parser() -> argparse.ArgumentParser:
     ob.add_argument("--max-overhead-pct", type=float, default=2.0,
                     help="fail the bench above this tracing overhead")
 
+    he = sub.add_parser("health", help="metrics-history ingestion "
+                                       "overhead on the heartbeat hot "
+                                       "path (fake-clock harness)")
+    he.add_argument("--sources", type=int, default=64)
+    he.add_argument("--metrics-per-source", type=int, default=120,
+                    help="snapshot size per heartbeat (a live worker "
+                         "ships ~100-150 entries once timers expand)")
+    he.add_argument("--ticks", type=int, default=40)
+    he.add_argument("--batches", type=int, default=8)
+    he.add_argument("--max-overhead-pct", type=float, default=5.0,
+                    help="fail the bench above this heartbeat-handling "
+                         "overhead with history enabled")
+
     uc = sub.add_parser("ufscold", help="striped vs single-stream cold "
                                         "UFS reads (connection-limited "
                                         "UFS model)")
@@ -174,6 +187,7 @@ SUITE = (
     ("table-projection", ["table"]),
     ("write-eviction", ["write"]),
     ("obs-tracing-overhead", ["obs"]),
+    ("health-ingest-overhead", ["health"]),
     ("ufs-cold-read", ["ufscold"]),
     ("remote-warm-read", ["remoteread"]),
 )
@@ -337,6 +351,13 @@ def main(argv=None) -> int:
         r = run(file_mb=args.file_mb, reads=args.reads,
                 batches=args.batches,
                 span_iterations=args.span_iterations,
+                max_overhead_pct=args.max_overhead_pct)
+    elif args.bench == "health":
+        from alluxio_tpu.stress.health_bench import run
+
+        r = run(sources=args.sources,
+                metrics_per_source=args.metrics_per_source,
+                ticks=args.ticks, batches=args.batches,
                 max_overhead_pct=args.max_overhead_pct)
     elif args.bench == "ufscold":
         from alluxio_tpu.stress.ufs_cold_bench import run

@@ -1,0 +1,857 @@
+"""Metrics history + health-rule engine (PR 5: the time dimension of
+observability).
+
+Unit layers run on deterministic fake clocks — no sleeps: ring+rollup
+downsampling must preserve sums/means and respect capacity under
+arbitrary sample streams; the alert lifecycle must debounce.  The
+minicluster layer drives the acceptance path end to end: heartbeat ->
+history series -> injected stall -> rule fires -> `fsadmin report
+health` verdict -> condition clears -> alert resolves, with memory
+staying bounded under a cardinality flood.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import random
+import time
+import urllib.request
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.master.health import (
+    HealthMonitor, HealthRule, Violation, default_rules,
+)
+from alluxio_tpu.master.metrics_master import MetricsMaster, MetricsStore
+from alluxio_tpu.metrics.history import MetricsHistory, derive_rate
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+
+class _Clock:
+    def __init__(self, t: float = 1_000_000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _history(clock, **kw):
+    kw.setdefault("capacity", 512)
+    kw.setdefault("retention_s", 86400.0)
+    return MetricsHistory(clock=clock, **kw)
+
+
+class TestRingAndRollups:
+    def test_rollup_sums_and_means_preserved_under_arbitrary_streams(self):
+        """Property: for ANY sample stream (no eviction), every 1m/10m
+        bucket's sum/count/mean must equal the same aggregate computed
+        from the raw points that fell into it."""
+        rng = random.Random(1234)
+        for trial in range(5):
+            clock = _Clock()
+            h = _history(clock, capacity=4096)
+            samples = []
+            for _ in range(rng.randrange(50, 400)):
+                clock.t += rng.uniform(0.1, 45.0)
+                v = rng.uniform(-100.0, 100.0)
+                samples.append((clock.t, v))
+                h.ingest("src", {"Worker.X": v})
+            for resolution, width in (("1m", 60.0), ("10m", 600.0)):
+                [series] = h.query("Worker.X", resolution=resolution)
+                expected: dict = {}
+                for t, v in samples:
+                    expected.setdefault(t - (t % width), []).append(v)
+                got = {b["ts"]: b for b in series["points"]}
+                assert set(got) == set(expected)
+                for start, vals in expected.items():
+                    b = got[start]
+                    assert b["count"] == len(vals)
+                    assert b["sum"] == pytest.approx(sum(vals))
+                    assert b["mean"] == pytest.approx(
+                        sum(vals) / len(vals))
+                    assert b["min"] == pytest.approx(min(vals))
+                    assert b["max"] == pytest.approx(max(vals))
+                    assert b["last"] == pytest.approx(vals[-1])
+
+    def test_capacity_respected_and_order_preserved_across_wrap(self):
+        clock = _Clock()
+        h = _history(clock, capacity=16)
+        for i in range(100):  # > 6x wrap
+            clock.t += 1.0
+            h.ingest("s", {"Worker.N": float(i)})
+        [series] = h.query("Worker.N")
+        pts = series["points"]
+        assert len(pts) == 16  # hard bound
+        assert [v for _, v in pts] == [float(i) for i in range(84, 100)]
+        ts = [t for t, _ in pts]
+        assert ts == sorted(ts)
+
+    def test_retention_prunes_raw_but_rollups_survive_longer(self):
+        clock = _Clock()
+        h = _history(clock, capacity=4096, retention_s=100.0)
+        h.ingest("s", {"Worker.Old": 1.0})
+        clock.t += 500.0  # way past raw retention, inside 1m horizon
+        h.ingest("s", {"Worker.Old": 2.0})
+        [series] = h.query("Worker.Old")
+        assert [v for _, v in series["points"]] == [2.0]
+        [r1] = h.query("Worker.Old", resolution="1m")
+        assert len(r1["points"]) == 2  # 10x retention keeps the old one
+
+    def test_counter_rate_derivation_clamps_resets(self):
+        pts = [(0.0, 100.0), (10.0, 200.0), (20.0, 5.0), (30.0, 65.0)]
+        rates = derive_rate(pts)
+        assert rates == [(10.0, 10.0), (20.0, 0.0), (30.0, 6.0)]
+
+    def test_query_rate_from_rollups_uses_last(self):
+        clock = _Clock(1_000_000.0 - 1_000_000.0 % 600)
+        h = _history(clock)
+        for i in range(4):
+            h.ingest("s", {"Worker.C": float(100 * i)})
+            clock.t += 60.0
+        [series] = h.query("Worker.C", resolution="1m", rate=True)
+        for _, r in series["points"]:
+            assert r == pytest.approx(100.0 / 60.0)
+
+
+class TestCardinalityBounds:
+    def test_allowlist_blocks_bogus_name_flood(self):
+        clock = _Clock()
+        h = _history(clock, max_series=100)
+        h.ingest("evil", {f"bogus{i}": 1.0 for i in range(5000)})
+        assert h.series_count() == 0
+        h.ingest("good", {"Worker.Real": 1.0})
+        assert h.series_count() == 1
+
+    def test_max_series_cap_counts_drops(self):
+        clock = _Clock()
+        h = _history(clock, max_series=50)
+        h.ingest("evil", {f"Worker.Flood{i}": 1.0 for i in range(500)})
+        assert h.series_count() == 50
+        assert h.stats()["dropped_samples"] == 450
+        # existing series still ingest fine at the cap
+        n = h.ingest("evil", {"Worker.Flood0": 2.0})
+        assert n == 1
+
+    def test_pending_queue_bounded(self):
+        clock = _Clock()
+        h = _history(clock, pending_max=4)
+        for i in range(10):
+            h.offer(f"s{i}", {"Worker.X": 1.0})
+        assert h.stats()["pending"] == 4
+        assert h.stats()["dropped_ticks"] == 6
+        h.drain()
+        assert h.stats()["pending"] == 0
+
+    def test_memory_stays_bounded_under_sustained_flood(self):
+        clock = _Clock()
+        h = _history(clock, capacity=8, max_series=20)
+        for tick in range(300):
+            clock.t += 5.0
+            h.ingest(f"w{tick % 7}",
+                     {f"Worker.M{i}": float(tick) for i in range(40)})
+        st = h.stats()
+        assert st["series"] <= 20
+        # 3 rings (raw + 1m + 10m) x capacity is the documented bound
+        assert st["points"] <= 20 * 3 * 8
+
+
+class TestSeriesReclamation:
+    """Dead sources must release their (source, metric) slots long
+    before the 10m rollup horizon (retention x 60), or short-lived
+    clients pin the whole ``max_series`` budget on dead data."""
+
+    def test_ended_series_release_slots_after_raw_retention(self):
+        clock = _Clock()
+        h = _history(clock, retention_s=100.0)
+        h.ingest("worker-a", {"Worker.X": 1.0})
+        h.end_source("worker-a")
+        clock.t += 101.0  # ended past one raw retention
+        h.ingest("worker-b", {"Worker.X": 1.0})  # triggers the sweep
+        assert h.sources_for("Worker.X") == ["worker-b"]
+
+    def test_idle_client_series_release_slots_without_end_event(self):
+        clock = _Clock()
+        h = _history(clock, retention_s=100.0)
+        h.ingest("client-job1", {"Client.BytesRead": 1.0})
+        clock.t += 201.0  # idle past 2x raw retention; no lost event
+        h.ingest("worker-b", {"Worker.X": 1.0})
+        # the 10m horizon alone (retention x 60) would have kept it
+        assert h.query("Client.BytesRead") == []
+
+    def test_cap_pressure_evicts_ended_series_for_live_sources(self):
+        clock = _Clock()
+        h = _history(clock, max_series=3)
+        h.ingest("w-dead",
+                 {"Worker.A": 1.0, "Worker.B": 1.0, "Worker.C": 1.0})
+        h.end_source("w-dead")
+        clock.t += 10.0  # well inside retention: the sweep won't help
+        n = h.ingest("w-live", {"Worker.A": 5.0, "Worker.B": 5.0})
+        assert n == 2  # accepted by evicting dead slots, not dropped
+        assert h.series_count() == 3
+        assert h.sources_for("Worker.A") == ["w-live"]
+        assert h.stats()["dropped_samples"] == 0
+
+    def test_cap_pressure_never_evicts_live_series(self):
+        clock = _Clock()
+        h = _history(clock, max_series=3)
+        h.ingest("w1", {"Worker.A": 1.0, "Worker.B": 1.0,
+                        "Worker.C": 1.0})
+        clock.t += 1.0
+        n = h.ingest("w2", {"Worker.A": 2.0})
+        assert n == 0
+        assert h.series_count() == 3
+        assert h.sources_for("Worker.A") == ["w1"]
+        assert h.stats()["dropped_samples"] == 1
+
+
+class TestEndMarker:
+    def test_end_source_marks_and_revival_clears(self):
+        clock = _Clock()
+        h = _history(clock)
+        h.ingest("worker-a:1", {"Worker.X": 1.0})
+        assert h.end_source("worker-a:1") == 1
+        [series] = h.query("Worker.X")
+        assert series["ended_at"] == clock.t
+        clock.t += 10.0
+        h.revive_source("worker-a:1")  # re-registered with the master
+        [series] = h.query("Worker.X")
+        assert series["ended_at"] is None
+
+    def test_metrics_arrival_alone_does_not_revive(self):
+        """A lost worker whose metrics heartbeat outlives its wedged
+        block-sync thread keeps shipping reports while serving nothing:
+        those reports must NOT clear the end marker — only a full
+        block-master re-registration (revive_source) does (review
+        finding)."""
+        clock = _Clock()
+        h = _history(clock)
+        h.ingest("worker-a:1", {"Worker.X": 1.0})
+        death = clock.t
+        h.end_source("worker-a:1")
+        clock.t += 10.0
+        h.ingest("worker-a:1", {"Worker.X": 2.0})  # lost but chatty
+        [series] = h.query("Worker.X")
+        assert series["ended_at"] == death
+        assert h.ended_sources() == {"worker-a:1": death}
+
+    def test_new_series_for_ended_source_inherits_marker(self):
+        """A series minted AFTER end_source (a metric name first seen
+        from a lost-but-chatty worker, or one recreated after the
+        retention sweep) must carry the end marker, not read as live
+        (review finding)."""
+        clock = _Clock()
+        h = _history(clock)
+        h.ingest("worker-a:1", {"Worker.X": 1.0})
+        death = clock.t
+        h.end_source("worker-a:1")
+        clock.t += 10.0
+        h.ingest("worker-a:1", {"Worker.NewTimer.p99": 0.5})
+        [series] = h.query("Worker.NewTimer.p99")
+        assert series["ended_at"] == death
+
+    def test_stale_queued_sample_does_not_clear_end_marker(self):
+        """A heartbeat snapshot that was stamped BEFORE the worker was
+        declared lost (it sat in the pending queue) must not un-end the
+        series when drained afterwards."""
+        clock = _Clock()
+        h = _history(clock)
+        h.ingest("worker-a:1", {"Worker.X": 1.0})
+        stale_ts = clock.t
+        clock.t += 10.0
+        h.end_source("worker-a:1")
+        h.ingest("worker-a:1", {"Worker.X": 2.0}, now=stale_ts)
+        [series] = h.query("Worker.X")
+        assert series["ended_at"] == clock.t
+
+    def test_ended_sources_outlive_snapshot_and_age_out(self):
+        """Source-level death marker (worker-lost rule): set by
+        end_source, immune to queued samples, cleared only by an
+        explicit revival, aged out with retention."""
+        clock = _Clock()
+        h = _history(clock, retention_s=3600.0)
+        h.ingest("worker-a:1", {"Worker.X": 1.0})
+        death = clock.t
+        h.end_source("worker-a:1")
+        assert h.ended_sources() == {"worker-a:1": death}
+        h.ingest("worker-a:1", {"Worker.X": 1.0}, now=death - 5.0)
+        assert h.ended_sources() == {"worker-a:1": death}  # still dead
+        clock.t += 10.0
+        h.revive_source("worker-a:1")  # re-registered: genuinely back
+        assert h.ended_sources() == {}
+        h.end_source("worker-a:1")
+        assert h.ended_sources(now=clock.t + 3601.0) == {}  # aged out
+
+
+class TestTwoPhaseIngestAndClusterSeries:
+    def test_offer_then_drain_records_per_source_and_cluster(self):
+        clock = _Clock()
+        mm = MetricsMaster(store=MetricsStore(clock=clock),
+                           history=_history(clock))
+        mm.handle_heartbeat({"source": "worker-h:1",
+                             "metrics": {"Worker.Bytes": 100.0}})
+        # nothing folded yet: the RPC path only offers
+        assert mm.history.series_count() == 0
+        mm.drain_history(now=clock())
+        assert mm.history.latest("Worker.Bytes", "worker-h:1") == 100.0
+        # Cluster.* aggregates recorded alongside, under source=cluster
+        assert mm.history.latest("Cluster.Bytes", "cluster") == 100.0
+
+    def test_dropped_report_not_offered_to_history(self):
+        clock = _Clock()
+        mm = MetricsMaster(
+            store=MetricsStore(clock=clock, max_sources=1),
+            history=_history(clock))
+        mm.handle_heartbeat({"source": "a", "metrics": {"Worker.X": 1}})
+        mm.handle_heartbeat({"source": "b", "metrics": {"Worker.X": 2}})
+        mm.drain_history(now=clock())
+        assert mm.store.dropped_reports == 1
+        assert mm.history.query("Worker.X", source="b") == []
+
+    def test_non_string_metric_keys_sanitized_before_history(self):
+        # the store coerces str(k) on its own copy; the history offer
+        # must see the same sanitized names or the drain crashes on
+        # name.startswith (review finding)
+        clock = _Clock()
+        mm = MetricsMaster(store=MetricsStore(clock=clock),
+                           history=_history(clock))
+        mm.handle_heartbeat({"source": "worker-h:1",
+                             "metrics": {123: 1.0, "Worker.Good": 2.0}})
+        mm.drain_history(now=clock())  # must not raise
+        assert mm.history.latest("Worker.Good", "worker-h:1") == 2.0
+        assert mm.store.per_source("123") == {"worker-h:1": 1.0}
+
+
+class TestMetricsStoreDropCounter:
+    def test_drop_counted_in_registry(self):
+        from alluxio_tpu.metrics import metrics
+
+        before = metrics().counter("Master.MetricsReportsDropped").count
+        s = MetricsStore(max_sources=1)
+        assert s.report("a", {"Worker.X": 1.0}) is True
+        assert s.report("b", {"Worker.X": 1.0}) is False
+        assert s.dropped_reports == 1
+        assert metrics().counter(
+            "Master.MetricsReportsDropped").count == before + 1
+
+    def test_per_source_includes_percentiles(self):
+        s = MetricsStore()
+        s.report("worker-a:1", {"Worker.ReadBlockTime.p99": 0.004})
+        s.report("worker-b:1", {"Worker.ReadBlockTime.p99": 0.050})
+        per = s.per_source("Worker.ReadBlockTime.p99")
+        assert per == {"worker-a:1": 0.004, "worker-b:1": 0.050}
+
+    def test_blocked_source_refused_until_unblocked(self):
+        """clear_source(block=True) (worker-lost path) must keep a
+        lost-but-chatty worker's reports out of the store — and with
+        them out of Cluster.* — until re-registration unblocks it
+        (review finding)."""
+        s = MetricsStore()
+        s.report("worker-a:1", {"Worker.Bytes": 5.0})
+        s.clear_source("worker-a:1", block=True)
+        assert s.report("worker-a:1", {"Worker.Bytes": 9.0}) is False
+        assert s.cluster_metrics() == {}
+        # blocked refusals are NOT cap drops: they get their own
+        # counter so fsadmin's "raise the source cap" advice never
+        # points at a dead worker
+        assert s.blocked_reports == 1 and s.dropped_reports == 0
+        s.unblock_source("worker-a:1")
+        assert s.report("worker-a:1", {"Worker.Bytes": 9.0}) is True
+        assert s.cluster_metrics() == {"Cluster.Bytes": 9.0}
+
+    def test_refused_report_does_not_ingest_spans(self):
+        """Sources whose metric reports are refused (cap or block)
+        must not keep washing the bounded trace ring either."""
+        mm = MetricsMaster(store=MetricsStore(max_sources=1))
+        span = {"trace_id": "t" * 32, "span_id": "s" * 16,
+                "name": "x", "start": 1.0, "end": 2.0}
+        mm.handle_heartbeat({"source": "a", "metrics": {"Worker.X": 1.0},
+                             "spans": [dict(span)]})
+        assert mm.traces.span_count() == 1
+        mm.handle_heartbeat({"source": "b",  # refused: past the cap
+                             "metrics": {"Worker.X": 1.0},
+                             "spans": [dict(span, span_id="y" * 16)]})
+        assert mm.traces.span_count() == 1
+
+    def test_blocked_entries_age_out(self):
+        """A churned worker that never re-registers (rescheduled under
+        a new host:port) must not leak its block entry forever."""
+        clock = _Clock()
+        s = MetricsStore(blocked_ttl_s=100.0, clock=clock)
+        s.clear_source("worker-gone:1", block=True)
+        clock.t += 101.0
+        # lazy expiry on its own report ...
+        assert s.report("worker-gone:1", {"Worker.X": 1.0}) is True
+        # ... and the gc sweep drops silent entries
+        s.clear_source("worker-gone:2", block=True)
+        clock.t += 101.0
+        s._gc(clock.t)
+        assert s._blocked == {}
+
+
+def _stall_monitor(mm, clock, *, fire_after=10.0, resolve_after=10.0):
+    return HealthMonitor(
+        mm, rules=default_rules(stall_threshold=0.5, stall_window_s=30.0),
+        fire_after_s=fire_after, resolve_after_s=resolve_after,
+        clock=clock)
+
+
+class TestHealthEngineLifecycle:
+    def _mm(self, clock):
+        return MetricsMaster(store=MetricsStore(clock=clock),
+                             history=_history(clock))
+
+    def _beat(self, mm, clock, frac):
+        mm.handle_heartbeat({"source": "client-1",
+                             "metrics": {"Client.InputBoundFraction":
+                                         frac}})
+        mm.drain_history(now=clock())
+
+    def test_stall_alert_fires_debounced_and_resolves(self):
+        clock = _Clock()
+        mm = self._mm(clock)
+        mon = _stall_monitor(mm, clock)
+        self._beat(mm, clock, 0.9)
+        assert mon.evaluate() == []  # pending, not firing yet
+        report = mon.report()
+        assert report["status"] == "OK"
+        assert len(report["pending"]) == 1
+        clock.t += 15.0  # past fire_after while still violating
+        self._beat(mm, clock, 0.9)
+        firing = mon.evaluate()
+        assert [a.rule for a in firing] == ["input-stall-sustained"]
+        a = firing[0]
+        assert a.severity == "critical" and a.subject == "client-1"
+        assert a.evidence["window_s"] == 30.0
+        assert mon.report()["status"] == "CRITICAL"
+        # condition clears: low fractions age the highs out of window.
+        # The first clean evaluation starts the resolve debounce — the
+        # alert keeps firing until it has been OBSERVED clean for
+        # resolve_after (a gap between evaluations is not a streak)
+        clock.t += 31.0
+        self._beat(mm, clock, 0.05)
+        assert [a.rule for a in mon.evaluate()] == \
+            ["input-stall-sustained"]
+        assert mon.report()["status"] == "CRITICAL"
+        clock.t += 11.0
+        self._beat(mm, clock, 0.05)
+        mon.evaluate()
+        report = mon.report()
+        assert report["status"] == "OK"
+        assert report["alerts"] == []
+        resolved = report["recently_resolved"]
+        assert resolved and resolved[0]["rule"] == "input-stall-sustained"
+        assert resolved[0]["resolved_at"] == clock.t
+
+    def test_blip_shorter_than_debounce_never_fires(self):
+        clock = _Clock()
+        mm = self._mm(clock)
+        mon = _stall_monitor(mm, clock)
+        self._beat(mm, clock, 0.9)
+        mon.evaluate()
+        clock.t += 31.0  # high sample ages out before fire_after hits
+        self._beat(mm, clock, 0.05)
+        mon.evaluate()
+        report = mon.report()
+        assert report["pending"] == [] and report["alerts"] == []
+
+    def test_alerts_firing_gauge(self):
+        from alluxio_tpu.metrics import metrics
+
+        clock = _Clock()
+        mm = self._mm(clock)
+        mon = _stall_monitor(mm, clock, fire_after=0.0)
+        self._beat(mm, clock, 0.9)
+        mon.evaluate()
+        assert metrics().snapshot()["Master.Health.AlertsFiring"] == 1.0
+
+    def test_heartbeat_staleness_fires_immediately(self):
+        clock = _Clock()
+        mm = self._mm(clock)
+        mon = _stall_monitor(mm, clock)
+        mm.handle_heartbeat({"source": "worker-x:1",
+                             "metrics": {"Worker.A": 1.0}})
+        clock.t += 90.0  # > 60s staleness threshold, < source TTL
+        firing = mon.evaluate()
+        assert [a.rule for a in firing] == ["heartbeat-staleness"]
+        assert firing[0].subject == "worker-x:1"
+
+    def test_p99_regression_against_fleet_median(self):
+        clock = _Clock()
+        mm = self._mm(clock)
+        mon = _stall_monitor(mm, clock, fire_after=0.0)
+        for i, p99 in enumerate((0.004, 0.005, 0.006, 0.040)):
+            mm.handle_heartbeat({
+                "source": f"worker-h{i}:1",
+                "metrics": {"Worker.ReadBlockTime.p99": p99}})
+        firing = mon.evaluate()
+        regress = [a for a in firing
+                   if a.rule == "read-latency-p99-regression"]
+        assert [a.subject for a in regress] == ["worker-h3:1"]
+        # value is the regression ratio (same unit as the 3x factor
+        # threshold) so ranking orders worse regressions first
+        assert regress[0].value == pytest.approx(0.040 / 0.0055)
+
+    def test_report_ranks_critical_first(self):
+        clock = _Clock()
+        rules = [
+            HealthRule("warny", severity="warning", window_s=1.0,
+                       threshold=1.0, remediation="r", description="d",
+                       probe=lambda ctx: [Violation("s", 5.0, "w")]),
+            HealthRule("crity", severity="critical", window_s=1.0,
+                       threshold=1.0, remediation="r", description="d",
+                       probe=lambda ctx: [Violation("s", 2.0, "c")]),
+        ]
+        mon = HealthMonitor(None, rules=rules, fire_after_s=0.0,
+                            clock=clock)
+        mon.evaluate()
+        report = mon.report()
+        assert [a["rule"] for a in report["alerts"]] == ["crity", "warny"]
+        assert report["status"] == "CRITICAL"
+
+    def test_rank_handles_lower_is_worse_rules(self):
+        """A rule that violates BELOW its threshold (hit-ratio drop)
+        must rank its worst violation first: ratio 0.05 against a 0.5
+        floor outranks 0.45."""
+        clock = _Clock()
+        rules = [HealthRule(
+            "hitratio", severity="warning", window_s=1.0, threshold=0.5,
+            remediation="r", description="d",
+            probe=lambda ctx: [Violation("meh", 0.45, "near floor"),
+                               Violation("bad", 0.05, "cratered")])]
+        mon = HealthMonitor(None, rules=rules, fire_after_s=0.0,
+                            clock=clock)
+        mon.evaluate()
+        assert [a["subject"] for a in mon.report()["alerts"]] == \
+            ["bad", "meh"]
+
+    def test_broken_rule_cannot_take_the_doctor_down(self):
+        def boom(ctx):
+            raise RuntimeError("bad rule")
+
+        clock = _Clock()
+        rules = [HealthRule("boom", severity="info", window_s=1.0,
+                            threshold=1.0, remediation="", description="",
+                            probe=boom)]
+        mon = HealthMonitor(None, rules=rules, clock=clock)
+        assert mon.evaluate() == []
+
+
+class TestRuleProbes:
+    """Direct probes of rules whose edge cases the lifecycle tests
+    don't reach (review findings)."""
+
+    def _rule(self, name, **kw):
+        return [r for r in default_rules(**kw) if r.name == name][0]
+
+    def _ctx(self, store, **kw):
+        from alluxio_tpu.master.health import HealthContext
+
+        return HealthContext(None, store, 1_000_000.0, **kw)
+
+    def test_p99_floor_gates_outlier_not_median(self):
+        # fast memory-serving fleet: median far below the 1ms floor,
+        # one worker regressed to disk-bound latency — must flag it
+        s = MetricsStore()
+        for i, v in enumerate([1e-4, 1e-4, 1e-4, 0.05]):
+            s.report(f"worker-{i}:1", {"Worker.ReadBlockTime.p99": v})
+        [v] = self._rule("read-latency-p99-regression").probe(
+            self._ctx(s))
+        assert v.subject == "worker-3:1" and v.value == \
+            pytest.approx(500.0)
+
+    def test_p99_subfloor_noise_stays_quiet(self):
+        s = MetricsStore()
+        for i, v in enumerate([1e-4, 1e-4, 8e-4]):  # 8x median, sub-ms
+            s.report(f"worker-{i}:1", {"Worker.ReadBlockTime.p99": v})
+        assert self._rule("read-latency-p99-regression").probe(
+            self._ctx(s)) == []
+
+    def test_staleness_flags_expired_registered_worker(self):
+        """A registered worker whose metrics source TTL'd out of the
+        store entirely must keep violating (the alert must not
+        self-resolve when the evidence expires); freshly-registered
+        workers get a grace period before their first report is
+        overdue."""
+        rule = self._rule("heartbeat-staleness")
+        ctx = self._ctx(MetricsStore(), expected_workers=[
+            ("worker-dead:1", 400.0), ("worker-new:1", 100.0)])
+        [v] = rule.probe(ctx)
+        assert v.subject == "worker-dead:1"
+
+    def test_window_rate_is_time_weighted(self):
+        """One counter increment landing in a short inter-heartbeat
+        jitter gap must not inflate the window rate: total increase
+        over total time, not an unweighted mean of per-segment rates
+        (review finding)."""
+        from alluxio_tpu.master.health import HealthContext
+
+        clock = _Clock()
+        h = _history(clock)
+        base = clock.t
+        for i in range(12):  # 10s cadence, flat counter
+            h.ingest("w1", {"Worker.UfsFetchFailures": 0.0},
+                     now=base + 10.0 * i)
+        # the only failure lands on a 0.5s-late straggler tick
+        h.ingest("w1", {"Worker.UfsFetchFailures": 1.0},
+                 now=base + 110.5)
+        ctx = HealthContext(h, None, base + 110.5)
+        rate = ctx.window_rate("Worker.UfsFetchFailures", "w1", 120.0)
+        # segment-mean estimation would report ~0.17/s here and trip
+        # the 0.02/s ufs-fetch-errors threshold off one blip
+        assert rate == pytest.approx(1.0 / 110.5)
+
+    def test_window_rate_clamps_counter_resets(self):
+        from alluxio_tpu.master.health import HealthContext
+
+        clock = _Clock()
+        h = _history(clock)
+        base = clock.t
+        for i, v in enumerate([5.0, 2.0, 4.0]):  # restart mid-window
+            h.ingest("w1", {"Worker.UfsFetchFailures": v},
+                     now=base + 10.0 * i)
+        ctx = HealthContext(h, None, base + 20.0)
+        rate = ctx.window_rate("Worker.UfsFetchFailures", "w1", 60.0)
+        assert rate == pytest.approx(2.0 / 20.0)
+
+    def test_monitor_plumbs_worker_sources_fn(self):
+        clock = _Clock()
+        mm = MetricsMaster(store=MetricsStore(clock=clock),
+                           history=_history(clock))
+        mon = HealthMonitor(
+            mm, rules=default_rules(), clock=clock,
+            worker_sources_fn=lambda: [("worker-dead:1", 400.0)])
+        firing = mon.evaluate()  # staleness fires immediately
+        assert [(a.rule, a.subject) for a in firing] == \
+            [("heartbeat-staleness", "worker-dead:1")]
+
+
+class TestWorkerLostWiring:
+    """Satellite: a dead worker's metrics leave the aggregates at
+    lost-worker time (clear_source finally has a caller) and its
+    history series carry an explicit end marker."""
+
+    def test_forget_worker_clears_source_and_ends_history(self, tmp_path):
+        with LocalCluster(str(tmp_path), num_workers=1) as cluster:
+            master = cluster.master
+            info = master.block_master.get_worker_infos()[0]
+            source = f"worker-{info.address.host}:{info.address.rpc_port}"
+            master.metrics_master.handle_heartbeat(
+                {"source": source, "metrics": {"Worker.Bytes": 7.0}})
+            master.metrics_master.drain_history()
+            assert "Cluster.Bytes" in \
+                master.metrics_master.store.cluster_metrics()
+            master.block_master.forget_worker(info.id)
+            # snapshot cleared immediately, not after the 300s TTL
+            assert "Cluster.Bytes" not in \
+                master.metrics_master.store.cluster_metrics()
+            [series] = master.metrics_master.history.query(
+                "Worker.Bytes", source=source)
+            assert series["ended_at"] is not None
+            # ... and the death keeps health out of OK even though the
+            # TTL'd snapshot (and with it heartbeat-staleness) is gone
+            master.health_monitor.evaluate()
+            lost = [a for a in master.health_monitor.firing()
+                    if a.rule == "worker-lost"]
+            assert lost and lost[0].subject == source
+            # a metrics heartbeat from the "dead" worker must not
+            # launder the marker away or re-admit its snapshot into
+            # the Cluster.* aggregates (lost-but-chatty worker) ...
+            master.metrics_master.handle_heartbeat(
+                {"source": source, "metrics": {"Worker.Bytes": 9.0}})
+            master.metrics_master.drain_history()
+            assert source in master.metrics_master.history.ended_sources()
+            assert "Cluster.Bytes" not in \
+                master.metrics_master.store.cluster_metrics()
+            # ... only a full block-master re-registration revives it
+            master.block_master.worker_register(info.id, {}, {}, {},
+                                                address=info.address)
+            assert master.metrics_master.history.ended_sources() == {}
+            [series] = master.metrics_master.history.query(
+                "Worker.Bytes", source=source)
+            assert series["ended_at"] is None
+            master.metrics_master.handle_heartbeat(
+                {"source": source, "metrics": {"Worker.Bytes": 10.0}})
+            assert "Cluster.Bytes" in \
+                master.metrics_master.store.cluster_metrics()
+            # recovery resets the missing-source staleness grace: a
+            # worker first registered long ago that JUST re-registered
+            # must not read as overdue for its first metrics report
+            # (start_time_ms survives loss/recovery; the registration
+            # stamp must not)
+            master._worker_registered_at[source] = time.time() - 400.0
+            master.block_master.worker_register(info.id, {}, {}, {},
+                                                address=info.address)
+            ages = dict(master.health_monitor._worker_sources_fn())
+            assert ages[source] < 1.0
+
+    def test_health_enabled_without_history_boots_reduced_rules(
+            self, tmp_path):
+        # history disabled + health enabled must boot (a NameError in
+        # the warning path crashed the master here — review finding)
+        # with only the rules that don't read history
+        with LocalCluster(str(tmp_path), num_workers=0, conf_overrides={
+                Keys.MASTER_METRICS_HISTORY_ENABLED: False}) as cluster:
+            mon = cluster.master.health_monitor
+            assert mon is not None
+            assert cluster.master.metrics_master.history is None
+            names = {r.name for r in mon.rules}
+            assert names and all(
+                not r.needs_history for r in mon.rules), names
+            mon.evaluate()  # reduced catalog evaluates cleanly
+
+    def test_reinit_does_not_accumulate_listeners(self, tmp_path):
+        # _start_serving re-runs _init_metrics_master on every HA
+        # re-promotion; the worker-lost listener must register once
+        # (review finding)
+        with LocalCluster(str(tmp_path), num_workers=0) as cluster:
+            master = cluster.master
+            before = len(master.block_master.lost_worker_listeners)
+            master._init_metrics_master()
+            master._init_metrics_master()
+            assert len(master.block_master.lost_worker_listeners) == before
+
+
+@pytest.fixture()
+def doctor_cluster(tmp_path):
+    with LocalCluster(str(tmp_path), num_workers=1, conf_overrides={
+            Keys.MASTER_WEB_ENABLED: True,
+            Keys.MASTER_WEB_PORT: 0,
+            Keys.MASTER_HEALTH_STALL_WINDOW: "2s",
+            Keys.MASTER_HEALTH_FIRE_AFTER: "0s",
+            Keys.MASTER_HEALTH_RESOLVE_AFTER: "0s",
+            Keys.MASTER_METRICS_HISTORY_MAX_SERIES: 300,
+            # keep the periodic evaluator out of the way: the test
+            # drives evaluation through get_health deterministically
+            Keys.MASTER_HEALTH_EVAL_INTERVAL: "10min"}) as c:
+        yield c
+
+
+def _run_fsadmin(cluster, argv):
+    from alluxio_tpu.shell.command import ShellContext
+    from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+
+    conf = cluster.conf.copy()
+    conf.set(Keys.MASTER_HOSTNAME, "localhost")
+    conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+    out = io.StringIO()
+    ctx = ShellContext(conf, out=out, err=out)
+    code = ADMIN_SHELL.run(argv, ctx)
+    return code, out.getvalue()
+
+
+class TestClusterDoctorEndToEnd:
+    """The acceptance path: injected sustained stall -> queryable
+    series -> firing alert with the right evidence window -> fsadmin
+    verdict -> automatic resolution, with history memory bounded under
+    a cardinality flood."""
+
+    def test_stall_fires_and_resolves(self, doctor_cluster):
+        mc = doctor_cluster.meta_client()
+        for _ in range(3):
+            mc.metrics_heartbeat(
+                "client-stalled",
+                {"Client.InputBoundFraction": 0.95,
+                 "Client.InputStallUs.ufs": 9e6})
+            time.sleep(0.05)
+        health = mc.get_health()
+        stall = [a for a in health["alerts"]
+                 if a["rule"] == "input-stall-sustained"]
+        assert stall, health
+        assert stall[0]["subject"] == "client-stalled"
+        assert stall[0]["value"] == pytest.approx(0.95)
+        assert stall[0]["window_s"] == pytest.approx(2.0)
+        assert health["status"] == "CRITICAL"
+
+        # the series the alert was computed from is queryable over RPC
+        hist = mc.get_metrics_history("Client.InputBoundFraction")
+        series = [s for s in hist["series"]
+                  if s["source"] == "client-stalled"]
+        assert series and len(series[0]["points"]) >= 3
+        assert all(v == pytest.approx(0.95)
+                   for _, v in series[0]["points"])
+
+        # ... and over the web endpoint
+        port = doctor_cluster.master.web_port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/master/metrics/history"
+                f"?name=Client.InputBoundFraction", timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert any(s["source"] == "client-stalled"
+                   for s in body["series"])
+
+        # fsadmin shows the ranked verdict with remediation
+        code, out = _run_fsadmin(doctor_cluster, ["report", "health"])
+        assert code == 1  # CRITICAL exits nonzero
+        assert "input-stall-sustained" in out
+        assert "client-stalled" in out
+        assert "clairvoyant" in out  # the remediation hint
+
+        # condition clears: low samples + the highs age out of the 2s
+        # window (sleep dwarfs ms-scale host jitter)
+        mc.metrics_heartbeat("client-stalled",
+                             {"Client.InputBoundFraction": 0.01})
+        time.sleep(2.5)
+        mc.metrics_heartbeat("client-stalled",
+                             {"Client.InputBoundFraction": 0.01})
+        health = mc.get_health()
+        assert not [a for a in health["alerts"]
+                    if a["rule"] == "input-stall-sustained"]
+        assert any(a["rule"] == "input-stall-sustained"
+                   for a in health["recently_resolved"])
+        code, out = _run_fsadmin(doctor_cluster, ["report", "health"])
+        assert "[resolved] input-stall-sustained" in out
+
+    def test_history_bounded_under_cardinality_flood(self, doctor_cluster):
+        mc = doctor_cluster.meta_client()
+        mc.metrics_heartbeat("client-ok",
+                             {"Client.InputBoundFraction": 0.1})
+        # bogus prefixes AND a legit-prefixed series flood, both capped
+        mc.metrics_heartbeat("evil", {f"totally.bogus{i}": 1.0
+                                      for i in range(2000)})
+        mc.metrics_heartbeat("evil", {f"Worker.Flood{i}": 1.0
+                                      for i in range(2000)})
+        stats = mc.get_metrics_history()["stats"]
+        assert stats["series"] <= 300
+        assert stats["points"] <= 300 * 3 * stats["capacity"]
+        assert stats["dropped_samples"] > 0
+        # the legit series survived the flood
+        hist = mc.get_metrics_history("Client.InputBoundFraction",
+                                      source="client-ok")
+        assert hist["series"]
+
+    def test_report_rejects_history_args_on_other_categories(
+            self, doctor_cluster):
+        # `report metrics Worker.X` used to silently ignore the
+        # positional and dump the full snapshot (review finding)
+        code, out = _run_fsadmin(
+            doctor_cluster, ["report", "metrics", "Worker.UfsFetchFailures"])
+        assert code == 2
+        assert "history-only" in out
+
+    def test_fsadmin_report_history_sparkline(self, doctor_cluster):
+        mc = doctor_cluster.meta_client()
+        for i in range(8):
+            mc.metrics_heartbeat("client-h",
+                                 {"Client.InputBoundFraction": i / 10})
+        code, out = _run_fsadmin(
+            doctor_cluster,
+            ["report", "history", "Client.InputBoundFraction"])
+        assert code == 0
+        assert "client-h" in out
+        assert any(ch in out for ch in "▁▂▃▄▅▆▇█")
+        # listing mode names the recorded metrics
+        code, out = _run_fsadmin(doctor_cluster, ["report", "history"])
+        assert code == 0 and "Client.InputBoundFraction" in out
+        # ... and refuses series filters instead of silently ignoring
+        # them (same rule as cross-category extras)
+        code, out = _run_fsadmin(doctor_cluster,
+                                 ["report", "history", "--rate"])
+        assert code == 2
+        # rollup table renders
+        code, out = _run_fsadmin(
+            doctor_cluster,
+            ["report", "history", "Client.InputBoundFraction",
+             "--resolution", "1m"])
+        assert code == 0 and "bucket" in out

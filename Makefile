@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-ufs-cold bench-remote-read sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read sdist clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -27,6 +27,9 @@ bench-obs:  ## tracing overhead: spans/sec + on-vs-off read latency (<2% budget)
 
 bench-health:  ## metrics-history ingestion: heartbeat hot-path overhead (<5% gate, fake clock)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress health
+
+bench-selfheal:  ## remediation engine: detection->action latency + health-tick overhead (<2% gate, fake clock)
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress selfheal
 
 bench-ufs-cold:  ## cold UFS reads: striped vs single-stream GB/s + ttfb (1.5x gate at c=4)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress ufscold

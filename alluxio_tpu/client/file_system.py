@@ -154,14 +154,81 @@ class FileSystem:
         """Ship this client's metric snapshot — plus completed trace
         spans drained from the local ring — to the master for cluster
         aggregation and trace stitching (reference:
-        ``client/metrics/ClientMasterSync``)."""
+        ``client/metrics/ClientMasterSync``).  The response may carry a
+        remediation tuning overlay; applying it here means pushed
+        retunes land within one heartbeat interval, no extra RPC."""
         from alluxio_tpu.metrics import metrics
         from alluxio_tpu.utils.tracing import tracer
 
         spans = tracer().drain(500) if tracer().enabled else []
-        self.meta_master.metrics_heartbeat(
+        resp = self.meta_master.metrics_heartbeat(
             f"client-{socket.gethostname()}-{id(self):x}",
             metrics().snapshot(), spans=spans)
+        if isinstance(resp, dict) and "conf_overlay_version" in resp:
+            self.apply_conf_overlay(resp.get("conf_overlay") or {},
+                                    int(resp["conf_overlay_version"]))
+
+    #: master-pushable keys -> (clamp, apply) — everything else in an
+    #: overlay is ignored: the push surface is a closed catalog, not a
+    #: remote-write of arbitrary client conf
+    _OVERLAY_CLAMPS = {
+        "atpu.user.remote.read.hedge.quantile":
+            lambda v: min(1.0, max(0.5, float(v))),
+        "atpu.user.remote.read.concurrency":
+            lambda v: min(64, max(1, int(float(v)))),
+        "atpu.prefetch.budget.bytes":
+            lambda v: min(4 << 30, max(16 << 20, int(float(v)))),
+    }
+
+    def apply_conf_overlay(self, overlay: Dict[str, object],
+                           version: int) -> None:
+        """Apply (or revert) the master's remediation tuning overlay.
+        Idempotent per version; values are clamped client-side (a
+        misbehaving master cannot push a client off a cliff); keys the
+        overlay no longer carries revert to the value this client
+        booted with."""
+        if version == getattr(self, "_overlay_version", None):
+            return
+        self._overlay_version = version
+        runtime = self.store.remote_read
+        bases = getattr(self, "_overlay_bases", None)
+        if bases is None:
+            bases = self._overlay_bases = {
+                "atpu.user.remote.read.hedge.quantile":
+                    runtime.conf.hedge_quantile,
+                "atpu.user.remote.read.concurrency":
+                    runtime.conf.concurrency,
+                "atpu.prefetch.budget.bytes": None,  # scheduler-owned
+            }
+        import dataclasses as _dc
+
+        from alluxio_tpu.metrics import metrics
+
+        applied = []
+        replace = {}
+        for key, clamp in self._OVERLAY_CLAMPS.items():
+            raw = overlay.get(key)
+            try:
+                value = clamp(raw) if raw is not None else bases[key]
+            except (TypeError, ValueError):
+                continue  # a malformed push must not break heartbeats
+            if key == "atpu.user.remote.read.hedge.quantile":
+                replace["hedge_quantile"] = float(value)
+            elif key == "atpu.user.remote.read.concurrency":
+                replace["concurrency"] = int(value)
+            elif key == "atpu.prefetch.budget.bytes":
+                from alluxio_tpu.prefetch.scheduler import retune_budget
+
+                # None = overlay withdrawn: restore each scheduler's
+                # own configured budget
+                retune_budget(None if raw is None else int(value))
+            if raw is not None:
+                applied.append(key)
+        # the conf dataclass is frozen; swap it atomically so a stream
+        # mid-read never sees a half-applied retune
+        runtime.conf = _dc.replace(runtime.conf, **replace)
+        metrics().counter("Client.ConfOverlayApplied").inc()
+        self._overlay_active = applied
 
     # ------------------------------------------------------------- metadata
     def get_status(self, path: "str | AlluxioURI") -> FileInfo:

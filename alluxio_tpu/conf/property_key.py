@@ -444,6 +444,14 @@ class Keys:
     MASTER_REPLICATION_CHECK_INTERVAL = _k(
         "atpu.master.replication.check.interval", KeyType.DURATION, default="1min",
         scope=Scope.MASTER)
+    MASTER_REPLICATION_MAX_INFLIGHT = _k(
+        "atpu.master.replication.max.inflight", KeyType.INT, default=256,
+        scope=Scope.MASTER,
+        description="Replicate/evict jobs the replication checker keeps "
+                    "in flight at once; deficits beyond it wait for the "
+                    "next heartbeat (counted in "
+                    "Master.ReplicationJobsDeferred) — bounds job-master "
+                    "load after a mass worker loss.")
     MASTER_LOST_FILES_DETECTION_INTERVAL = _k(
         "atpu.master.lost.files.detection.interval", KeyType.DURATION,
         default="5min", scope=Scope.MASTER,
@@ -844,6 +852,59 @@ class Keys:
         default="60s", scope=Scope.MASTER,
         description="Debounce: a firing alert must stay clean this "
                     "long before it resolves.")
+    MASTER_REMEDIATION_ENABLED = _k(
+        "atpu.master.remediation.enabled", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="Act on firing health alerts with bounded, audited "
+                    "remediations (quarantine, targeted re-replication, "
+                    "client retuning pushed on the metrics heartbeat). "
+                    "OFF by default: with it off the cluster behaves "
+                    "exactly as if the engine did not exist. See "
+                    "docs/self_healing.md.")
+    MASTER_REMEDIATION_DRY_RUN = _k(
+        "atpu.master.remediation.dry.run", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="Evaluate and AUDIT every remediation the engine "
+                    "would take without executing any of them — the "
+                    "recommended first week of production rollout.")
+    MASTER_REMEDIATION_MAX_ACTIONS_PER_WINDOW = _k(
+        "atpu.master.remediation.max.actions.per.window", KeyType.INT,
+        default=4, scope=Scope.MASTER,
+        description="Hard cap on remediation actions (executed or "
+                    "dry-run) per sliding window; further actions are "
+                    "suppressed-but-audited. A runaway rule can "
+                    "quarantine at most this many workers per window.")
+    MASTER_REMEDIATION_WINDOW = _k(
+        "atpu.master.remediation.window", KeyType.DURATION,
+        default="10min", scope=Scope.MASTER,
+        description="Sliding window the action cap counts over.")
+    MASTER_REMEDIATION_COOLDOWN = _k(
+        "atpu.master.remediation.cooldown", KeyType.DURATION,
+        default="5min", scope=Scope.MASTER,
+        description="Minimum spacing between two actions of the same "
+                    "kind on the same subject — a flapping alert cannot "
+                    "thrash quarantine/release or re-replicate the same "
+                    "worker's blocks in a loop.")
+    MASTER_REMEDIATION_PROBATION = _k(
+        "atpu.master.remediation.probation", KeyType.DURATION,
+        default="60s", scope=Scope.MASTER,
+        description="After the triggering alert resolves, a quarantined "
+                    "worker (or pushed tuning overlay) is held this much "
+                    "longer before release/revert — resolution debounce "
+                    "on the action side.")
+    MASTER_REMEDIATION_REREPLICATE_BLOCKS = _k(
+        "atpu.master.remediation.rereplicate.blocks", KeyType.INT,
+        default=8, scope=Scope.MASTER,
+        description="Hottest blocks (top-tier residents) re-replicated "
+                    "off a worker per re-replication action.")
+    MASTER_REMEDIATION_QUARANTINE_MAX_FRACTION = _k(
+        "atpu.master.remediation.quarantine.max.fraction", KeyType.FLOAT,
+        default=0.5, scope=Scope.MASTER,
+        description="Healthy-capacity floor: at most this fraction of "
+                    "registered workers (min 1) may be quarantined at "
+                    "once — a systemic condition that flags the whole "
+                    "fleet must not let the engine empty the placement "
+                    "set and amplify the outage.")
     METRICS_SINKS = _k(
         "atpu.metrics.sinks", KeyType.STRING, default="",
         scope=Scope.ALL,
@@ -976,6 +1037,35 @@ class Keys:
                                   default="256MB",
                                   description="Pinned host staging pool for "
                                               "UFS->HBM decode paths.")
+
+    # --- fault injection (chaos / self-healing tests; see utils/faults.py)
+    DEBUG_FAULT_READ_LATENCY = _k(
+        "atpu.debug.fault.read.latency", KeyType.DURATION, default="0ms",
+        scope=Scope.WORKER,
+        description="FAULT INJECTION (tests/chaos only): extra latency "
+                    "added to every warm read_block chunk this worker "
+                    "serves — inflates Worker.ReadBlockTime so the p99 "
+                    "regression rule can be exercised end to end.")
+    DEBUG_FAULT_HEARTBEAT_FREEZE = _k(
+        "atpu.debug.fault.worker.heartbeat.freeze", KeyType.BOOL,
+        default=False, scope=Scope.WORKER,
+        description="FAULT INJECTION (tests/chaos only): the worker "
+                    "silently skips its metrics heartbeats — drives the "
+                    "heartbeat-staleness rule without killing the "
+                    "process.")
+    DEBUG_FAULT_UFS_ERROR_RATE = _k(
+        "atpu.debug.fault.ufs.error.rate", KeyType.FLOAT, default=0.0,
+        scope=Scope.WORKER,
+        description="FAULT INJECTION (tests/chaos only): deterministic "
+                    "fraction (0..1) of UFS stripe reads that fail with "
+                    "an injected IOError.")
+    DEBUG_FAULT_SCOPE = _k(
+        "atpu.debug.fault.scope", KeyType.STRING, default="",
+        scope=Scope.WORKER,
+        description="Substring a node's locality host / metrics source "
+                    "must contain for the atpu.debug.fault.* hooks to "
+                    "apply; empty = every node that loaded the conf "
+                    "(in-process miniclusters share one injector).")
 
 
 # Parameterized families (reference: PropertyKey.Template, PropertyKey.java:5668)

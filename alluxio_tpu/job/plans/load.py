@@ -86,7 +86,11 @@ class LoadDefinition(PlanDefinition):
         """Cache every assigned block into the co-located block worker."""
         store = ctx.fs.store
         local = None
-        for w in ctx.fs.block_master.get_worker_infos():
+        # include_quarantined: co-location lookup wants the LIVE set,
+        # not the placement view — a quarantined local worker is still
+        # alive and must still be findable (e.g. to evict from it)
+        for w in ctx.fs.block_master.get_worker_infos(
+                include_quarantined=True):
             if w.address.tiered_identity.value("host") == ctx.hostname:
                 local = w
                 break
@@ -132,8 +136,12 @@ class LoadDefinition(PlanDefinition):
                 # worker timeout) must get the chance to re-register —
                 # only a persistently-absent worker aborts the wait.
                 next_live_check = time.monotonic() + 1.0
+                # LIVE set incl. quarantined: a worker quarantined
+                # mid-load is still registered and still committing —
+                # it must not read as "left the cluster"
                 live = {w.address.tiered_identity.value("host")
-                        for w in block_master.get_worker_infos()}
+                        for w in block_master.get_worker_infos(
+                            include_quarantined=True)}
                 absent_checks = 0 if hostname in live \
                     else absent_checks + 1
                 if absent_checks >= 3:

@@ -21,7 +21,12 @@ from alluxio_tpu.utils.exceptions import (
 
 
 def _local_block_worker(ctx: RunTaskContext):
-    for w in ctx.fs.block_master.get_worker_infos():
+    # include_quarantined: this resolves the co-located worker to talk
+    # TO, not a placement choice — an evict task must still find a
+    # quarantined holder, and a replicate target quarantined between
+    # select and run is still alive to receive
+    for w in ctx.fs.block_master.get_worker_infos(
+            include_quarantined=True):
         if w.address.tiered_identity.value("host") == ctx.hostname:
             return w
     raise UnavailableError(

@@ -114,6 +114,14 @@ class BlockMaster(Journaled):
         self._container_reserved = 0
         self._reserve_lock = threading.Lock()
         self._lost_blocks: Set[int] = set()
+        #: worker id -> quarantine start (ms): still registered, still
+        #: serving its resident blocks, but filtered out of the
+        #: placement listing (writes, UFS read-through policy picks,
+        #: prefetch targets, replication targets) until released.
+        #: Soft state owned by the remediation engine — like locations,
+        #: never journaled: a failover drops quarantine and the health
+        #: rules re-derive it if the worker is still sick.
+        self._quarantined: Dict[int, int] = {}
         #: listeners fired on worker loss (elastic re-replication hook)
         self.lost_worker_listeners: List = []
         #: listeners fired on full (re-)registration — the only signal
@@ -283,6 +291,10 @@ class BlockMaster(Journaled):
                 if now - info.last_contact_ms > self._worker_timeout_ms:
                     del self._workers[wid]
                     self._lost_workers[wid] = info
+                    # a lost worker's quarantine dies with it: loss is
+                    # the stronger state, and a later re-registration
+                    # must start from a clean placement slate
+                    self._quarantined.pop(wid, None)
                     info.registered = False
                     self._refresh_top_tiers()
                     for bid in list(info.blocks):
@@ -297,6 +309,48 @@ class BlockMaster(Journaled):
                     pass
         return [i.id for i in newly_lost]
 
+    def worker_id_for_source(self, source: str) -> Optional[int]:
+        """O(1) lookup of a LIVE worker by its metrics-source name
+        (``worker-<host>:<rpc_port>``).  The remediation engine
+        resolves alert subjects through this — scanning
+        ``get_worker_infos`` would build a wire object per worker
+        under the lock for every action taken."""
+        if not source.startswith("worker-"):
+            return None
+        with self._lock:
+            wid = self._address_to_id.get(source[len("worker-"):])
+            return wid if wid in self._workers else None
+
+    # ---------------------------------------------------------- quarantine
+    def quarantine_worker(self, worker_id: int) -> bool:
+        """Remove a live worker from the placement listing without
+        touching its served blocks (remediation: a straggling or stale
+        worker keeps serving what it has, but receives nothing new).
+        Returns False for unknown/lost workers."""
+        with self._lock:
+            if worker_id not in self._workers:
+                return False
+            self._quarantined[worker_id] = self._clock.millis()
+            self.location_version += 1
+            return True
+
+    def release_worker(self, worker_id: int) -> bool:
+        """Lift a quarantine (probation passed, or operator override)."""
+        with self._lock:
+            if self._quarantined.pop(worker_id, None) is None:
+                return False
+            self.location_version += 1
+            return True
+
+    def quarantined_workers(self) -> Dict[int, int]:
+        """worker id -> quarantine start (ms since epoch)."""
+        with self._lock:
+            return dict(self._quarantined)
+
+    def is_quarantined(self, worker_id: int) -> bool:
+        with self._lock:
+            return worker_id in self._quarantined
+
     def forget_worker(self, worker_id: int) -> None:
         """Expire one worker immediately (admin decommission / tests);
         same effect as the lost-worker detector firing for it."""
@@ -304,6 +358,7 @@ class BlockMaster(Journaled):
             info = self._workers.pop(worker_id, None)
             if info is None:
                 return
+            self._quarantined.pop(worker_id, None)
             self._lost_workers[worker_id] = info
             info.registered = False
             self._refresh_top_tiers()
@@ -455,9 +510,24 @@ class BlockMaster(Journaled):
         with self._lock:
             return sum(1 for w in self._workers.values() if w.registered)
 
-    def get_worker_infos(self, include_lost: bool = False) -> List[WorkerInfo]:
+    def get_worker_infos(self, include_lost: bool = False,
+                         include_quarantined: bool = True
+                         ) -> List[WorkerInfo]:
+        """Worker listing.  ``include_quarantined=False`` is the
+        PLACEMENT view: quarantined workers vanish from it, which is
+        what makes quarantine effective — every placement chooser
+        (client write policy, UFS read-through pick, prefetch agent,
+        replication targets) selects from this listing.  The default
+        keeps them visible (marked ``QUARANTINED``) for reporting,
+        health watching and in-process admin callers."""
         with self._lock:
-            out = [w.to_wire("LIVE") for w in self._workers.values()]
+            out = []
+            for w in self._workers.values():
+                if w.id in self._quarantined:
+                    if include_quarantined:
+                        out.append(w.to_wire("QUARANTINED"))
+                else:
+                    out.append(w.to_wire("LIVE"))
             if include_lost:
                 out += [w.to_wire("LOST") for w in self._lost_workers.values()]
             return out
@@ -465,6 +535,17 @@ class BlockMaster(Journaled):
     def get_worker(self, worker_id: int) -> Optional[MasterWorkerInfo]:
         with self._lock:
             return self._workers.get(worker_id)
+
+    def worker_resident_blocks(self, worker_id: int
+                               ) -> Optional[Dict[int, str]]:
+        """Locked copy of one worker's block -> tier map (None for
+        unknown/lost workers).  ``MasterWorkerInfo.blocks`` is mutated
+        in place by worker heartbeats, so iterating the live dict from
+        another thread (the remediation engine picking hot blocks)
+        would race a concurrent add/remove."""
+        with self._lock:
+            info = self._workers.get(worker_id)
+            return dict(info.blocks) if info is not None else None
 
     def all_block_ids(self) -> List[int]:
         """Snapshot of every block id in the master map (integrity scan)."""

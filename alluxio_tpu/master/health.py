@@ -467,6 +467,11 @@ class HealthMonitor:
         self.fire_after_s = fire_after_s
         self.resolve_after_s = resolve_after_s
         self._clock = clock
+        #: called after every evaluation with (firing_alerts, now) —
+        #: OUTSIDE the monitor lock, so a listener may query the
+        #: monitor.  The remediation engine subscribes here.
+        self.alert_listeners: List[Callable[[List[Alert], float],
+                                            None]] = []
         self._tracked: Dict[Tuple[str, str], _Tracked] = {}
         self._resolved: deque = deque(maxlen=50)
         self._lock = threading.Lock()
@@ -513,8 +518,14 @@ class HealthMonitor:
                         continue      # must not take the doctor down
                     self._apply(rule, violations, ts)
                 self._last_eval = ts
-                return [t.alert for t in self._tracked.values()
-                        if t.alert.state == "firing"]
+                firing = [t.alert for t in self._tracked.values()
+                          if t.alert.state == "firing"]
+            for listener in self.alert_listeners:
+                try:
+                    listener(firing, ts)
+                except Exception:  # noqa: BLE001 - a broken actor must
+                    pass           # not take the doctor down either
+            return firing
 
     def _apply(self, rule: HealthRule,
                violations: List[Violation], now: float) -> None:

@@ -133,6 +133,7 @@ class MasterProcess:
         self.rpc_server: Optional[RpcServer] = None
         self.metrics_master = None
         self.health_monitor = None
+        self.remediation = None
         self._worker_lost_listener_installed = False
         self.web_server = None
         self.update_checker = None
@@ -207,7 +208,8 @@ class MasterProcess:
             config_checker=self.config_checker,
             permission_checker=self.permission_checker,
             metrics_master=self.metrics_master,
-            health_monitor=self.health_monitor))
+            health_monitor=self.health_monitor,
+            remediation_engine=self.remediation))
         self.rpc_port = self.rpc_server.start()
         if self._conf.get_bool(Keys.MASTER_FASTPATH_ENABLED):
             from alluxio_tpu.rpc.fastpath import (
@@ -334,6 +336,39 @@ class MasterProcess:
                 eval_interval_s=conf.get_duration_s(
                     Keys.MASTER_HEALTH_EVAL_INTERVAL),
                 worker_sources_fn=_expected_worker_sources)
+
+        self.remediation = None
+        if self.health_monitor is not None and \
+                conf.get_bool(Keys.MASTER_REMEDIATION_ENABLED):
+            from alluxio_tpu.master.remediation import RemediationEngine
+
+            # default-off: with the key false this block never runs —
+            # no engine object, no alert listener, no overlay in the
+            # heartbeat response, no remediation in get_health
+            self.remediation = RemediationEngine(
+                self.block_master,
+                metrics_master=self.metrics_master,
+                dry_run=conf.get_bool(Keys.MASTER_REMEDIATION_DRY_RUN),
+                max_actions_per_window=conf.get_int(
+                    Keys.MASTER_REMEDIATION_MAX_ACTIONS_PER_WINDOW),
+                window_s=conf.get_duration_s(
+                    Keys.MASTER_REMEDIATION_WINDOW),
+                cooldown_s=conf.get_duration_s(
+                    Keys.MASTER_REMEDIATION_COOLDOWN),
+                probation_s=conf.get_duration_s(
+                    Keys.MASTER_REMEDIATION_PROBATION),
+                rereplicate_blocks=conf.get_int(
+                    Keys.MASTER_REMEDIATION_REREPLICATE_BLOCKS),
+                quarantine_max_fraction=conf.get_float(
+                    Keys.MASTER_REMEDIATION_QUARANTINE_MAX_FRACTION),
+                hedge_quantile_base=conf.get_float(
+                    Keys.USER_REMOTE_READ_HEDGE_QUANTILE),
+                remote_concurrency_base=conf.get_int(
+                    Keys.USER_REMOTE_READ_CONCURRENCY),
+                prefetch_budget_base=conf.get_bytes(
+                    Keys.PREFETCH_BUDGET_BYTES))
+            self.health_monitor.alert_listeners.append(
+                self.remediation.on_alerts)
 
         # source -> wall time of its last full registration; reset on
         # (re-)init conservatively — ages restart at 0, which only
@@ -474,8 +509,15 @@ class MasterProcess:
         from alluxio_tpu.heartbeat import HeartbeatContext as HC
         from alluxio_tpu.master.replication import ReplicationChecker
 
-        checker = ReplicationChecker(self.fs_master, self.block_master,
-                                     job_client)
+        checker = ReplicationChecker(
+            self.fs_master, self.block_master, job_client,
+            max_inflight=self._conf.get_int(
+                Keys.MASTER_REPLICATION_MAX_INFLIGHT))
+        self.replication_checker = checker
+        if self.remediation is not None:
+            # the re-replication action needs the job service; like the
+            # checker itself it binds late, once one exists
+            self.remediation.bind_replication(checker)
         t = HeartbeatThread(
             HC.MASTER_REPLICATION_CHECK, _Exec(checker.heartbeat),
             interval_s if interval_s is not None else

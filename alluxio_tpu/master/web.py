@@ -41,11 +41,12 @@ def _dashboard_html() -> bytes:
         sections=[("Cluster", "info"), ("Workers", "workers"),
                   ("Mounts", "mounts"), ("Catalog", "catalog"),
                   ("Cluster health", "health"),
+                  ("Self-healing", "remediation"),
                   ("Input doctor", "stall")],
         raw_routes=["/api/v1/master/info", "/capacity", "/metrics",
-                    "/metrics/history", "/health", "/mounts",
-                    "/catalog", "/trace", "/browse", "/config",
-                    "/logs"],
+                    "/metrics/history", "/health", "/remediation",
+                    "/mounts", "/catalog", "/trace", "/browse",
+                    "/config", "/logs"],
         js_body="""
     const info = await j('/info');
     const t = document.getElementById('info');
@@ -79,6 +80,26 @@ def _dashboard_html() -> bytes:
     if (!h.alerts.length)
       row(ht, ['(no alerts firing — ' + h.rules.length +
                ' rules watching)', '', '', '']);
+    // self-healing: the remediation engine's audited timeline
+    const rem = await j('/remediation');
+    const rt = document.getElementById('remediation');
+    if (!rem.enabled) {
+      row(rt, ['(remediation disabled — ' +
+               'atpu.master.remediation.enabled)', '', '', '']);
+    } else {
+      row(rt, ['mode: ' + (rem.dry_run ? 'DRY-RUN' : 'active') +
+               ', ' + rem.actions_in_window + '/' +
+               rem.max_actions_per_window + ' actions in window, ' +
+               rem.quarantined.length + ' quarantined',
+               '', '', ''], true);
+      row(rt, ['when', 'cause', 'action', 'outcome'], true);
+      for (const a of rem.audit.slice(-15).reverse())
+        row(rt, [new Date(1e3 * a.at).toISOString().slice(11, 19),
+                 a.rule + ' on ' + a.subject, a.action,
+                 a.outcome + (a.reverted_at ? ' (reverted)' : '')]);
+      if (!rem.audit.length)
+        row(rt, ['(no actions taken yet)', '', '', '']);
+    }
     // input doctor: rank loader input waits by serving tier
     // (Cluster.* roll-up when clients report, else this process's own)
     const met = (await j('/metrics')).metrics;
@@ -276,7 +297,17 @@ class MasterWebServer:
                         return {"status": "DISABLED", "alerts": [],
                                 "pending": [], "recently_resolved": [],
                                 "rules": []}
-                    return hm.fresh_report()
+                    resp = hm.fresh_report()
+                    engine = getattr(mp, "remediation", None)
+                    if engine is not None:
+                        resp["remediation"] = engine.report()
+                    return resp
+                if route == "/api/v1/master/remediation":
+                    engine = getattr(mp, "remediation", None)
+                    if engine is None:
+                        return {"enabled": False, "audit": [],
+                                "quarantined": [], "overlay": {}}
+                    return engine.report()
                 if route == "/api/v1/master/mounts":
                     return {"mounts": [
                         {"path": m.alluxio_path, "ufs": m.ufs_uri,

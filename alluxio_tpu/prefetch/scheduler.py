@@ -38,6 +38,19 @@ OUTCOME_STALE = "stale"
 _LIVE_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
+def retune_budget(budget_bytes: "Optional[int]") -> int:
+    """Remediation hook: apply a master-pushed byte budget to every
+    live scheduler in this process (``None`` restores each scheduler's
+    own configured budget).  Returns how many schedulers were retuned —
+    the client's overlay application logs it.  The HBM/DRAM split
+    ratio each scheduler was built with is preserved."""
+    n = 0
+    for s in list(_LIVE_SCHEDULERS):
+        s.set_budget(budget_bytes)
+        n += 1
+    return n
+
+
 def _register_gauges() -> None:
     """(Re-)register the process-wide prefetch gauges. Idempotent, and
     safe to call per scheduler: the registered functions read the live
@@ -80,6 +93,10 @@ class PrefetchScheduler:
         self._lookahead = max(1, lookahead_blocks)
         self._budget = max(0, budget_bytes)
         self._hbm_budget = int(self._budget * hbm_fraction)
+        #: what the service configured, kept so a withdrawn remediation
+        #: overlay can restore it (set_budget(None))
+        self._configured_budget = self._budget
+        self._hbm_fraction = hbm_fraction
         self._retry_backoff_s = retry_backoff_s
         self._lock = threading.Lock()
         # consumer cursor (epoch, position within the host's sequence)
@@ -115,6 +132,17 @@ class PrefetchScheduler:
         # oracle+manifest) for process lifetime
         _LIVE_SCHEDULERS.add(self)
         _register_gauges()
+
+    # -- retuning -----------------------------------------------------------
+    def set_budget(self, budget_bytes: "Optional[int]") -> None:
+        """Live byte-budget retune (remediation overlay; ``None``
+        restores the configured value).  Held bytes are untouched — a
+        shrink simply stops admitting new placements until consumes
+        drain below the new ceiling."""
+        with self._lock:
+            self._budget = self._configured_budget \
+                if budget_bytes is None else max(0, int(budget_bytes))
+            self._hbm_budget = int(self._budget * self._hbm_fraction)
 
     # -- cursor -------------------------------------------------------------
     def begin_epoch(self, epoch: int) -> int:

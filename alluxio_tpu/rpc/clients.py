@@ -312,8 +312,14 @@ class BlockMasterClient(_BaseClient):
         resp = self._call("get_block_infos", {"block_ids": block_ids})
         return [BlockInfo.from_wire(d) for d in resp["infos"]]
 
-    def get_worker_infos(self, include_lost: bool = False) -> List[WorkerInfo]:
-        resp = self._call("get_worker_infos", {"include_lost": include_lost})
+    def get_worker_infos(self, include_lost: bool = False,
+                         include_quarantined: bool = False
+                         ) -> List[WorkerInfo]:
+        """Default view excludes quarantined workers — it is the
+        placement listing; admin/report callers opt them back in."""
+        resp = self._call("get_worker_infos",
+                          {"include_lost": include_lost,
+                           "include_quarantined": include_quarantined})
         return [WorkerInfo.from_wire(d) for d in resp["infos"]]
 
     def get_capacity(self) -> Dict[str, Dict[str, int]]:
@@ -379,13 +385,16 @@ class MetaMasterClient(_BaseClient):
 
     def metrics_heartbeat(self, source: str,
                           metrics: Dict[str, float],
-                          spans: Optional[List[dict]] = None) -> None:
+                          spans: Optional[List[dict]] = None) -> dict:
         """Ship a node's metric snapshot — and any completed trace spans
         drained from its ring — for cluster aggregation / trace
-        stitching (reference: ``metric_master.proto`` ClientMasterSync)."""
-        self._call("metrics_heartbeat", {"source": source,
-                                         "metrics": metrics,
-                                         "spans": spans or []})
+        stitching (reference: ``metric_master.proto`` ClientMasterSync).
+        The response may carry a remediation config overlay
+        (``conf_overlay`` + ``conf_overlay_version``) the client is
+        expected to apply — see docs/self_healing.md."""
+        return self._call("metrics_heartbeat", {"source": source,
+                                                "metrics": metrics,
+                                                "spans": spans or []})
 
     def get_metrics_history(self, name: str = "", *, source: str = "",
                             resolution: str = "raw", since: float = 0.0,

@@ -237,9 +237,14 @@ def block_master_service(bm: BlockMaster) -> ServiceDefinition:
         {})[-1])
     u("device_block_map", lambda r: {"map": {
         str(bid): m for bid, m in bm.device_block_map().items()}})
+    # wire default EXCLUDES quarantined workers: remote callers of this
+    # listing are placement choosers (write policy, UFS read-through
+    # pick, prefetch agent) and quarantine works by disappearing from
+    # their view; admin surfaces opt back in with include_quarantined
     u("get_worker_infos", lambda r: {"infos": [
         w.to_wire() for w in bm.get_worker_infos(
-            include_lost=r.get("include_lost", False))]})
+            include_lost=r.get("include_lost", False),
+            include_quarantined=r.get("include_quarantined", False))]})
     u("get_capacity", lambda r: {"capacity": bm.capacity_bytes_on_tiers(),
                                  "used": bm.used_bytes_on_tiers()})
     return svc
@@ -253,7 +258,8 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         config_checker=None,
                         permission_checker=None,
                         metrics_master=None,
-                        health_monitor=None) -> ServiceDefinition:
+                        health_monitor=None,
+                        remediation_engine=None) -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
     config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
@@ -350,7 +356,16 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                 if authenticated_user() is None:
                     raise UnauthenticatedError(
                         "metrics_heartbeat requires an authenticated user")
-            return metrics_master.handle_heartbeat(r)
+            resp = metrics_master.handle_heartbeat(r)
+            if remediation_engine is not None:
+                # piggyback the retuning overlay: no extra RPC, and
+                # every reporting client converges within one
+                # heartbeat interval of a push or revert
+                overlay, version = remediation_engine.heartbeat_overlay()
+                if overlay:
+                    resp["conf_overlay"] = overlay
+                resp["conf_overlay_version"] = version
+            return resp
         return {}
 
     def _get_metrics_history(r):
@@ -377,7 +392,12 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
             raise FailedPreconditionError(
                 "the health-rule engine is disabled on this master "
                 "(atpu.master.health.enabled)")
-        return health_monitor.fresh_report(bool(r.get("evaluate", True)))
+        resp = health_monitor.fresh_report(bool(r.get("evaluate", True)))
+        if remediation_engine is not None:
+            # the remediation timeline rides the health report: cause
+            # (alert) and effect (action) belong on one screen
+            resp["remediation"] = remediation_engine.report()
+        return resp
 
     svc.unary("get_metrics", _get_metrics)
     svc.unary("metrics_heartbeat", _metrics_heartbeat)

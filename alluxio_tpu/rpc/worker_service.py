@@ -95,8 +95,11 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
         import time as _time
 
         from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils import faults
 
         clock = _time.monotonic
+        fault_host = worker.address.tiered_identity.value("host") \
+            or worker.address.host
         block_id = req["block_id"]
         offset = req.get("offset", 0)
         length = req.get("length", -1)
@@ -119,6 +122,13 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
                         n = min(chunk, end - pos)
                         t0 = clock()
                         data = r.read(pos, n)
+                        if faults.armed():
+                            # inside the timed region on purpose: the
+                            # injected straggler must show up in
+                            # Worker.ReadBlockTime (and thus in the
+                            # p99-regression rule) like a real one
+                            faults.injector().maybe_sleep_read(
+                                fault_host)
                         produce_s += clock() - t0
                         produced_b += len(data)
                         yield {"data": data, "offset": pos,

@@ -63,7 +63,8 @@ class ReportCommand(Command):
     def _summary(self, ctx):
         info = ctx.meta_client().get_master_info()
         cap = ctx.block_client().get_capacity()
-        workers = ctx.block_client().get_worker_infos()
+        workers = ctx.block_client().get_worker_infos(
+            include_quarantined=True)
         started = time.strftime(
             "%m-%d-%Y %H:%M:%S",
             time.localtime(info.get("start_time_ms", 0) / 1000))
@@ -74,7 +75,10 @@ class ReportCommand(Command):
         ctx.print(f"    Started: {started}")
         ctx.print(f"    Uptime: {int(uptime_s)}s")
         ctx.print(f"    Safe Mode: {info.get('safe_mode', False)}")
-        ctx.print(f"    Live Workers: {len(workers)}")
+        quarantined = sum(1 for w in workers if w.state == "QUARANTINED")
+        ctx.print(f"    Live Workers: {len(workers)}"
+                  + (f" ({quarantined} quarantined)"
+                     if quarantined else ""))
         total = sum(cap["capacity"].values())
         used = sum(cap["used"].values())
         ctx.print(f"    Total Capacity: {human_size(total)}")
@@ -89,7 +93,8 @@ class ReportCommand(Command):
         return 0
 
     def _capacity(self, ctx):
-        workers = ctx.block_client().get_worker_infos(include_lost=True)
+        workers = ctx.block_client().get_worker_infos(
+            include_lost=True, include_quarantined=True)
         ctx.print(f"{'Worker Name':<28s} {'Last Heartbeat':>14s} "
                   f"{'Storage':>9s} {'Total':>12s} {'Used':>12s} "
                   f"{'State':>8s}")
@@ -140,6 +145,20 @@ class ReportCommand(Command):
                       f"from lost workers that never re-registered — "
                       f"run `fsadmin report health` and restart or "
                       f"remove the dead workers")
+        repl_failed = snap.get("Master.ReplicationJobsFailed", 0)
+        if repl_failed:
+            ctx.print(f"WARN: {int(repl_failed)} replication job "
+                      f"launches failed — is the job service up? "
+                      f"deficits persist until launches succeed")
+        repl_deferred = snap.get("Master.ReplicationJobsDeferred", 0)
+        if repl_deferred:
+            ctx.print(f"WARN: {int(repl_deferred)} replication jobs "
+                      f"deferred by the in-flight cap "
+                      f"(atpu.master.replication.max.inflight; "
+                      f"currently "
+                      f"{int(snap.get('Master.ReplicationJobsInflight', 0))}"
+                      f" in flight) — expected during mass recovery, "
+                      f"raise the cap if it never drains")
         return 0
 
     def _history(self, ctx, args):
@@ -247,7 +266,47 @@ class ReportCommand(Command):
         if not alerts:
             ctx.print(f"  no alerts firing — "
                       f"{len(resp.get('rules', []))} rules watching")
+        self._remediation(ctx, resp.get("remediation"))
         return 0 if resp["status"] in ("OK", "WARN") else 1
+
+    @staticmethod
+    def _remediation(ctx, rem):
+        """Self-healing timeline: every audit row is one
+        cause -> action -> resolution line, so the operator reads what
+        the engine did (or would do, in dry-run) and why, in order."""
+        if not rem:
+            return  # engine disabled: report is byte-identical to PR-5
+        mode = "DRY-RUN" if rem.get("dry_run") else "active"
+        ctx.print(f"Self-healing ({mode}): "
+                  f"{rem.get('actions_in_window', 0)}/"
+                  f"{rem.get('max_actions_per_window', 0)} actions in "
+                  f"window, {len(rem.get('quarantined', []))} "
+                  f"quarantined, {len(rem.get('overlay', {}))} tuning "
+                  f"overlay key(s) pushed")
+        for q in rem.get("quarantined", []):
+            state = "probation" if q.get("probation_since") else \
+                "quarantined"
+            ctx.print(f"  [{state}] {q['subject']} "
+                      f"(cause: {q['rule']})")
+        for k, v in sorted(rem.get("overlay", {}).items()):
+            ctx.print(f"  [overlay] {k} = {v}")
+        audit = rem.get("audit", [])
+        for a in audit[-12:]:
+            when = time.strftime("%m-%d %H:%M:%S",
+                                 time.localtime(a["at"]))
+            resolution = ""
+            if a.get("reverted_at"):
+                resolution = (" -> reverted "
+                              + time.strftime(
+                                  "%H:%M:%S",
+                                  time.localtime(a["reverted_at"])))
+            elif a.get("resolved_at"):
+                resolution = " -> alert resolved"
+            ctx.print(f"  {when}  {a['rule']} on {a['subject']} -> "
+                      f"{a['action']} [{a['outcome']}]{resolution}")
+            ctx.print(f"      {a['summary']}")
+        if not audit:
+            ctx.print("  no actions audited yet")
 
     def _stall(self, ctx):
         """Input doctor: ranked per-tier attribution of loader input

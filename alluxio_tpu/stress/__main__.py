@@ -108,6 +108,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fail the bench above this heartbeat-handling "
                          "overhead with history enabled")
 
+    sh = sub.add_parser("selfheal",
+                        help="remediation engine: detection->action "
+                             "latency + health-tick overhead "
+                             "(fake-clock harness)")
+    sh.add_argument("--sources", type=int, default=64,
+                    help="fleet size driving the health tick (matches "
+                         "bench-health's model); the engine's cost is "
+                         "per-tick constant, the tick scales with this")
+    sh.add_argument("--ticks", type=int, default=60)
+    sh.add_argument("--batches", type=int, default=6)
+    sh.add_argument("--eval-interval", type=float, default=5.0,
+                    help="simulated health-eval period (seconds)")
+    sh.add_argument("--fire-after", type=float, default=10.0,
+                    help="simulated alert fire debounce (seconds)")
+    sh.add_argument("--max-overhead-pct", type=float, default=2.0,
+                    help="fail the bench above this added health-tick "
+                         "overhead with the engine attached")
+
     uc = sub.add_parser("ufscold", help="striped vs single-stream cold "
                                         "UFS reads (connection-limited "
                                         "UFS model)")
@@ -188,6 +206,7 @@ SUITE = (
     ("write-eviction", ["write"]),
     ("obs-tracing-overhead", ["obs"]),
     ("health-ingest-overhead", ["health"]),
+    ("selfheal-remediation", ["selfheal"]),
     ("ufs-cold-read", ["ufscold"]),
     ("remote-warm-read", ["remoteread"]),
 )
@@ -358,6 +377,14 @@ def main(argv=None) -> int:
         r = run(sources=args.sources,
                 metrics_per_source=args.metrics_per_source,
                 ticks=args.ticks, batches=args.batches,
+                max_overhead_pct=args.max_overhead_pct)
+    elif args.bench == "selfheal":
+        from alluxio_tpu.stress.selfheal_bench import run
+
+        r = run(sources=args.sources, ticks=args.ticks,
+                batches=args.batches,
+                eval_interval_s=args.eval_interval,
+                fire_after_s=args.fire_after,
                 max_overhead_pct=args.max_overhead_pct)
     elif args.bench == "ufscold":
         from alluxio_tpu.stress.ufs_cold_bench import run

@@ -1,0 +1,137 @@
+"""Conf-gated fault injection for chaos and self-healing tests.
+
+One process-wide injector (in-process miniclusters deliberately share
+it) holds three faults, each scoped by an optional host/source
+substring so a multi-worker cluster can break exactly one node:
+
+- **read-latency inflation** — the worker's warm ``read_block`` path
+  sleeps per chunk, inflating ``Worker.ReadBlockTime`` so the
+  p99-regression health rule (and the remediation engine behind it)
+  can be driven end to end;
+- **heartbeat freeze** — the worker's metrics reporter silently skips
+  its ticks, driving the heartbeat-staleness rule without killing the
+  process;
+- **UFS error rate** — a deterministic fraction of UFS stripe reads
+  fail with an injected ``IOError`` (counter-based, not random: the
+  Nth failure lands at the same read in every run).
+
+The hooks are gated on a single module flag, so a production cluster
+that never sets ``atpu.debug.fault.*`` pays one attribute read per
+hook site.  Everything here is test/chaos machinery: see
+``docs/self_healing.md`` for how the remediation tests use it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class FaultInjector:
+    """Mutable fault state; thread-safe (hooks read under no lock —
+    torn reads of independent floats are harmless for chaos knobs)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.read_latency_s: float = 0.0
+        self.heartbeat_freeze: bool = False
+        self.ufs_error_rate: float = 0.0
+        self.scope: str = ""
+        #: injected-fault tallies, for tests and fsadmin spelunking
+        self.injected = {"read_latency": 0, "heartbeat_freeze": 0,
+                         "ufs_error": 0}
+        self._ufs_reads = 0
+        self._ufs_failed = 0
+
+    # ----------------------------------------------------------- config
+    def configure(self, conf) -> None:
+        """Arm from ``atpu.debug.fault.*`` (worker boot calls this)."""
+        from alluxio_tpu.conf import Keys
+
+        self.set(
+            read_latency_s=conf.get_duration_s(
+                Keys.DEBUG_FAULT_READ_LATENCY),
+            heartbeat_freeze=conf.get_bool(
+                Keys.DEBUG_FAULT_HEARTBEAT_FREEZE),
+            ufs_error_rate=conf.get_float(Keys.DEBUG_FAULT_UFS_ERROR_RATE),
+            scope=str(conf.get(Keys.DEBUG_FAULT_SCOPE) or ""))
+
+    def set(self, *, read_latency_s: Optional[float] = None,
+            heartbeat_freeze: Optional[bool] = None,
+            ufs_error_rate: Optional[float] = None,
+            scope: Optional[str] = None) -> None:
+        global _armed
+        with self._lock:
+            if read_latency_s is not None:
+                self.read_latency_s = max(0.0, float(read_latency_s))
+            if heartbeat_freeze is not None:
+                self.heartbeat_freeze = bool(heartbeat_freeze)
+            if ufs_error_rate is not None:
+                self.ufs_error_rate = min(1.0, max(
+                    0.0, float(ufs_error_rate)))
+            if scope is not None:
+                self.scope = str(scope)
+            _armed = bool(self.read_latency_s or self.heartbeat_freeze
+                          or self.ufs_error_rate)
+
+    def reset(self) -> None:
+        global _armed
+        with self._lock:
+            self.read_latency_s = 0.0
+            self.heartbeat_freeze = False
+            self.ufs_error_rate = 0.0
+            self.scope = ""
+            self._ufs_reads = 0
+            self._ufs_failed = 0
+            for k in self.injected:
+                self.injected[k] = 0
+            _armed = False
+
+    # ------------------------------------------------------------ hooks
+    def _in_scope(self, key: str) -> bool:
+        return not self.scope or self.scope in key
+
+    def maybe_sleep_read(self, host: str) -> None:
+        if self.read_latency_s > 0 and self._in_scope(host):
+            self.injected["read_latency"] += 1
+            time.sleep(self.read_latency_s)
+
+    def heartbeat_frozen(self, source: str) -> bool:
+        if self.heartbeat_freeze and self._in_scope(source):
+            self.injected["heartbeat_freeze"] += 1
+            return True
+        return False
+
+    def take_ufs_error(self, host: str) -> bool:
+        """True when this UFS stripe read should fail.  Deterministic:
+        fail whenever the failed/total ratio has fallen behind the
+        configured rate — rate 0.25 fails exactly reads 1, 5, 9, ..."""
+        rate = self.ufs_error_rate
+        if rate <= 0 or not self._in_scope(host):
+            return False
+        with self._lock:
+            self._ufs_reads += 1
+            if self._ufs_failed < rate * self._ufs_reads:
+                self._ufs_failed += 1
+                self.injected["ufs_error"] += 1
+                return True
+        return False
+
+
+#: fast-path gate the hook sites check before touching the injector
+_armed = False
+_injector = FaultInjector()
+
+
+def injector() -> FaultInjector:
+    return _injector
+
+
+def armed() -> bool:
+    return _armed
+
+
+class InjectedFaultError(IOError):
+    """Raised by the UFS hook; a distinct type so tests can tell an
+    injected failure from a real one."""

@@ -105,8 +105,15 @@ class _MetricsReporter:
 
     def heartbeat(self) -> None:
         from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils import faults
         from alluxio_tpu.utils.tracing import tracer
 
+        if faults.armed() and \
+                faults.injector().heartbeat_frozen(self._source):
+            # injected fault: the node is alive but its telemetry is
+            # not — exactly the wedge the heartbeat-staleness rule and
+            # the quarantine remediation exist to catch
+            return
         spans = tracer().drain(500) if tracer().enabled else []
         try:
             self._client.metrics_heartbeat(self._source,
@@ -131,6 +138,11 @@ class BlockWorker:
                  meta_master_client=None) -> None:
         self._meta_client = meta_master_client
         self._conf = conf
+        from alluxio_tpu.utils import faults
+
+        # arm the conf-gated fault hooks (atpu.debug.fault.*) — a
+        # no-op with the defaults; chaos/self-healing tests set them
+        faults.injector().configure(conf)
         self.store = build_store_from_conf(conf)
         self.ufs_manager = ufs_manager or UfsManager()
         host = conf.get(Keys.WORKER_HOSTNAME)
@@ -151,8 +163,10 @@ class BlockWorker:
             promote=conf.get_bool(Keys.WORKER_MANAGEMENT_TIER_PROMOTE_ENABLED),
             quota_percent=conf.get_int(
                 Keys.WORKER_MANAGEMENT_PROMOTE_QUOTA_PERCENT))
-        self.ufs_fetcher = UfsBlockFetcher(self.store,
-                                           FetchConf.from_conf(conf))
+        self.ufs_fetcher = UfsBlockFetcher(
+            self.store, FetchConf.from_conf(conf),
+            host=self.address.tiered_identity.value("host")
+            or self.address.host)
         self.web_server = None
         self.web_port: Optional[int] = None
         self.async_cache = AsyncCacheManager(

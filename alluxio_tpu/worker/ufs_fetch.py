@@ -480,9 +480,12 @@ class UfsBlockFetcher:
     same block attaches to it mid-flight (``Worker.UfsFetchCoalesced``).
     """
 
-    def __init__(self, store: TieredBlockStore, conf: FetchConf) -> None:
+    def __init__(self, store: TieredBlockStore, conf: FetchConf, *,
+                 host: str = "") -> None:
         self._store = store
         self.conf = conf
+        #: locality host the fault-injection scope matches against
+        self._fault_host = host
         self._lock = threading.Lock()
         self._inflight: Dict[int, BlockFetch] = {}
         self._executors: Dict[int, ThreadPoolExecutor] = {}
@@ -616,6 +619,13 @@ class UfsBlockFetcher:
             for attempt in (0, 1):
                 try:
                     if ln > 0:
+                        from alluxio_tpu.utils import faults
+
+                        if faults.armed() and faults.injector() \
+                                .take_ufs_error(self._fault_host):
+                            raise faults.InjectedFaultError(
+                                f"injected UFS fault for stripe {i} of "
+                                f"block {fetch.desc.block_id}")
                         data = ufs.read_range(fetch.desc.ufs_path,
                                               fetch.desc.offset + off, ln)
                         if len(data) != ln:

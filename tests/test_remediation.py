@@ -1,0 +1,745 @@
+"""Self-healing remediation engine: unit + closed-loop tests.
+
+Covers the acceptance path of the remediation tentpole: injected
+straggler -> p99 alert fires -> quarantine + targeted re-replication,
+audited -> fault lifted -> alert resolves -> probation release; plus
+dry-run (actions suppressed but audited), action-cap/cooldown property
+tests on a fake clock, the heartbeat-piggybacked config overlay
+(push -> client applies clamped -> revert restores), the
+ReplicationChecker satellites (counters, in-flight cap,
+transport-vs-notfound reap) and the conf-gated fault-injection hooks.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.master.remediation import (
+    ACTION_QUARANTINE, ACTION_REREPLICATE, ACTION_RETUNE,
+    OVERLAY_HEDGE_QUANTILE, RemediationEngine,
+)
+from alluxio_tpu.minicluster.local_cluster import LocalCluster
+from alluxio_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.injector().reset()
+    yield
+    faults.injector().reset()
+
+
+# --------------------------------------------------------------------- stubs
+class _Clock:
+    def __init__(self, t: float = 1_000_000.0) -> None:
+        self.now = t
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, s: float) -> None:
+        self.now += s
+
+
+class _Addr:
+    def __init__(self, host, port):
+        self.host, self.rpc_port = host, port
+
+
+class _StubBM:
+    def __init__(self, n=2):
+        self.workers = {}
+        for i in range(n):
+            w = SimpleNamespace(
+                id=100 + i, address=_Addr(f"h{i}", 29999),
+                capacity_bytes_on_tiers={"MEM": 1 << 30},
+                blocks={10 * i + j: "MEM" for j in range(3)})
+            self.workers[w.id] = w
+        self.quarantined = set()
+
+    def worker_id_for_source(self, source):
+        for w in self.workers.values():
+            if f"worker-{w.address.host}:{w.address.rpc_port}" == source:
+                return w.id
+        return None
+
+    def get_worker_infos(self, include_lost=False,
+                         include_quarantined=True):
+        return [w for w in self.workers.values()
+                if include_quarantined or w.id not in self.quarantined]
+
+    def get_worker(self, wid):
+        return self.workers.get(wid)
+
+    def quarantine_worker(self, wid):
+        if wid not in self.workers:
+            return False
+        self.quarantined.add(wid)
+        return True
+
+    def release_worker(self, wid):
+        try:
+            self.quarantined.remove(wid)
+            return True
+        except KeyError:
+            return False
+
+    def quarantined_workers(self):
+        return {w: 0 for w in self.quarantined}
+
+
+class _StubReplication:
+    def __init__(self):
+        self.requests = []
+
+    def request_replication(self, block_ids, *, replicas=1):
+        self.requests.append((list(block_ids), replicas))
+        return list(block_ids)
+
+
+def _alert(rule, subject):
+    return SimpleNamespace(rule=rule, subject=subject)
+
+
+def _engine(clock, bm=None, **kw):
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("probation_s", 30.0)
+    kw.setdefault("window_s", 600.0)
+    kw.setdefault("max_actions_per_window", 4)
+    return RemediationEngine(bm or _StubBM(), clock=clock, **kw)
+
+
+P99 = "read-latency-p99-regression"
+
+
+# ------------------------------------------------------------- engine units
+class TestQuarantineLifecycle:
+    def test_quarantine_then_probation_release(self):
+        clock, bm = _Clock(), _StubBM()
+        eng = _engine(clock, bm)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        assert bm.quarantined == {101}
+        executed = [a for a in eng.report()["audit"]
+                    if a["outcome"] == "executed"]
+        # no job service bound here: re-replicate audits as skipped
+        assert [a["action"] for a in executed] == [ACTION_QUARANTINE]
+        # alert still firing: stays quarantined, no duplicate action
+        clock.advance(10)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        assert bm.quarantined == {101}
+        assert len([a for a in eng.report()["audit"]
+                    if a["action"] == ACTION_QUARANTINE]) == 1
+        # alert resolves: probation starts, release only after it
+        clock.advance(10)
+        eng.on_alerts([])
+        assert bm.quarantined == {101}  # probation holds
+        clock.advance(29)
+        eng.on_alerts([])
+        assert bm.quarantined == {101}
+        clock.advance(2)
+        eng.on_alerts([])
+        assert bm.quarantined == set()
+        releases = [a for a in eng.report()["audit"]
+                    if a["action"] == "release"]
+        assert len(releases) == 1
+        # the acting record carries the resolution timeline
+        acted = [a for a in eng.report()["audit"]
+                 if a["action"] == ACTION_QUARANTINE][0]
+        assert acted["resolved_at"] and acted["reverted_at"]
+
+    def test_refire_during_probation_cancels_release(self):
+        clock, bm = _Clock(), _StubBM()
+        eng = _engine(clock, bm)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        clock.advance(5)
+        eng.on_alerts([])           # clean: probation starts
+        clock.advance(5)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])  # refires
+        clock.advance(31)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        assert bm.quarantined == {101}  # never released
+
+    def test_rereplication_targets_hot_blocks(self):
+        clock, bm = _Clock(), _StubBM()
+        repl = _StubReplication()
+        eng = _engine(clock, bm, rereplicate_blocks=2)
+        eng.bind_replication(repl)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        [(blocks, replicas)] = repl.requests
+        assert replicas == 1 and len(blocks) == 2
+        assert set(blocks) <= set(bm.workers[101].blocks)
+
+    def test_rereplication_without_job_service_is_skipped_audited(self):
+        clock, bm = _Clock(), _StubBM()
+        eng = _engine(clock, bm)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        rows = [a for a in eng.report()["audit"]
+                if a["action"] == ACTION_REREPLICATE]
+        assert rows and rows[0]["outcome"] == "skipped"
+
+    def test_unknown_worker_subject_audits_failed(self):
+        clock, bm = _Clock(), _StubBM()
+        eng = _engine(clock, bm)
+        eng.on_alerts([_alert(P99, "worker-ghost:1")])
+        rows = [a for a in eng.report()["audit"]
+                if a["action"] == ACTION_QUARANTINE]
+        assert rows and rows[0]["outcome"] == "failed"
+        assert bm.quarantined == set()
+
+
+class TestBounds:
+    def test_action_cap_suppresses_but_audits(self):
+        clock, bm = _Clock(), _StubBM(n=4)
+        eng = _engine(clock, bm, max_actions_per_window=1)
+        eng.on_alerts([_alert(P99, "worker-h0:29999"),
+                       _alert(P99, "worker-h1:29999")])
+        assert bm.quarantined == {100}  # only the first got through
+        capped = [a for a in eng.report()["audit"]
+                  if a["outcome"] == "suppressed-cap"]
+        # h1's quarantine (and the rest of the would-be actions) hit
+        # the cap but are still audited
+        assert ("quarantine", "worker-h1:29999") in {
+            (a["action"], a["subject"]) for a in capped}
+
+    def test_cap_window_slides(self):
+        clock, bm = _Clock(), _StubBM(n=4)
+        eng = _engine(clock, bm, max_actions_per_window=1,
+                      window_s=100.0, cooldown_s=1.0)
+        eng.on_alerts([_alert(P99, "worker-h0:29999")])
+        clock.advance(101)  # window slid past the first action
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        assert bm.quarantined == {100, 101}
+
+    def test_cooldown_blocks_same_subject_and_audits_once(self):
+        clock, bm = _Clock(), _StubBM()
+        eng = _engine(clock, bm, cooldown_s=60.0, probation_s=0.0)
+        src = "worker-h1:29999"
+        eng.on_alerts([_alert(P99, src)])
+        eng.on_alerts([])            # resolves + releases (probation 0)
+        assert bm.quarantined == set()
+        for _ in range(5):           # flapping inside the cooldown
+            clock.advance(2)
+            eng.on_alerts([_alert(P99, src)])
+        assert bm.quarantined == set()  # cooldown holds
+        cooled = [a for a in eng.report()["audit"]
+                  if a["outcome"] == "suppressed-cooldown"
+                  and a["action"] == ACTION_QUARANTINE]
+        assert len(cooled) == 1      # once per episode, not per tick
+        clock.advance(61)
+        eng.on_alerts([_alert(P99, src)])
+        assert bm.quarantined == {101}  # cooldown expired: acts again
+
+    def test_quarantine_capacity_floor(self):
+        # 4 workers, floor 0.5 -> at most 2 quarantined; the third is
+        # skipped-and-audited, and NOT tracked active (releasing it
+        # later would "undo" something never applied)
+        clock, bm = _Clock(), _StubBM(n=4)
+        eng = _engine(clock, bm, max_actions_per_window=10,
+                      quarantine_max_fraction=0.5, probation_s=0.0)
+        eng.on_alerts([_alert(P99, f"worker-h{i}:29999")
+                       for i in range(3)])
+        assert bm.quarantined == {100, 101}
+        skipped = [a for a in eng.report()["audit"]
+                   if a["action"] == ACTION_QUARANTINE
+                   and a["outcome"] == "skipped"]
+        assert skipped and "floor" in skipped[0]["detail"]["reason"]
+        assert [q["subject"] for q in eng.report()["quarantined"]] == \
+            ["worker-h0:29999", "worker-h1:29999"]
+        # everything resolves: only the two real quarantines release
+        eng.on_alerts([])
+        assert bm.quarantined == set()
+        releases = [a for a in eng.report()["audit"]
+                    if a["action"] == "release"]
+        assert len(releases) == 2
+
+    def test_dry_run_audits_without_acting(self):
+        clock, bm = _Clock(), _StubBM()
+        repl = _StubReplication()
+        eng = _engine(clock, bm, dry_run=True)
+        eng.bind_replication(repl)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        assert bm.quarantined == set()
+        assert repl.requests == []
+        dry = [a["action"] for a in eng.report()["audit"]
+               if a["outcome"] == "dry-run"]
+        assert ACTION_QUARANTINE in dry and ACTION_REREPLICATE in dry
+        # dry-run actions count against the window: the audit previews
+        # exactly what live mode would have been allowed to do
+        assert eng.report()["actions_in_window"] == 2
+
+
+class TestRetuneOverlay:
+    def test_hedge_spike_pushes_then_reverts(self):
+        clock = _Clock()
+        eng = _engine(clock, probation_s=0.0, hedge_quantile_base=0.95)
+        eng.on_alerts([_alert("hedge-win-rate-spike", "cluster")])
+        overlay, v1 = eng.heartbeat_overlay()
+        assert overlay[OVERLAY_HEDGE_QUANTILE] == pytest.approx(0.76)
+        assert v1 == 1
+        # still firing: no version churn
+        clock.advance(5)
+        eng.on_alerts([_alert("hedge-win-rate-spike", "cluster")])
+        assert eng.heartbeat_overlay()[1] == v1
+        # cleared: overlay withdrawn, version bumps so clients revert
+        clock.advance(5)
+        eng.on_alerts([])
+        overlay, v2 = eng.heartbeat_overlay()
+        assert overlay == {} and v2 > v1
+        reverts = [a for a in eng.report()["audit"]
+                   if a["action"] == "revert"]
+        assert len(reverts) == 1
+
+    def test_stall_retune_scales_budget_and_concurrency(self):
+        clock = _Clock()
+        eng = _engine(clock, prefetch_budget_base=64 << 20,
+                      remote_concurrency_base=4)
+        eng.on_alerts([_alert("input-stall-sustained", "client-a")])
+        overlay, _ = eng.heartbeat_overlay()
+        assert overlay["atpu.prefetch.budget.bytes"] == 128 << 20
+        assert overlay["atpu.user.remote.read.concurrency"] == 8
+
+    def test_hedge_floor_clamped(self):
+        clock = _Clock()
+        eng = _engine(clock, hedge_quantile_base=0.55)
+        eng.on_alerts([_alert("hedge-win-rate-spike", "cluster")])
+        overlay, _ = eng.heartbeat_overlay()
+        assert overlay[OVERLAY_HEDGE_QUANTILE] == 0.5
+
+
+class TestRemediationHistorySeries:
+    def test_actions_sampled_into_history(self):
+        from alluxio_tpu.master.metrics_master import (
+            MetricsMaster, MetricsStore,
+        )
+        from alluxio_tpu.metrics.history import MetricsHistory
+
+        clock = _Clock()
+        mm = MetricsMaster(store=MetricsStore(clock=clock),
+                           history=MetricsHistory(clock=clock))
+        eng = _engine(clock, metrics_master=mm)
+        eng.on_alerts([_alert(P99, "worker-h1:29999")])
+        [series] = mm.history.query("Master.RemediationActions",
+                                    source="master")
+        assert series["points"]
+
+
+# -------------------------------------------------- block-master quarantine
+class TestBlockMasterQuarantine:
+    def _bm(self):
+        from alluxio_tpu.journal import NoopJournalSystem
+        from alluxio_tpu.master import BlockMaster
+        from alluxio_tpu.utils.wire import WorkerNetAddress
+
+        bm = BlockMaster(NoopJournalSystem())
+        wids = []
+        for i in range(2):
+            addr = WorkerNetAddress(host=f"h{i}", rpc_port=29999)
+            wid = bm.get_worker_id(addr)
+            bm.worker_register(wid, {"MEM": 1000}, {"MEM": 0}, {})
+            wids.append(wid)
+        return bm, wids
+
+    def test_quarantine_filters_placement_view_only(self):
+        bm, (w0, w1) = self._bm()
+        assert bm.quarantine_worker(w1)
+        placement = bm.get_worker_infos(include_quarantined=False)
+        assert [w.id for w in placement] == [w0]
+        full = bm.get_worker_infos()
+        assert {w.id: w.state for w in full}[w1] == "QUARANTINED"
+        assert bm.release_worker(w1)
+        assert len(bm.get_worker_infos(include_quarantined=False)) == 2
+
+    def test_worker_id_for_source(self):
+        bm, (w0, _) = self._bm()
+        assert bm.worker_id_for_source("worker-h0:29999") == w0
+        assert bm.worker_id_for_source("worker-nope:1") is None
+        assert bm.worker_id_for_source("client-h0:29999") is None
+
+    def test_loss_sheds_quarantine(self):
+        bm, (_, w1) = self._bm()
+        bm.quarantine_worker(w1)
+        bm.forget_worker(w1)
+        assert w1 not in bm.quarantined_workers()
+        # re-registration starts from a clean placement slate
+        bm.worker_register(w1, {"MEM": 1000}, {"MEM": 0}, {})
+        assert len(bm.get_worker_infos(include_quarantined=False)) == 2
+
+
+# --------------------------------------------- replication checker satellites
+class _FakeJobs:
+    def __init__(self):
+        self.launched = []
+        self.fail_run = False
+        self.status_error = None
+        self.statuses = {}
+        self._next = 1
+
+    def run(self, config):
+        if self.fail_run:
+            raise IOError("job master down")
+        jid = self._next
+        self._next += 1
+        self.launched.append((jid, config))
+        self.statuses[jid] = "RUNNING"
+        return jid
+
+    def get_status(self, jid):
+        if self.status_error is not None:
+            raise self.status_error
+        return SimpleNamespace(status=self.statuses[jid])
+
+
+class TestReplicationCheckerSatellites:
+    def _checker(self, jobs, **kw):
+        from alluxio_tpu.master.replication import ReplicationChecker
+
+        return ReplicationChecker(None, None, jobs, **kw)
+
+    def test_launch_failures_counted_not_inflight(self):
+        from alluxio_tpu.metrics import metrics
+
+        jobs = _FakeJobs()
+        jobs.fail_run = True
+        c = self._checker(jobs)
+        before = metrics().counter("Master.ReplicationJobsFailed").count
+        assert c.request_replication([1, 2]) == []
+        assert c._inflight == {}
+        after = metrics().counter("Master.ReplicationJobsFailed").count
+        assert after - before == 2
+
+    def test_inflight_cap_defers(self):
+        jobs = _FakeJobs()
+        c = self._checker(jobs, max_inflight=2)
+        assert c.request_replication([1, 2, 3]) == [1, 2]
+        assert len(c._inflight) == 2
+
+    def test_transport_error_keeps_inflight_notfound_reaps(self):
+        from alluxio_tpu.utils.exceptions import NotFoundError
+
+        jobs = _FakeJobs()
+        c = self._checker(jobs)
+        c.request_replication([7])
+        # transport blip: entry retained (a reap here would drop the
+        # dedupe and double-launch next heartbeat)
+        jobs.status_error = IOError("transient RPC blip")
+        c._reap_finished()
+        assert 7 in c._inflight
+        # genuinely evicted from the job master: reaped
+        jobs.status_error = NotFoundError("job 1 does not exist")
+        c._reap_finished()
+        assert c._inflight == {}
+
+    def test_launch_reservation_dedupes_mid_rpc(self):
+        # the remediation engine and the constraint walk are two writer
+        # threads: while one launch RPC is in flight its slot is
+        # reserved, so the other caller dedupes instead of
+        # double-launching
+        jobs = _FakeJobs()
+        c = self._checker(jobs)
+        orig_run, reentered = jobs.run, []
+
+        def slow_run(config):
+            reentered.append(
+                c.request_replication([config["block_id"]]))
+            return orig_run(config)
+
+        jobs.run = slow_run
+        assert c.request_replication([5]) == [5]
+        assert reentered == [[]]
+        # a reservation is invisible to the reaper (job id not real yet)
+        c._inflight[9] = c._RESERVED
+        c._reap_finished()
+        assert 9 in c._inflight
+
+    def test_finished_jobs_reaped_and_dedupe_holds(self):
+        jobs = _FakeJobs()
+        c = self._checker(jobs)
+        c.request_replication([7])
+        assert c.request_replication([7]) == []  # deduped while inflight
+        jobs.statuses[1] = "COMPLETED"
+        c._reap_finished()
+        assert c.request_replication([7]) == [7]  # relaunches after
+
+
+# ------------------------------------------------------------ fault injection
+class TestFaultInjection:
+    def test_ufs_error_rate_deterministic(self):
+        inj = faults.injector()
+        inj.set(ufs_error_rate=0.5)
+        outcomes = [inj.take_ufs_error("any") for _ in range(10)]
+        assert sum(outcomes) == 5
+        assert outcomes == [True, False] * 5
+
+    def test_scope_gates_every_hook(self):
+        inj = faults.injector()
+        inj.set(read_latency_s=0.001, heartbeat_freeze=True,
+                ufs_error_rate=1.0, scope="w1")
+        assert not inj.heartbeat_frozen("worker-w0:1")
+        assert inj.heartbeat_frozen("worker-w1:1")
+        assert not inj.take_ufs_error("w0")
+        assert inj.take_ufs_error("w1")
+        t0 = time.monotonic()
+        inj.maybe_sleep_read("w0")
+        assert time.monotonic() - t0 < 0.5e-3
+
+    def test_armed_flag_tracks_state(self):
+        assert not faults.armed()
+        faults.injector().set(read_latency_s=0.01)
+        assert faults.armed()
+        faults.injector().set(read_latency_s=0.0)
+        assert not faults.armed()
+
+    def test_heartbeat_freeze_skips_reporter(self):
+        from alluxio_tpu.worker.process import _MetricsReporter
+
+        calls = []
+        client = SimpleNamespace(
+            metrics_heartbeat=lambda *a, **k: calls.append(a))
+        rep = _MetricsReporter(client, "worker-w1:29999")
+        faults.injector().set(heartbeat_freeze=True, scope="w1")
+        rep.heartbeat()
+        assert calls == []
+        faults.injector().set(heartbeat_freeze=False)
+        rep.heartbeat()
+        assert len(calls) == 1
+
+    def test_configure_from_conf(self, conf):
+        conf.set(Keys.DEBUG_FAULT_READ_LATENCY, "25ms")
+        conf.set(Keys.DEBUG_FAULT_UFS_ERROR_RATE, 0.25)
+        conf.set(Keys.DEBUG_FAULT_SCOPE, "w7")
+        inj = faults.injector()
+        inj.configure(conf)
+        assert inj.read_latency_s == pytest.approx(0.025)
+        assert inj.ufs_error_rate == 0.25
+        assert inj.scope == "w7"
+        assert faults.armed()
+
+
+# --------------------------------------------------------------- end to end
+@pytest.fixture()
+def heal_cluster(tmp_path):
+    # three workers: the p99-regression rule compares against the fleet
+    # MEDIAN, and with exactly two workers the median is the midpoint —
+    # no straggler can ever exceed 3x it
+    with LocalCluster(str(tmp_path), num_workers=3,
+                      start_job_service=True, conf_overrides={
+            Keys.MASTER_REMEDIATION_ENABLED: True,
+            Keys.MASTER_REMEDIATION_COOLDOWN: "200ms",
+            Keys.MASTER_REMEDIATION_PROBATION: "0s",
+            Keys.MASTER_HEALTH_FIRE_AFTER: "0s",
+            Keys.MASTER_HEALTH_RESOLVE_AFTER: "0s",
+            Keys.MASTER_HEALTH_STALL_WINDOW: "2s",
+            # the test drives evaluation deterministically
+            Keys.MASTER_HEALTH_EVAL_INTERVAL: "10min"}) as c:
+        yield c
+
+
+def _worker_sources(cluster):
+    return [f"worker-{h.worker.address.host}:{h.worker.address.rpc_port}"
+            for h in cluster.workers]
+
+
+def _beat_workers(cluster, p99s):
+    for src, p99 in zip(_worker_sources(cluster), p99s):
+        cluster.master.metrics_master.handle_heartbeat(
+            {"source": src,
+             "metrics": {"Worker.ReadBlockTime.p99": p99}})
+
+
+def _run_fsadmin(cluster, argv):
+    from alluxio_tpu.shell.command import ShellContext
+    from alluxio_tpu.shell.fsadmin_shell import ADMIN_SHELL
+
+    conf = cluster.conf.copy()
+    conf.set(Keys.MASTER_HOSTNAME, "localhost")
+    conf.set(Keys.MASTER_RPC_PORT, cluster.master.rpc_port)
+    out = io.StringIO()
+    ctx = ShellContext(conf, out=out, err=out)
+    code = ADMIN_SHELL.run(argv, ctx)
+    return code, out.getvalue()
+
+
+class TestClosedLoopEndToEnd:
+    """The acceptance path: injected straggler -> firing alert ->
+    audited quarantine + re-replication -> fault lifted -> resolution
+    -> probation release, all visible in `fsadmin report health`."""
+
+    def test_straggler_quarantined_rereplicated_released(
+            self, heal_cluster):
+        from alluxio_tpu.client.streams import WriteType
+
+        cluster = heal_cluster
+        master = cluster.master
+        fs = cluster.file_system()
+        # one cached block per file; find which worker holds blocks
+        for i in range(3):
+            fs.write_all(f"/heal/f{i}", b"x" * 4096,
+                         write_type=WriteType.MUST_CACHE)
+        held = {}  # source -> [block ids]
+        for i in range(3):
+            info = fs.get_status(f"/heal/f{i}")
+            for bid in info.block_ids:
+                binfo = master.block_master.get_block_info(bid)
+                for loc in binfo.locations:
+                    src = (f"worker-{loc.address.host}:"
+                           f"{loc.address.rpc_port}")
+                    held.setdefault(src, []).append(bid)
+        assert held, "no cached blocks after writes"
+        sick_source = max(held, key=lambda s: len(held[s]))
+        sources = _worker_sources(cluster)
+        sick_idx = sources.index(sick_source)
+        p99s = [0.002] * len(sources)
+        p99s[sick_idx] = 0.5
+
+        # straggler p99 heartbeats -> alert fires -> engine acts
+        _beat_workers(cluster, p99s)
+        master.health_monitor.evaluate()
+        alerts = {a.rule for a in master.health_monitor.firing()}
+        assert "read-latency-p99-regression" in alerts
+        report = master.remediation.report()
+        executed = {a["action"] for a in report["audit"]
+                    if a["outcome"] == "executed"}
+        assert ACTION_QUARANTINE in executed
+        assert [q["subject"] for q in report["quarantined"]] == \
+            [sick_source]
+
+        # quarantine removes the worker from the PLACEMENT listing...
+        placement = cluster.block_client().get_worker_infos()
+        assert sick_source not in {
+            f"worker-{w.address.host}:{w.address.rpc_port}"
+            for w in placement}
+        # ...but the admin view still shows it, marked
+        full = cluster.block_client().get_worker_infos(
+            include_quarantined=True)
+        states = {f"worker-{w.address.host}:{w.address.rpc_port}":
+                  w.state for w in full}
+        assert states[sick_source] == "QUARANTINED"
+
+        # targeted re-replication went through the job service
+        rerep = [a for a in report["audit"]
+                 if a["action"] == ACTION_REREPLICATE
+                 and a["outcome"] == "executed"]
+        assert rerep and rerep[0]["detail"]["blocks"]
+        target_block = rerep[0]["detail"]["blocks"][0]
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            locs = master.block_master.get_block_info(
+                target_block).locations
+            if len(locs) >= 2:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("re-replication job never landed a second copy")
+
+        # the operator sees the full cause -> action -> resolution
+        code, out = _run_fsadmin(cluster, ["report", "health"])
+        assert "Self-healing (active)" in out
+        assert "quarantine [executed]" in out
+        assert sick_source in out
+
+        # fault lifted: alert resolves -> probation (0s) -> release
+        _beat_workers(cluster, [0.002] * len(sources))
+        master.health_monitor.evaluate()
+        report = master.remediation.report()
+        assert report["quarantined"] == []
+        assert any(a["action"] == "release" for a in report["audit"])
+        placement = cluster.block_client().get_worker_infos()
+        assert sick_source in {
+            f"worker-{w.address.host}:{w.address.rpc_port}"
+            for w in placement}
+        code, out = _run_fsadmin(cluster, ["report", "health"])
+        assert "release" in out
+
+    def test_hedge_overlay_pushed_applied_and_reverted(
+            self, heal_cluster):
+        cluster = heal_cluster
+        master = cluster.master
+        mm = master.metrics_master
+        mm.CLUSTER_SAMPLE_INTERVAL_S = 0.0  # test drives sampling
+        fs = cluster.file_system()
+        base_q = fs.store.remote_read.conf.hedge_quantile
+        # rising hedge counters, wins dominating -> spike rule fires
+        for i in range(4):
+            mm.handle_heartbeat({
+                "source": "client-hedgy",
+                "metrics": {"Client.RemoteReadHedges": 100.0 * (i + 1),
+                            "Client.RemoteReadHedgeWins": 90.0 * (i + 1)}})
+            master.health_monitor.evaluate()
+            time.sleep(0.06)
+        assert any(a.rule == "hedge-win-rate-spike"
+                   for a in master.health_monitor.firing())
+        overlay, version = master.remediation.heartbeat_overlay()
+        assert overlay[OVERLAY_HEDGE_QUANTILE] < base_q
+
+        # the client applies it off its ordinary metrics heartbeat
+        fs.send_metrics()
+        assert fs.store.remote_read.conf.hedge_quantile == \
+            pytest.approx(overlay[OVERLAY_HEDGE_QUANTILE])
+
+        # counters stop rising -> once the rising samples age out of
+        # the 2s evidence window the rule resolves -> overlay reverts
+        time.sleep(2.2)
+        for i in range(3):
+            mm.handle_heartbeat({
+                "source": "client-hedgy",
+                "metrics": {"Client.RemoteReadHedges": 400.0,
+                            "Client.RemoteReadHedgeWins": 360.0}})
+            master.health_monitor.evaluate()
+            time.sleep(0.1)
+        overlay2, version2 = master.remediation.heartbeat_overlay()
+        assert overlay2 == {} and version2 > version
+        fs.send_metrics()
+        assert fs.store.remote_read.conf.hedge_quantile == \
+            pytest.approx(base_q)
+
+    def test_overlay_clamped_client_side(self, heal_cluster):
+        fs = heal_cluster.file_system()
+        fs.apply_conf_overlay(
+            {OVERLAY_HEDGE_QUANTILE: 0.01,
+             "atpu.user.remote.read.concurrency": 10_000,
+             "atpu.not.a.pushable.key": "ignored"}, version=99)
+        assert fs.store.remote_read.conf.hedge_quantile == 0.5
+        assert fs.store.remote_read.conf.concurrency == 64
+        # idempotent per version: a re-delivered overlay with the same
+        # version is not re-applied
+        fs.apply_conf_overlay(
+            {"atpu.user.remote.read.concurrency": 5}, version=99)
+        assert fs.store.remote_read.conf.concurrency == 64
+
+
+class TestDryRunAndDefaultOff:
+    def test_dry_run_minicluster_audits_only(self, tmp_path):
+        with LocalCluster(str(tmp_path), num_workers=3, conf_overrides={
+                Keys.MASTER_REMEDIATION_ENABLED: True,
+                Keys.MASTER_REMEDIATION_DRY_RUN: True,
+                Keys.MASTER_HEALTH_FIRE_AFTER: "0s",
+                Keys.MASTER_HEALTH_EVAL_INTERVAL: "10min"}) as cluster:
+            master = cluster.master
+            _beat_workers(cluster, [0.002, 0.002, 0.5])
+            master.health_monitor.evaluate()
+            report = master.remediation.report()
+            assert any(a["outcome"] == "dry-run"
+                       for a in report["audit"])
+            assert master.block_master.quarantined_workers() == {}
+            assert len(cluster.block_client().get_worker_infos()) == 3
+            _, out = _run_fsadmin(cluster, ["report", "health"])
+            assert "Self-healing (DRY-RUN)" in out
+
+    def test_default_off_is_inert(self, tmp_path):
+        with LocalCluster(str(tmp_path), num_workers=1, conf_overrides={
+                Keys.MASTER_HEALTH_EVAL_INTERVAL: "10min"}) as cluster:
+            master = cluster.master
+            assert master.remediation is None
+            assert master.health_monitor.alert_listeners == []
+            resp = cluster.meta_client().get_health()
+            assert "remediation" not in resp
+            hb = cluster.meta_client().metrics_heartbeat(
+                "client-x", {"Client.Bytes": 1.0})
+            assert "conf_overlay_version" not in (hb or {})
+            _, out = _run_fsadmin(cluster, ["report", "health"])
+            assert "Self-healing" not in out

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos sdist clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -36,6 +36,9 @@ bench-ufs-cold:  ## cold UFS reads: striped vs single-stream GB/s + ttfb (1.5x g
 
 bench-remote-read:  ## warm remote reads: striped vs single-stream GB/s + hedged straggler drill (1.5x gate at 4 stripes)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress remoteread
+
+bench-qos:  ## two-tenant QoS: victim read p99 under flood <=2x solo with QoS on + admission bounded-memory shedding
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress qos
 
 sdist:
 	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist

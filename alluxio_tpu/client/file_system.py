@@ -82,12 +82,24 @@ class FileSystem:
 
         md = tuple(client_metadata(self._conf))
         fp_dir = self._conf.get(Keys.MASTER_FASTPATH_DIR)
+        # retry budget from conf (atpu.user.rpc.retry.duration):
+        # overload drills shorten it so a flooded client gives up fast
+        # instead of stacking 30s of backoff behind a shedding master
+        retry_kw = dict(
+            retry_duration_s=self._conf.get_duration_s(
+                Keys.USER_RPC_RETRY_MAX_DURATION),
+            base_sleep_s=self._conf.get_duration_s(
+                Keys.USER_RPC_RETRY_BASE_SLEEP),
+            max_sleep_s=self._conf.get_duration_s(
+                Keys.USER_RPC_RETRY_MAX_SLEEP))
         self.fs_master = FsMasterClient(master_address, metadata=md,
-                                        fastpath_dir=fp_dir)
+                                        fastpath_dir=fp_dir, **retry_kw)
         self.block_master = BlockMasterClient(master_address, metadata=md,
-                                              fastpath_dir=fp_dir)
+                                              fastpath_dir=fp_dir,
+                                              **retry_kw)
         self.meta_master = MetaMasterClient(master_address, metadata=md,
-                                            fastpath_dir=fp_dir)
+                                            fastpath_dir=fp_dir,
+                                            **retry_kw)
         identity = TieredIdentity.from_spec(
             self._conf.get(Keys.TIERED_IDENTITY),
             hostname=socket.gethostname())

@@ -334,6 +334,45 @@ class Keys:
         description="Comma-separated master addresses for HA deployments; "
                     "overrides hostname:port when set (reference: "
                     "alluxio.master.rpc.addresses).")
+    MASTER_RPC_ADMISSION_ENABLED = _k(
+        "atpu.master.rpc.admission.enabled", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="Per-principal token-bucket admission control on "
+                    "the master RPC dispatch: calls beyond a "
+                    "principal's rate are shed with a typed "
+                    "ResourceExhausted carrying a retry-after hint "
+                    "(which the client retry policy honors) instead "
+                    "of queuing in the RPC executor. Off: dispatch is "
+                    "byte-identical to a build without admission "
+                    "control.")
+    MASTER_RPC_ADMISSION_RATE = _k(
+        "atpu.master.rpc.admission.rate", KeyType.FLOAT, default=200.0,
+        scope=Scope.MASTER,
+        description="Sustained master RPCs per second each principal "
+                    "may issue before shedding starts.")
+    MASTER_RPC_ADMISSION_BURST = _k(
+        "atpu.master.rpc.admission.burst", KeyType.FLOAT, default=400.0,
+        scope=Scope.MASTER,
+        description="Token-bucket depth per principal: how far a "
+                    "principal may briefly exceed the sustained rate.")
+    MASTER_RPC_ADMISSION_MAX_PRINCIPALS = _k(
+        "atpu.master.rpc.admission.max.principals", KeyType.INT,
+        default=4096, scope=Scope.MASTER,
+        description="Bound on tracked principal buckets (the key space "
+                    "is client-controlled); beyond it the least-"
+                    "recently-used bucket is evicted, so a spoofed-"
+                    "principal flood cannot grow master memory.")
+    MASTER_RPC_ADMISSION_EXEMPT = _k(
+        "atpu.master.rpc.admission.exempt", KeyType.STRING,
+        default="register,heartbeat,commit_block,get_worker_id,"
+                "metrics_heartbeat,file_system_heartbeat,"
+                "worker_heartbeat,register_worker",
+        scope=Scope.MASTER,
+        description="Comma-separated RPC method names never shed: "
+                    "worker registration/heartbeats and block commits "
+                    "are cluster-critical — shedding them would "
+                    "destabilize the cluster faster than any tenant "
+                    "flood.")
     MASTER_HA_ENABLED = _k(
         "atpu.master.ha.enabled", KeyType.BOOL, default=False,
         scope=Scope.MASTER,
@@ -605,6 +644,27 @@ class Keys:
         description="Worker threads draining the passive-cache queue "
                     "(reference: alluxio.worker.network.async.cache."
                     "manager.threads.max).")
+    WORKER_QOS_ENABLED = _k(
+        "atpu.worker.qos.enabled", KeyType.BOOL, default=False,
+        scope=Scope.WORKER,
+        description="Priority-class scheduling + per-tenant quotas on "
+                    "the worker data plane: the per-mount UFS stripe "
+                    "executors and the async cache queue drain "
+                    "ON_DEMAND > ASYNC_FILL > PREFETCH (on-demand "
+                    "reads overtake QUEUED background work; in-flight "
+                    "work is never interrupted), and per-tenant "
+                    "concurrency caps apply. Also authenticates worker "
+                    "RPCs (SIMPLE metadata identity) so requests carry "
+                    "a principal. Off: FIFO drain, no caps — "
+                    "byte-identical to a build without QoS.")
+    WORKER_UFS_FETCH_TENANT_LIMIT = _k(
+        "atpu.worker.ufs.fetch.tenant.limit", KeyType.INT, default=8,
+        scope=Scope.WORKER,
+        description="With worker QoS on: concurrent UFS stripe tasks "
+                    "one tenant (principal) may occupy per mount; "
+                    "excess work is parked until the tenant frees a "
+                    "slot, so one flooding tenant cannot monopolize "
+                    "the per-mount connection budget. 0 = unlimited.")
 
     # --- client / user ---
     USER_FILE_WRITE_TYPE_DEFAULT = _k(
@@ -672,6 +732,17 @@ class Keys:
                     "worker's rolling EWMA is re-issued to another "
                     "replica/channel; first answer wins, the loser is "
                     "cancelled. 0 disables hedging.")
+    USER_QOS_STRIPE_LIMIT = _k(
+        "atpu.user.qos.stripe.limit", KeyType.INT, default=0,
+        scope=Scope.CLIENT,
+        description="Per-tenant cap on concurrent remote-read stripe "
+                    "streams (including hedges) across every striped "
+                    "read this client process runs — keeps one "
+                    "tenant's DCN fan-out from monopolizing a shared "
+                    "client (FUSE mount, proxy). The frontier stripe "
+                    "of each read always proceeds, so the cap shapes "
+                    "readahead and hedging, never liveness. "
+                    "0 = unlimited (today's behavior).")
     USER_CLIENT_CACHE_ENABLED = _k("atpu.user.client.cache.enabled", KeyType.BOOL,
                                    default=False, scope=Scope.CLIENT)
     USER_CLIENT_CACHE_SIZE = _k("atpu.user.client.cache.size", KeyType.BYTES,
@@ -963,9 +1034,17 @@ class Keys:
                     "worker missed heartbeats (host overload) and is "
                     "re-registering; 0 fails immediately (reference: client "
                     "UnavailableException retry on write).")
-    USER_RPC_RETRY_MAX_DURATION = _k("atpu.user.rpc.retry.max.duration",
-                                     KeyType.DURATION, default="2min",
-                                     scope=Scope.CLIENT)
+    USER_RPC_RETRY_MAX_DURATION = _k(
+        "atpu.user.rpc.retry.max.duration", KeyType.DURATION,
+        default="30s", scope=Scope.CLIENT,
+        aliases=("atpu.user.rpc.retry.duration",),
+        description="Wall-clock budget a client RPC retries transient "
+                    "errors within before giving up (reference: "
+                    "alluxio.user.rpc.retry.max.duration). The 30s "
+                    "default matches the previously hard-coded client "
+                    "behavior; overload drills shorten it so flooded "
+                    "clients fail fast instead of piling 30s of "
+                    "backoff behind a shedding master.")
     USER_RPC_RETRY_BASE_SLEEP = _k("atpu.user.rpc.retry.base.sleep", KeyType.DURATION,
                                    default="50ms", scope=Scope.CLIENT)
     USER_RPC_RETRY_MAX_SLEEP = _k("atpu.user.rpc.retry.max.sleep", KeyType.DURATION,
@@ -1059,6 +1138,16 @@ class Keys:
         description="FAULT INJECTION (tests/chaos only): deterministic "
                     "fraction (0..1) of UFS stripe reads that fail with "
                     "an injected IOError.")
+    DEBUG_FAULT_RPC_REJECT_RATE = _k(
+        "atpu.debug.fault.rpc.reject.rate", KeyType.FLOAT, default=0.0,
+        scope=Scope.ALL,
+        description="FAULT INJECTION (tests/chaos only): deterministic "
+                    "fraction (0..1) of RPC dispatches shed with the "
+                    "same typed ResourceExhausted + retry-after the "
+                    "admission controller emits — drills shedding and "
+                    "client retry-after honoring without a real "
+                    "flood. The fault scope matches the RPC's "
+                    "service.method key.")
     DEBUG_FAULT_SCOPE = _k(
         "atpu.debug.fault.scope", KeyType.STRING, default="",
         scope=Scope.WORKER,

@@ -34,8 +34,8 @@ class JobMasterProcess:
                  clock=None) -> None:
         self._conf = conf
         self.job_master = JobMaster(
-            FsMasterClient(master_address),
-            BlockMasterClient(master_address),
+            FsMasterClient(master_address, conf=conf),
+            BlockMasterClient(master_address, conf=conf),
             capacity=conf.get_int(Keys.JOB_MASTER_JOB_CAPACITY),
             clock=clock,
             worker_timeout_ms=conf.get_ms(Keys.JOB_MASTER_WORKER_TIMEOUT))

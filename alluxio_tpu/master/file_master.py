@@ -1245,7 +1245,33 @@ class FileSystemMaster:
             return
         try:
             resolution = self.mount_table.resolve(uri)
-            self._ufs.get(resolution.mount_id).delete_file(temp_ufs_path)
+            ufs = self._ufs.get(resolution.mount_id)
+            ufs.delete_file(temp_ufs_path)
+            # the worker's temp write mkdirs'd the final file's parent
+            # chain in the UFS (temps live next to their final files
+            # for same-dir rename atomicity). When this commit failed
+            # because the file MOVED (rename raced the persist), those
+            # directories are namespace orphans now — metadata sync
+            # would resurrect them as ghost paths (observed: /rp back
+            # after `mv /rp /rp-moved` raced an async persist). Prune
+            # empty orphaned parents bottom-up, stopping at the first
+            # directory the namespace still knows, a non-empty one, or
+            # the mount root.
+            parent = uri.parent()
+            ufs_dir = temp_ufs_path.rsplit("/", 1)[0]
+            mount_root = resolution.mount_info.ufs_uri.rstrip("/")
+            while parent is not None and parent.path not in ("", "/") \
+                    and ufs_dir.rstrip("/") != mount_root:
+                lookup = self.inode_tree.lookup(parent)
+                if len(lookup.inodes) == \
+                        1 + len(parent.path_components()):
+                    break  # dir still exists in the namespace: owned
+                if ufs.list_status(ufs_dir):
+                    break  # not empty: someone else's contents
+                if not ufs.delete_directory(ufs_dir):
+                    break
+                parent = parent.parent()
+                ufs_dir = ufs_dir.rsplit("/", 1)[0]
         except Exception:  # noqa: BLE001 UfsCleaner sweeps later
             LOG.debug("temp persist cleanup failed for %s",
                       temp_ufs_path, exc_info=True)

@@ -106,7 +106,8 @@ class MasterProcess:
             from alluxio_tpu.rpc.job_service import JobMasterClient
 
             return JobMasterClient(
-                f"localhost:{conf.get_int(Keys.JOB_MASTER_RPC_PORT)}")
+                f"localhost:{conf.get_int(Keys.JOB_MASTER_RPC_PORT)}",
+                conf=conf)
 
         # registered with the journal BEFORE replay so catalog entries
         # from prior runs find their component
@@ -134,6 +135,7 @@ class MasterProcess:
         self.metrics_master = None
         self.health_monitor = None
         self.remediation = None
+        self.admission = None
         self._worker_lost_listener_installed = False
         self.web_server = None
         self.update_checker = None
@@ -179,18 +181,36 @@ class MasterProcess:
         self._safe_mode_until = time.monotonic() + self._conf.get_duration_s(
             Keys.MASTER_SAFEMODE_WAIT)
         metrics("Master")
-        self._init_metrics_master()
-        self._start_heartbeats()
         from alluxio_tpu.security.audit import AsyncAuditLogWriter
         from alluxio_tpu.security.authentication import Authenticator
+        from alluxio_tpu.utils import faults
 
+        # arm the conf-gated fault hooks (atpu.debug.fault.*): the
+        # rpc.reject.rate drill sheds master dispatches, so the master
+        # must read the keys too, not just workers
+        faults.injector().configure(self._conf)
         self.audit_writer = AsyncAuditLogWriter()
         self.audit_writer.start()
+        self.admission = None
+        if self._conf.get_bool(Keys.MASTER_RPC_ADMISSION_ENABLED):
+            from alluxio_tpu.qos.admission import (
+                AdmissionConf, AdmissionController,
+            )
+
+            # built BEFORE the metrics master so the tenant-overload
+            # health rule can close over it; shed RPCs are audited
+            # with allowed=False next to the permission denials
+            self.admission = AdmissionController(
+                AdmissionConf.from_conf(self._conf),
+                audit_writer=self.audit_writer)
+        self._init_metrics_master()
+        self._start_heartbeats()
         authenticator = Authenticator(self._conf)
         self.rpc_server = RpcServer(
             bind_host="0.0.0.0",
             port=self._conf.get_int(Keys.MASTER_RPC_PORT),
-            authenticator=authenticator)
+            authenticator=authenticator,
+            admission=self.admission)
         self.rpc_server.add_service(fs_master_service(
             self.fs_master, active_sync=self.active_sync,
             audit_writer=self.audit_writer))
@@ -209,7 +229,8 @@ class MasterProcess:
             permission_checker=self.permission_checker,
             metrics_master=self.metrics_master,
             health_monitor=self.health_monitor,
-            remediation_engine=self.remediation))
+            remediation_engine=self.remediation,
+            admission=self.admission))
         self.rpc_port = self.rpc_server.start()
         if self._conf.get_bool(Keys.MASTER_FASTPATH_ENABLED):
             from alluxio_tpu.rpc.fastpath import (
@@ -220,7 +241,8 @@ class MasterProcess:
                 socket_path_for(
                     f"localhost:{self.rpc_port}",
                     self._conf.get(Keys.MASTER_FASTPATH_DIR)),
-                authenticator=authenticator)
+                authenticator=authenticator,
+                admission=self.admission)
             for svc in self.rpc_server._services.values():
                 self.fastpath_server.add_service(svc)
             self.fastpath_server.start()
@@ -294,6 +316,17 @@ class MasterProcess:
                     Keys.MASTER_HEALTH_STALL_THRESHOLD),
                 stall_window_s=conf.get_duration_s(
                     Keys.MASTER_HEALTH_STALL_WINDOW))
+            if self.admission is not None:
+                from alluxio_tpu.master.health import (
+                    tenant_overload_rule,
+                )
+
+                # flags a principal whose master RPCs are being shed
+                # at a sustained rate — the doctor names the tenant
+                # exceeding its share instead of operators diffing
+                # audit logs
+                rules.append(tenant_overload_rule(
+                    self.admission.shed_counts))
             if history is None:
                 # don't advertise rules that silently no-op without
                 # the history store: the report must only list rules
@@ -442,18 +475,24 @@ class MasterProcess:
                 _Exec(self.ufs_cleaner.heartbeat),
                 conf.get_duration_s(Keys.MASTER_UFS_CLEANUP_INTERVAL)),
         ]
-        if self.health_monitor is not None:
+        def _health_tick() -> None:
+            if self.health_monitor is not None:
+                self.health_monitor.evaluate()
+            elif self.metrics_master.history is not None:
+                # health disabled but history on: evaluate() normally
+                # drains the pending offers, so tick the drain directly
+                # or the bounded pending queue overflows between queries
+                self.metrics_master.drain_history()
+            if self.admission is not None:
+                # Master.RpcAdmission* series ride the same tick the
+                # remediation samples do: flood shapes stay visible in
+                # `fsadmin report history` after the flood is gone
+                self.admission.sample_history(self.metrics_master.history)
+
+        if self.health_monitor is not None or \
+                self.metrics_master.history is not None:
             self._threads.append(HeartbeatThread(
-                HeartbeatContext.MASTER_HEALTH_CHECK,
-                _Exec(self.health_monitor.evaluate),
-                conf.get_duration_s(Keys.MASTER_HEALTH_EVAL_INTERVAL)))
-        elif self.metrics_master.history is not None:
-            # health disabled but history on: its evaluate() normally
-            # drains the pending offers, so tick the drain directly or
-            # the bounded pending queue overflows between queries
-            self._threads.append(HeartbeatThread(
-                HeartbeatContext.MASTER_HEALTH_CHECK,
-                _Exec(self.metrics_master.drain_history),
+                HeartbeatContext.MASTER_HEALTH_CHECK, _Exec(_health_tick),
                 conf.get_duration_s(Keys.MASTER_HEALTH_EVAL_INTERVAL)))
         if conf.get_bool(Keys.MASTER_UPDATE_CHECK_ENABLED):
             url = conf.get(Keys.MASTER_UPDATE_CHECK_URL) or ""

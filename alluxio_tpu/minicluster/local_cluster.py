@@ -109,7 +109,10 @@ class LocalCluster:
         # UFS resolution must be in place before the RPC server serves a
         # single read (a UFS-descriptor read in the gap would crash on None)
         worker.ufs_manager = WorkerUfsManager(fs_client)
-        server = RpcServer(bind_host="127.0.0.1", port=0)
+        from alluxio_tpu.security.authentication import worker_authenticator
+
+        server = RpcServer(bind_host="127.0.0.1", port=0,
+                           authenticator=worker_authenticator(wconf))
         server.add_service(worker_service(worker))
         port = server.start()
         worker.address.rpc_port = port

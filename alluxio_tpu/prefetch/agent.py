@@ -95,9 +95,12 @@ class WorkerTierExecutor:
         if addr is None:
             return False
         try:
+            # tagged PREFETCH: with worker QoS on, speculative loads
+            # drain after on-demand reads and client-issued fills,
+            # and an on-demand reader arriving first promotes them
             self._client_fn(addr).async_cache(
                 ref.block_id, ref.ufs_path, ref.offset, ref.length,
-                ref.mount_id)
+                ref.mount_id, qos_class="PREFETCH")
         except Exception:  # noqa: BLE001 worker transition: report failed
             LOG.debug("async_cache submit failed for block %d",
                       ref.block_id, exc_info=True)

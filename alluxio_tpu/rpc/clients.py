@@ -24,6 +24,22 @@ from alluxio_tpu.utils.wire import (
 )
 
 
+def resolve_retry_duration_s(value: Optional[float] = None,
+                             conf=None) -> float:
+    """The client RPC retry budget: an explicit value wins, else the
+    ``atpu.user.rpc.retry.duration`` conf key, else the historical
+    30s constant.  One resolver for every typed client (fs/block/meta,
+    job, table) so overload drills shorten give-up time everywhere by
+    setting one key."""
+    if value is not None:
+        return float(value)
+    if conf is not None:
+        from alluxio_tpu.conf import Keys
+
+        return float(conf.get_duration_s(Keys.USER_RPC_RETRY_MAX_DURATION))
+    return 30.0
+
+
 class _BaseClient:
     """``address`` may be a comma-separated list for HA deployments: on an
     UNAVAILABLE failure the client rotates to the next master and the retry
@@ -32,13 +48,16 @@ class _BaseClient:
 
     service = ""
 
-    def __init__(self, address: str, *, retry_duration_s: float = 30.0,
+    def __init__(self, address: str, *,
+                 retry_duration_s: Optional[float] = None,
                  base_sleep_s: float = 0.05, max_sleep_s: float = 3.0,
                  metadata=None, fastpath: bool = True,
-                 fastpath_dir: Optional[str] = None) -> None:
+                 fastpath_dir: Optional[str] = None, conf=None) -> None:
         """``fastpath_dir``: where master fastpath sockets live; pass the
         ``atpu.master.fastpath.dir`` property when a Configuration is at
-        hand (FileSystem does) — otherwise the env override or /tmp."""
+        hand (FileSystem does) — otherwise the env override or /tmp.
+        ``retry_duration_s`` defaults from ``conf``'s
+        ``atpu.user.rpc.retry.duration`` (30s)."""
         import os as _os
 
         from alluxio_tpu.rpc.fastpath import HybridChannel
@@ -58,7 +77,8 @@ class _BaseClient:
             self._channels.append(ch)
         self._active = 0
         self._metadata = metadata
-        self._retry_duration_s = retry_duration_s
+        self._retry_duration_s = resolve_retry_duration_s(
+            retry_duration_s, conf)
         self._base_sleep_s = base_sleep_s
         self._max_sleep_s = max_sleep_s
 
@@ -414,6 +434,11 @@ class MetaMasterClient(_BaseClient):
         (cluster doctor)."""
         return self._call("get_health", {"evaluate": evaluate})
 
+    def get_qos(self) -> dict:
+        """Admission-control state + per-principal shed/admit rows +
+        cluster Qos metrics (`fsadmin report qos`)."""
+        return self._call("get_qos", {})
+
     def get_config_report(self) -> dict:
         return self._call("get_config_report", {})
 
@@ -519,10 +544,15 @@ class WorkerClient(_BaseClient):
             "cancel": cancel, "pinned": pinned})
 
     def async_cache(self, block_id: int, ufs_path: str, offset: int,
-                    length: int, mount_id: int = 0) -> bool:
+                    length: int, mount_id: int = 0,
+                    qos_class: str = "") -> bool:
+        """``qos_class``: "ASYNC_FILL" (default) or "PREFETCH" — with
+        worker QoS on, speculative loads drain after client-issued
+        fills and on-demand reads."""
         return self._call("async_cache", {
             "block_id": block_id, "ufs_path": ufs_path, "offset": offset,
-            "length": length, "mount_id": mount_id})["accepted"]
+            "length": length, "mount_id": mount_id,
+            "qos_class": qos_class})["accepted"]
 
     def prefetch_pin(self, block_id: int, ttl_s: float = 600.0) -> bool:
         """Eviction shield for a clairvoyantly-placed block (held until

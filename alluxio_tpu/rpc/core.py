@@ -26,7 +26,9 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 import grpc
 import msgpack
 
-from alluxio_tpu.utils.exceptions import AlluxioTpuError, UnavailableError
+from alluxio_tpu.utils.exceptions import (
+    AlluxioTpuError, ResourceExhaustedError, UnavailableError,
+)
 from alluxio_tpu.utils.tracing import (
     TRACEPARENT_KEY, bind_remote_parent, current_traceparent,
     reset_remote_parent, tracer,
@@ -92,14 +94,61 @@ def _unbind_user(token) -> None:
         reset_authenticated_user(token)
 
 
+def check_admission(admission, context, method_key: str,
+                    principal_hint: Optional[str] = None) -> None:
+    """Per-dispatch QoS gate, shared by the gRPC wrappers and the
+    fastpath server: the conf-gated fault hook first (so shedding can
+    be chaos-drilled with no admission controller and no flood), then
+    the per-principal token bucket.  Raises a typed
+    ``ResourceExhaustedError`` carrying ``retry_after_s`` — the RPC is
+    SHED, never queued (see qos/admission.py).  ``principal_hint``:
+    transport-specific identity fallback for servers without a gRPC
+    context (the fastpath passes its hello-frame ``atpu-user``)."""
+    from alluxio_tpu.utils import faults
+
+    if faults.armed():
+        # the chaos drill honors the same exemptions real admission
+        # does — shedding registration/heartbeats would destabilize
+        # the cluster the drill is observing
+        from alluxio_tpu.qos.admission import DEFAULT_EXEMPT
+
+        exempt = admission.conf.exempt if admission is not None \
+            else DEFAULT_EXEMPT
+        if method_key.rsplit(".", 1)[-1] not in exempt:
+            ra = faults.injector().take_rpc_reject(method_key)
+            if ra:
+                err = ResourceExhaustedError(
+                    f"injected rpc reject for {method_key}; retry "
+                    f"after {ra:.3f}s")
+                err.retry_after_s = ra
+                raise err
+    if admission is None:
+        return
+    principal = principal_hint
+    from alluxio_tpu.security.user import authenticated_user
+
+    user = authenticated_user()
+    if user is not None:
+        principal = user.name
+    elif principal is None and context is not None:
+        # NOSASL server: fall back to the identity metadata clients
+        # attach anyway, so admission can still separate principals
+        for k, v in (context.invocation_metadata() or ()):
+            if k == "atpu-user":
+                principal = v
+                break
+    admission.check(principal, method_key.rsplit(".", 1)[-1])
+
+
 def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
-                span_name: str = "") -> Callable:
+                span_name: str = "", admission=None) -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
         token = None
         trace_token = _bind_trace(context)
         try:
             with tracer().span(span_name or "rpc.unary"):
                 token = _bind_user(context, authenticator)
+                check_admission(admission, context, span_name)
                 return fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -116,13 +165,15 @@ def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
 
 
 def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
-                     authenticator=None, span_name: str = "") -> Callable:
+                     authenticator=None, span_name: str = "",
+                     admission=None) -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
         token = None
         trace_token = _bind_trace(context)
         try:
             with tracer().span(span_name or "rpc.stream_out"):
                 token = _bind_user(context, authenticator)
+                check_admission(admission, context, span_name)
                 yield from fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -139,13 +190,15 @@ def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
 
 
 def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any],
-                    authenticator=None, span_name: str = "") -> Callable:
+                    authenticator=None, span_name: str = "",
+                    admission=None) -> Callable:
     def handler(request_iterator, context: grpc.ServicerContext):
         token = None
         trace_token = _bind_trace(context)
         try:
             with tracer().span(span_name or "rpc.stream_in"):
                 token = _bind_user(context, authenticator)
+                check_admission(admission, context, span_name)
                 return fn(request_iterator)
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -180,9 +233,10 @@ class ServiceDefinition:
 
 class _GenericHandler(grpc.GenericRpcHandler):
     def __init__(self, services: Dict[str, ServiceDefinition],
-                 authenticator=None) -> None:
+                 authenticator=None, admission=None) -> None:
         self._services = services
         self._auth = authenticator
+        self._admission = admission
 
     def service(self, handler_call_details):
         # method path: /<service>/<method>
@@ -198,16 +252,16 @@ class _GenericHandler(grpc.GenericRpcHandler):
         span = f"{service_name}.{method}"
         if kind == "unary":
             return grpc.unary_unary_rpc_method_handler(
-                _wrap_unary(fn, self._auth, span),
+                _wrap_unary(fn, self._auth, span, self._admission),
                 request_deserializer=unpack,
                 response_serializer=pack)
         if kind == "stream_out":
             return grpc.unary_stream_rpc_method_handler(
-                _wrap_stream_out(fn, self._auth, span),
+                _wrap_stream_out(fn, self._auth, span, self._admission),
                 request_deserializer=unpack, response_serializer=pack)
         if kind == "stream_in":
             return grpc.stream_unary_rpc_method_handler(
-                _wrap_stream_in(fn, self._auth, span),
+                _wrap_stream_in(fn, self._auth, span, self._admission),
                 request_deserializer=unpack, response_serializer=pack)
         return None
 
@@ -219,12 +273,16 @@ class RpcServer:
     def __init__(self, bind_host: str = "0.0.0.0", port: int = 0,
                  max_workers: int = 16,
                  domain_socket_path: Optional[str] = None,
-                 authenticator=None) -> None:
+                 authenticator=None, admission=None) -> None:
         """``authenticator``: a ``security.authentication.Authenticator``;
         when set, every RPC is authenticated and the resolved user is bound
-        for handlers to read via ``security.authenticated_user()``."""
+        for handlers to read via ``security.authenticated_user()``.
+        ``admission``: a ``qos.admission.AdmissionController``; when set,
+        every dispatch passes its per-principal token bucket and
+        over-limit calls are shed with a typed retry-after."""
         self._services: Dict[str, ServiceDefinition] = {}
         self._authenticator = authenticator
+        self._admission = admission
         options = [
             ("grpc.max_send_message_length", 64 << 20),
             ("grpc.max_receive_message_length", 64 << 20),
@@ -243,7 +301,8 @@ class RpcServer:
 
     def start(self) -> int:
         self._server.add_generic_rpc_handlers(
-            (_GenericHandler(self._services, self._authenticator),))
+            (_GenericHandler(self._services, self._authenticator,
+                             self._admission),))
         self.port = self._server.add_insecure_port(self._bind)
         if self._domain_socket_path:
             # UDS endpoint for same-host traffic without TCP

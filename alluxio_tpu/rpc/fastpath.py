@@ -84,9 +84,11 @@ class FastPathServer:
     Unix socket. Unary methods only — streaming methods are simply not
     registered here, so clients keep using gRPC for them."""
 
-    def __init__(self, uds_path: str, authenticator=None) -> None:
+    def __init__(self, uds_path: str, authenticator=None,
+                 admission=None) -> None:
         self._uds_path = uds_path
         self._auth = authenticator
+        self._admission = admission
         #: (service, method) -> fn, resolved once at registration
         self._methods: Dict[Tuple[str, str], Any] = {}
         self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
@@ -102,8 +104,11 @@ class FastPathServer:
                 self._methods[(svc.name, method)] = fn
 
     def start(self) -> str:
+        from alluxio_tpu.rpc.core import check_admission
+
         methods = self._methods
         authenticator = self._auth
+        admission = self._admission
         conns, conns_lock = self._conns, self._conns_lock
 
         class Handler(socketserver.StreamRequestHandler):
@@ -129,6 +134,11 @@ class FastPathServer:
                         return
                     md = msgpack.unpackb(hello, raw=False).get(
                         "metadata") or {}
+                    # NOSASL identity fallback for admission: without
+                    # it every UDS principal would collapse into one
+                    # anonymous bucket and a flooding tenant would
+                    # shed its victims too
+                    principal_hint = md.get("atpu-user")
                     if authenticator is not None:
                         try:
                             user = authenticator.authenticate(md)
@@ -166,6 +176,13 @@ class FastPathServer:
                             trace_token = bind_remote_parent(traceparent)
                             try:
                                 with tracer().span(f"{service}.{method}"):
+                                    # admission parity too: a local
+                                    # flood must not bypass the gate
+                                    # by riding the Unix socket
+                                    check_admission(
+                                        admission, None,
+                                        f"{service}.{method}",
+                                        principal_hint=principal_hint)
                                     result = fn(request or {})
                             finally:
                                 reset_remote_parent(trace_token)

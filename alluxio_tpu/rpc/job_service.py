@@ -8,9 +8,10 @@ commands ``:225-230``) on the shared msgpack-gRPC core.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from alluxio_tpu.job.wire import JobInfo
+from alluxio_tpu.rpc.clients import resolve_retry_duration_s
 from alluxio_tpu.rpc.core import RpcChannel, ServiceDefinition
 from alluxio_tpu.utils.retry import ExponentialTimeBoundedRetry, retry
 
@@ -47,10 +48,15 @@ class JobMasterClient:
 
     service = JOB_SERVICE
 
-    def __init__(self, address: str, *, retry_duration_s: float = 30.0,
-                 metadata=None):
+    def __init__(self, address: str, *,
+                 retry_duration_s: Optional[float] = None,
+                 metadata=None, conf=None):
+        """``retry_duration_s`` falls back to ``conf``'s
+        ``atpu.user.rpc.retry.duration`` (30s default) — the previously
+        hard-coded constant, now tunable for overload drills."""
         self._channel = RpcChannel(address, metadata=metadata)
-        self._retry_duration_s = retry_duration_s
+        self._retry_duration_s = resolve_retry_duration_s(
+            retry_duration_s, conf)
 
     def _call(self, method: str, request: dict, timeout: float = 30.0):
         return retry(

@@ -259,7 +259,8 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         permission_checker=None,
                         metrics_master=None,
                         health_monitor=None,
-                        remediation_engine=None) -> ServiceDefinition:
+                        remediation_engine=None,
+                        admission=None) -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
     config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
@@ -399,10 +400,24 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
             resp["remediation"] = remediation_engine.report()
         return resp
 
+    def _get_qos(r):
+        """QoS posture in one response: admission-control state +
+        per-principal rows, plus every Qos/RpcAdmission metric across
+        the cluster aggregates (`fsadmin report qos`)."""
+        resp = {"admission": admission.report() if admission is not None
+                else {"enabled": False}}
+        snap = metrics().snapshot()
+        if metrics_master is not None:
+            snap = metrics_master.merged_snapshot(snap)
+        resp["metrics"] = {k: v for k, v in snap.items()
+                           if "Qos" in k or "RpcAdmission" in k}
+        return resp
+
     svc.unary("get_metrics", _get_metrics)
     svc.unary("metrics_heartbeat", _metrics_heartbeat)
     svc.unary("get_metrics_history", _get_metrics_history)
     svc.unary("get_health", _get_health)
+    svc.unary("get_qos", _get_qos)
 
     def _checkpoint(r):
         _require_admin()

@@ -73,10 +73,17 @@ class TableMasterClient:
 
     service = TABLE_SERVICE
 
-    def __init__(self, address: str, *, retry_duration_s: float = 30.0,
-                 metadata=None) -> None:
+    def __init__(self, address: str, *,
+                 retry_duration_s: "Optional[float]" = None,
+                 metadata=None, conf=None) -> None:
+        """``retry_duration_s`` falls back to ``conf``'s
+        ``atpu.user.rpc.retry.duration`` (30s default) — the previously
+        hard-coded constant, now tunable for overload drills."""
+        from alluxio_tpu.rpc.clients import resolve_retry_duration_s
+
         self._channel = RpcChannel(address, metadata=metadata)
-        self._retry_duration_s = retry_duration_s
+        self._retry_duration_s = resolve_retry_duration_s(
+            retry_duration_s, conf)
 
     def _call(self, method: str, request: dict, timeout: float = 60.0):
         return retry(

@@ -67,6 +67,16 @@ class _LeaseRegistry:
             lease.close()
 
 
+def _principal() -> str:
+    """The authenticated caller's name, for per-tenant QoS accounting;
+    empty (one anonymous tenant) when the worker runs no authenticator
+    (QoS disabled) or the call is in-process."""
+    from alluxio_tpu.security.user import authenticated_user
+
+    user = authenticated_user()
+    return user.name if user is not None else ""
+
+
 def worker_service(worker: BlockWorker) -> ServiceDefinition:
     svc = ServiceDefinition(WORKER_SERVICE)
     leases = _LeaseRegistry()
@@ -158,8 +168,12 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
             mount_id=ufs.get("mount_id", 0))
         # streaming read-through: chunks go out as stripes land, so the
         # client's first byte costs one stripe, not the whole block; the
-        # tiered-store fill proceeds in parallel inside the fetch
-        fetch = worker.open_ufs_fetch(desc, cache=req.get("cache", True))
+        # tiered-store fill proceeds in parallel inside the fetch.
+        # A blocked reader is ON_DEMAND — it overtakes (and, when
+        # coalescing, promotes) queued background fills — and carries
+        # the caller's principal for the per-tenant stripe caps
+        fetch = worker.open_ufs_fetch(desc, cache=req.get("cache", True),
+                                      tenant=_principal())
         m.counter("Worker.BlocksServed.UFS").inc()
         served = m.counter("Worker.BytesServed.UFS")
         end = desc.length if length < 0 else min(desc.length,
@@ -243,10 +257,21 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
     svc.unary("complete_local_block", complete_local_block)
 
     # -------------------------------------------------------------- control
-    svc.unary("async_cache", lambda r: {"accepted": worker.async_cache.submit(
-        UfsBlockDescriptor(block_id=r["block_id"], ufs_path=r["ufs_path"],
-                           offset=r["offset"], length=r["length"],
-                           mount_id=r.get("mount_id", 0)))})
+    def async_cache(r: dict) -> dict:
+        """``qos_class`` (optional wire string, default ASYNC_FILL)
+        lets the prefetch agent tag its speculative loads PREFETCH so
+        they drain after client-issued fills and on-demand reads."""
+        from alluxio_tpu.qos import priority_from_name
+
+        return {"accepted": worker.async_cache.submit(
+            UfsBlockDescriptor(
+                block_id=r["block_id"], ufs_path=r["ufs_path"],
+                offset=r["offset"], length=r["length"],
+                mount_id=r.get("mount_id", 0)),
+            priority=priority_from_name(r.get("qos_class", "")),
+            tenant=_principal())}
+
+    svc.unary("async_cache", async_cache)
     svc.unary("prefetch_pin", lambda r: {
         "pinned": worker.store.pin_prefetch(r["block_id"],
                                             r.get("ttl_s", 600.0))})

@@ -49,6 +49,19 @@ def client_metadata(conf: Optional[Configuration] = None
     return md
 
 
+def worker_authenticator(conf: Configuration):
+    """The worker data plane's authenticator — installed only when
+    worker QoS is on (per-tenant quotas need a principal on every RPC);
+    None otherwise, keeping the QoS-off server byte-identical to a
+    build without it.  One helper so every worker boot path
+    (standalone launch, minicluster) stays in lockstep."""
+    from alluxio_tpu.conf import Keys
+
+    if not conf.get_bool(Keys.WORKER_QOS_ENABLED):
+        return None
+    return Authenticator(conf)
+
+
 class Authenticator:
     """Server-side per-RPC authentication + impersonation resolution."""
 

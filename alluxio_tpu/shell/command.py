@@ -80,35 +80,40 @@ class ShellContext:
         if self._fs_client is None:
             from alluxio_tpu.rpc.clients import FsMasterClient
 
-            self._fs_client = FsMasterClient(self.master_address)
+            self._fs_client = FsMasterClient(self.master_address,
+                                             conf=self.conf)
         return self._fs_client
 
     def block_client(self):
         if self._block_client is None:
             from alluxio_tpu.rpc.clients import BlockMasterClient
 
-            self._block_client = BlockMasterClient(self.master_address)
+            self._block_client = BlockMasterClient(self.master_address,
+                                                   conf=self.conf)
         return self._block_client
 
     def meta_client(self):
         if self._meta_client is None:
             from alluxio_tpu.rpc.clients import MetaMasterClient
 
-            self._meta_client = MetaMasterClient(self.master_address)
+            self._meta_client = MetaMasterClient(self.master_address,
+                                                 conf=self.conf)
         return self._meta_client
 
     def job_client(self):
         if self._job_client is None:
             from alluxio_tpu.rpc.job_service import JobMasterClient
 
-            self._job_client = JobMasterClient(self.job_master_address)
+            self._job_client = JobMasterClient(self.job_master_address,
+                                               conf=self.conf)
         return self._job_client
 
     def table_client(self):
         if self._table_client is None:
             from alluxio_tpu.rpc.table_service import TableMasterClient
 
-            self._table_client = TableMasterClient(self.master_address)
+            self._table_client = TableMasterClient(self.master_address,
+                                                   conf=self.conf)
         return self._table_client
 
     def close(self) -> None:

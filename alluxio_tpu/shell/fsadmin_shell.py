@@ -22,13 +22,13 @@ ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 class ReportCommand(Command):
     name = "report"
     description = ("Report cluster summary|capacity|ufs|metrics|"
-                   "jobservice|stall|history|health.")
+                   "jobservice|stall|history|health|qos.")
 
     def configure(self, p):
         p.add_argument("category", nargs="?", default="summary",
                        choices=["summary", "capacity", "ufs", "metrics",
                                 "jobservice", "stall", "history",
-                                "health"])
+                                "health", "qos"])
         p.add_argument("metric", nargs="?", default="",
                        help="history: metric name (omit to list "
                             "recorded names)")
@@ -159,6 +159,59 @@ class ReportCommand(Command):
                       f"{int(snap.get('Master.ReplicationJobsInflight', 0))}"
                       f" in flight) — expected during mass recovery, "
                       f"raise the cap if it never drains")
+        shed = snap.get("Master.RpcAdmissionShed", 0)
+        if shed:
+            # next to the other drop counters on purpose: shed RPCs
+            # are load shedding working as designed, but the operator
+            # reading drop counts must see them in the same place
+            ctx.print(f"WARN: {int(shed)} RPCs shed by admission "
+                      f"control (a principal exceeded "
+                      f"atpu.master.rpc.admission.rate) — run "
+                      f"`fsadmin report qos` for the per-principal "
+                      f"breakdown; shed calls are also audit-logged "
+                      f"with allowed=false")
+        return 0
+
+    def _qos(self, ctx):
+        """Multi-tenant QoS posture: admission-control state with the
+        per-principal admitted/shed table, plus every Worker.Qos* /
+        Client.Qos* metric the cluster aggregates."""
+        resp = ctx.meta_client().get_qos()
+        adm = resp.get("admission", {})
+        if not adm.get("enabled"):
+            ctx.print("RPC admission control: DISABLED "
+                      "(atpu.master.rpc.admission.enabled)")
+        else:
+            ctx.print(f"RPC admission control: rate "
+                      f"{adm['rate_per_s']:g}/s per principal, burst "
+                      f"{adm['burst']:g}, "
+                      f"{int(adm.get('admitted_total', 0))} admitted / "
+                      f"{int(adm.get('shed_total', 0))} shed")
+            ctx.print(f"    exempt methods: "
+                      f"{', '.join(adm.get('exempt', [])) or '(none)'}")
+            rows = adm.get("principals", [])
+            if rows:
+                ctx.print(f"    {'principal':<24s} {'admitted':>10s} "
+                          f"{'shed':>10s}")
+                for r in rows:
+                    ctx.print(f"    {r['principal']:<24s} "
+                              f"{r['admitted']:>10d} {r['shed']:>10d}"
+                              + ("   << throttled" if r["shed"] else ""))
+            if adm.get("bucket_evictions"):
+                ctx.print(f"    WARN: {adm['bucket_evictions']} "
+                          f"principal buckets evicted by the "
+                          f"max.principals cap — a principal flood is "
+                          f"churning the limiter")
+        qos_metrics = resp.get("metrics", {})
+        if qos_metrics:
+            ctx.print("QoS metrics (cluster-wide):")
+            for k in sorted(qos_metrics):
+                ctx.print(f"    {k}  {qos_metrics[k]}")
+        else:
+            ctx.print("No Worker.Qos*/Client.Qos* metrics reported — "
+                      "enable atpu.worker.qos.enabled / "
+                      "atpu.user.qos.stripe.limit to activate "
+                      "data-plane QoS")
         return 0
 
     def _history(self, ctx, args):

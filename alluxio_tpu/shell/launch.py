@@ -73,8 +73,11 @@ def launch_worker(conf: Configuration) -> int:
     worker = BlockWorker(conf, BlockMasterClient(master_addr), fs_client,
                          meta_master_client=MetaMasterClient(master_addr))
     worker.ufs_manager = WorkerUfsManager(fs_client)
+    from alluxio_tpu.security.authentication import worker_authenticator
+
     server = RpcServer(bind_host="0.0.0.0",
-                       port=conf.get_int(Keys.WORKER_RPC_PORT))
+                       port=conf.get_int(Keys.WORKER_RPC_PORT),
+                       authenticator=worker_authenticator(conf))
     server.add_service(worker_service(worker))
     port = server.start()
     worker.address.rpc_port = port

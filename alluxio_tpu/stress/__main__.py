@@ -165,6 +165,28 @@ def build_parser() -> argparse.ArgumentParser:
     rr.add_argument("--min-speedup", type=float, default=1.5,
                     help="fail below this striped/single throughput ratio")
 
+    qo = sub.add_parser("qos",
+                        help="two-tenant QoS: victim read p99 under an "
+                             "abusive tenant's flood with/without QoS, "
+                             "plus admission-limiter bounded-memory "
+                             "shedding (modeled UFS, fake-clock "
+                             "limiter)")
+    qo.add_argument("--rtt-ms", type=float, default=40.0,
+                    help="modeled per-read UFS round trip; must dwarf "
+                         "the host's thread-wake jitter")
+    qo.add_argument("--block-kb", type=int, default=64)
+    qo.add_argument("--victim-reads", type=int, default=12)
+    qo.add_argument("--flood-blocks", type=int, default=48,
+                    help="abusive-tenant backlog per wave (two waves)")
+    qo.add_argument("--per-mount-limit", type=int, default=4)
+    qo.add_argument("--tenant-limit", type=int, default=2)
+    qo.add_argument("--max-degradation", type=float, default=2.0,
+                    help="fail when the victim's flooded p99 exceeds "
+                         "this multiple of its solo p99 with QoS on")
+    qo.add_argument("--admission-checks", type=int, default=200_000)
+    qo.add_argument("--admission-principals", type=int, default=20_000)
+    qo.add_argument("--admission-max-principals", type=int, default=512)
+
     sub.add_parser("suite", help="run the whole BASELINE config family")
     rp = sub.add_parser("report",
                         help="render suite JSON to a single-file HTML "
@@ -209,6 +231,7 @@ SUITE = (
     ("selfheal-remediation", ["selfheal"]),
     ("ufs-cold-read", ["ufscold"]),
     ("remote-warm-read", ["remoteread"]),
+    ("qos-two-tenant", ["qos"]),
 )
 
 
@@ -403,6 +426,18 @@ def main(argv=None) -> int:
                 conn_mbps=args.conn_mbps, blocks=args.blocks,
                 hedge_quantile=args.hedge_quantile,
                 stall_ms=args.stall_ms, min_speedup=args.min_speedup)
+    elif args.bench == "qos":
+        from alluxio_tpu.stress.qos_bench import run
+
+        r = run(rtt_ms=args.rtt_ms, block_kb=args.block_kb,
+                victim_reads=args.victim_reads,
+                flood_blocks=args.flood_blocks,
+                per_mount_limit=args.per_mount_limit,
+                tenant_limit=args.tenant_limit,
+                max_degradation=args.max_degradation,
+                admission_checks=args.admission_checks,
+                admission_principals=args.admission_principals,
+                admission_max_principals=args.admission_max_principals)
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1

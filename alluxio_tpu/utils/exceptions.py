@@ -8,22 +8,36 @@ boundaries can round-trip typed errors.
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class AlluxioTpuError(Exception):
-    """Base class; ``code`` is the wire-stable status name."""
+    """Base class; ``code`` is the wire-stable status name.
+
+    ``retry_after_s`` (optional, set by admission control when it sheds
+    an RPC) survives the wire round trip so the client-side retry
+    policy can honor the server's backoff hint instead of hammering."""
 
     code = "INTERNAL"
+    retry_after_s: Optional[float] = None
 
     def to_wire(self) -> dict:
-        return {"code": self.code, "message": str(self),
-                "type": type(self).__name__}
+        d = {"code": self.code, "message": str(self),
+             "type": type(self).__name__}
+        if self.retry_after_s is not None:
+            d["retry_after_s"] = float(self.retry_after_s)
+        return d
 
     @staticmethod
     def from_wire(d: dict) -> "AlluxioTpuError":
         cls = _BY_NAME.get(d.get("type"), None)
         if cls is None:
             cls = _BY_CODE.get(d.get("code"), AlluxioTpuError)
-        return cls(d.get("message", ""))
+        e = cls(d.get("message", ""))
+        ra = d.get("retry_after_s")
+        if ra is not None:
+            e.retry_after_s = float(ra)
+        return e
 
 
 class FileDoesNotExistError(AlluxioTpuError):

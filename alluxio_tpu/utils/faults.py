@@ -13,7 +13,14 @@ substring so a multi-worker cluster can break exactly one node:
   process;
 - **UFS error rate** — a deterministic fraction of UFS stripe reads
   fail with an injected ``IOError`` (counter-based, not random: the
-  Nth failure lands at the same read in every run).
+  Nth failure lands at the same read in every run);
+- **RPC reject rate** — a deterministic fraction of master RPC
+  dispatches is shed with the same typed ``ResourceExhausted`` +
+  retry-after the admission controller emits, so admission shedding
+  and client-side retry-after honoring can be chaos-tested end to end
+  without a real flood.  The scope substring matches the RPC's
+  ``service.method`` key (e.g. scope ``create_file`` rejects only
+  CreateFile).
 
 The hooks are gated on a single module flag, so a production cluster
 that never sets ``atpu.debug.fault.*`` pays one attribute read per
@@ -37,12 +44,16 @@ class FaultInjector:
         self.read_latency_s: float = 0.0
         self.heartbeat_freeze: bool = False
         self.ufs_error_rate: float = 0.0
+        self.rpc_reject_rate: float = 0.0
+        self.rpc_reject_retry_after_s: float = 0.05
         self.scope: str = ""
         #: injected-fault tallies, for tests and fsadmin spelunking
         self.injected = {"read_latency": 0, "heartbeat_freeze": 0,
-                         "ufs_error": 0}
+                         "ufs_error": 0, "rpc_reject": 0}
         self._ufs_reads = 0
         self._ufs_failed = 0
+        self._rpc_calls = 0
+        self._rpc_rejected = 0
 
     # ----------------------------------------------------------- config
     def configure(self, conf) -> None:
@@ -55,11 +66,14 @@ class FaultInjector:
             heartbeat_freeze=conf.get_bool(
                 Keys.DEBUG_FAULT_HEARTBEAT_FREEZE),
             ufs_error_rate=conf.get_float(Keys.DEBUG_FAULT_UFS_ERROR_RATE),
+            rpc_reject_rate=conf.get_float(
+                Keys.DEBUG_FAULT_RPC_REJECT_RATE),
             scope=str(conf.get(Keys.DEBUG_FAULT_SCOPE) or ""))
 
     def set(self, *, read_latency_s: Optional[float] = None,
             heartbeat_freeze: Optional[bool] = None,
             ufs_error_rate: Optional[float] = None,
+            rpc_reject_rate: Optional[float] = None,
             scope: Optional[str] = None) -> None:
         global _armed
         with self._lock:
@@ -70,10 +84,13 @@ class FaultInjector:
             if ufs_error_rate is not None:
                 self.ufs_error_rate = min(1.0, max(
                     0.0, float(ufs_error_rate)))
+            if rpc_reject_rate is not None:
+                self.rpc_reject_rate = min(1.0, max(
+                    0.0, float(rpc_reject_rate)))
             if scope is not None:
                 self.scope = str(scope)
             _armed = bool(self.read_latency_s or self.heartbeat_freeze
-                          or self.ufs_error_rate)
+                          or self.ufs_error_rate or self.rpc_reject_rate)
 
     def reset(self) -> None:
         global _armed
@@ -81,9 +98,12 @@ class FaultInjector:
             self.read_latency_s = 0.0
             self.heartbeat_freeze = False
             self.ufs_error_rate = 0.0
+            self.rpc_reject_rate = 0.0
             self.scope = ""
             self._ufs_reads = 0
             self._ufs_failed = 0
+            self._rpc_calls = 0
+            self._rpc_rejected = 0
             for k in self.injected:
                 self.injected[k] = 0
             _armed = False
@@ -117,6 +137,22 @@ class FaultInjector:
                 self.injected["ufs_error"] += 1
                 return True
         return False
+
+    def take_rpc_reject(self, method_key: str) -> float:
+        """Retry-after seconds when this RPC dispatch should be shed
+        with an injected ``ResourceExhausted``; 0.0 = admit.  Same
+        deterministic failed/total pacing as the UFS hook.  The scope
+        substring matches ``method_key`` (``service.method``)."""
+        rate = self.rpc_reject_rate
+        if rate <= 0 or not self._in_scope(method_key):
+            return 0.0
+        with self._lock:
+            self._rpc_calls += 1
+            if self._rpc_rejected < rate * self._rpc_calls:
+                self._rpc_rejected += 1
+                self.injected["rpc_reject"] += 1
+                return self.rpc_reject_retry_after_s
+        return 0.0
 
 
 #: fast-path gate the hook sites check before touching the injector

@@ -139,6 +139,13 @@ class ExponentialTimeBoundedRetry(RetryPolicy):
         # to be independent)
         self._rng = rng or _SHARED_RNG
         self._count = 0
+        self._retry_after_s = 0.0
+
+    def note_retry_after(self, hint_s: float) -> None:
+        """Server-supplied backoff hint (admission-control shedding):
+        the NEXT sleep is at least this long, so a shed client stops
+        hammering at exactly the rate the master asked it to."""
+        self._retry_after_s = max(0.0, float(hint_s))
 
     def attempt(self) -> bool:
         now = self._time_fn()
@@ -148,7 +155,8 @@ class ExponentialTimeBoundedRetry(RetryPolicy):
         if now >= self._deadline:
             return False
         backoff = min(self._max_sleep, self._base * (2 ** (self._count - 1)))
-        sleep = min(backoff * (0.5 + 0.5 * self._rng.random()),
+        hint, self._retry_after_s = self._retry_after_s, 0.0
+        sleep = min(max(hint, backoff * (0.5 + 0.5 * self._rng.random())),
                     max(0.0, self._deadline - now))
         self._sleep_fn(sleep)
         self._count += 1
@@ -161,17 +169,26 @@ class ExponentialTimeBoundedRetry(RetryPolicy):
 
 def is_retryable(exc: BaseException) -> bool:
     if isinstance(exc, AlluxioTpuError):
-        return exc.code in RETRYABLE_CODES
+        if exc.code in RETRYABLE_CODES:
+            return True
+        # an admission-shed RPC (RESOURCE_EXHAUSTED + retry-after hint)
+        # is transient overload, not a terminal answer: retry AT the
+        # hinted pace.  A hint-less RESOURCE_EXHAUSTED (worker out of
+        # space...) stays non-retryable, as before.
+        return exc.retry_after_s is not None
     return isinstance(exc, (ConnectionError, TimeoutError, OSError))
 
 
 def retry(fn: Callable[[], T], policy: RetryPolicy,
           retry_on: Callable[[BaseException], bool] = is_retryable) -> T:
     """Run ``fn`` under ``policy``; re-raise the last error when exhausted.
+    A typed error carrying ``retry_after_s`` (master admission shedding)
+    feeds the hint to policies that can honor it.
 
     Reference: ``retry/RetryUtils.java``.
     """
     last: Optional[BaseException] = None
+    note = getattr(policy, "note_retry_after", None)
     while policy.attempt():
         try:
             return fn()
@@ -179,5 +196,8 @@ def retry(fn: Callable[[], T], policy: RetryPolicy,
             if not retry_on(e):
                 raise
             last = e
+            hint = getattr(e, "retry_after_s", None)
+            if hint and note is not None:
+                note(hint)
     assert last is not None
     raise last

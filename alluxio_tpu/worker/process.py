@@ -169,11 +169,28 @@ class BlockWorker:
             or self.address.host)
         self.web_server = None
         self.web_port: Optional[int] = None
+        qos_enabled = conf.get_bool(Keys.WORKER_QOS_ENABLED)
         self.async_cache = AsyncCacheManager(
             self.store, lambda mount_id: self.ufs_manager.get(mount_id),
             num_threads=conf.get_int(Keys.WORKER_ASYNC_CACHE_THREADS),
             queue_max=conf.get_int(Keys.WORKER_ASYNC_CACHE_QUEUE_MAX),
-            fetcher=self.ufs_fetcher)
+            fetcher=self.ufs_fetcher, prioritize=qos_enabled)
+        if qos_enabled:
+            from alluxio_tpu.metrics import metrics as _metrics
+
+            # Worker.Qos* gauges ride the metrics heartbeat into the
+            # master's Cluster.* aggregates and history series
+            reg = _metrics()
+            fetcher = self.ufs_fetcher
+            reg.register_gauge(
+                "Worker.QosFetchDeferred",
+                lambda: fetcher.qos_stats()["deferred"])
+            reg.register_gauge(
+                "Worker.QosFetchQueued",
+                lambda: fetcher.qos_stats()["queued"])
+            reg.register_gauge(
+                "Worker.QosFetchPromotedTotal",
+                lambda: fetcher.qos_stats()["promoted"])
         self._threads: List[HeartbeatThread] = []
         self._started = False
 
@@ -317,13 +334,16 @@ class BlockWorker:
         return LocalBlockLease(meta.path, meta.length, lock)
 
     def open_ufs_fetch(self, desc: UfsBlockDescriptor, *,
-                       cache: bool = True) -> BlockFetch:
+                       cache: bool = True, priority: int = 0,
+                       tenant: str = "") -> BlockFetch:
         """Start (or join) the striped cold fetch of a block; the
         returned handle streams chunks as stripes land — the data
         server serves from it while the tiered store fills in
-        parallel."""
+        parallel.  ``priority``/``tenant`` feed the QoS scheduler
+        (default ON_DEMAND, anonymous tenant)."""
         ufs = self.ufs_manager.get(desc.mount_id)
-        return self.ufs_fetcher.fetch(ufs, desc, cache=cache)
+        return self.ufs_fetcher.fetch(ufs, desc, cache=cache,
+                                      priority=priority, tenant=tenant)
 
     def read_ufs_block(self, desc: UfsBlockDescriptor, *,
                        cache: bool = True) -> bytes:

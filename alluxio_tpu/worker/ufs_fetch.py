@@ -29,6 +29,17 @@ Observability: ``Worker.UfsFetch*`` counters + ``Worker.UfsFetchTtfb``
 timer, and an ``atpu.worker.ufs_fetch`` span per fetch that joins the
 caller's trace context (so the input doctor can attribute cold-read
 stalls to this pipeline).
+
+QoS (``atpu.worker.qos.enabled``): every fetch carries a priority class
+(ON_DEMAND > ASYNC_FILL > PREFETCH) and a tenant (principal).  The
+per-mount executors drain in priority order — a queued prefetch fetch
+is overtaken by an arriving on-demand read (in-flight stripes are never
+interrupted), and a queued fetch is PROMOTED the moment an on-demand
+reader coalesces onto it — with per-tenant caps on concurrent stripe
+tasks so one flooding principal cannot monopolize the mount's
+connection budget (``atpu.worker.ufs.fetch.tenant.limit``).  Disabled,
+the executors are plain FIFO pools: byte-identical to a build without
+QoS.
 """
 
 from __future__ import annotations
@@ -36,11 +47,11 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from alluxio_tpu.metrics import metrics
+from alluxio_tpu.qos import ON_DEMAND, PRIORITY_NAMES, PriorityExecutor
 from alluxio_tpu.underfs.base import UnderFileSystem
 from alluxio_tpu.utils import tracing as _tracing
 from alluxio_tpu.utils.striping import plan_stripes as _plan_stripes
@@ -65,6 +76,11 @@ class FetchConf:
     concurrency: int = 4
     #: concurrent UFS reads per mount, across all blocks
     per_mount_limit: int = 16
+    #: priority-class scheduling + tenant caps (atpu.worker.qos.enabled)
+    qos_enabled: bool = False
+    #: concurrent stripe tasks one tenant may occupy per mount (with
+    #: QoS on; 0 = unlimited)
+    tenant_limit: int = 8
 
     @classmethod
     def from_conf(cls, conf) -> "FetchConf":
@@ -77,6 +93,9 @@ class FetchConf:
                 Keys.WORKER_UFS_FETCH_CONCURRENCY)),
             per_mount_limit=max(1, conf.get_int(
                 Keys.WORKER_UFS_FETCH_PER_MOUNT_LIMIT)),
+            qos_enabled=conf.get_bool(Keys.WORKER_QOS_ENABLED),
+            tenant_limit=max(0, conf.get_int(
+                Keys.WORKER_UFS_FETCH_TENANT_LIMIT)),
         )
 
 
@@ -107,6 +126,9 @@ class BlockFetch:
         self.desc = desc
         self.conf = conf
         self._store = store
+        #: QoS class of the most demanding waiter (coalescing joins by
+        #: an on-demand reader lower it and promote the queued tasks)
+        self.priority = ON_DEMAND
         self.stripes = plan_stripes(desc.length, conf.stripe_size)
         self.fallback = False
         #: any stripe read succeeded / the fallback read succeeded —
@@ -488,7 +510,7 @@ class UfsBlockFetcher:
         self._fault_host = host
         self._lock = threading.Lock()
         self._inflight: Dict[int, BlockFetch] = {}
-        self._executors: Dict[int, ThreadPoolExecutor] = {}
+        self._executors: Dict[int, PriorityExecutor] = {}
         #: mount_id -> retry-after (monotonic): a mount whose UFS failed
         #: a ranged read goes straight to single-range until the TTL
         #: lapses — a permanent demotion would let one transient stripe
@@ -510,7 +532,7 @@ class UfsBlockFetcher:
             fetch = self._inflight.get(block_id)
         return fetch is not None and fetch._cache_fill is not None
 
-    def _executor(self, mount_id: int) -> ThreadPoolExecutor:
+    def _executor(self, mount_id: int) -> PriorityExecutor:
         with self._lock:
             if self._closed:
                 # close() already drained the map; recreating here
@@ -518,11 +540,37 @@ class UfsBlockFetcher:
                 raise FetchError("fetcher is closed")
             ex = self._executors.get(mount_id)
             if ex is None:
-                ex = ThreadPoolExecutor(
-                    max_workers=self.conf.per_mount_limit,
-                    thread_name_prefix=f"ufs-fetch-m{mount_id}")
+                # with QoS off this drains FIFO with no tenant caps —
+                # semantically the ThreadPoolExecutor it replaced
+                ex = PriorityExecutor(
+                    self.conf.per_mount_limit,
+                    thread_name_prefix=f"ufs-fetch-m{mount_id}",
+                    prioritize=self.conf.qos_enabled,
+                    tenant_cap=self.conf.tenant_limit
+                    if self.conf.qos_enabled else 0)
                 self._executors[mount_id] = ex
             return ex
+
+    #: qos_stats memo TTL: three gauges read these on every metrics
+    #: scrape — one executor sweep serves all three, not three
+    QOS_STATS_TTL_S = 0.5
+
+    def qos_stats(self) -> Dict[str, float]:
+        """Aggregated executor QoS counters (gauges in BlockWorker);
+        briefly memoized so one scrape's three gauges share a sweep."""
+        now = time.monotonic()
+        cached = getattr(self, "_qos_stats_cache", None)
+        if cached is not None and now - cached[0] < self.QOS_STATS_TTL_S:
+            return cached[1]
+        with self._lock:
+            exs = list(self._executors.values())
+        stats = {
+            "deferred": float(sum(e.deferred for e in exs)),
+            "promoted": float(sum(e.promoted for e in exs)),
+            "queued": float(sum(e.queued() for e in exs)),
+        }
+        self._qos_stats_cache = (now, stats)
+        return stats
 
     def _mark_unstriped(self, mount_id: int) -> None:
         with self._lock:
@@ -538,7 +586,9 @@ class UfsBlockFetcher:
             return self.conf
         # known-unstriped mount: one worker, one whole-block stripe
         return FetchConf(stripe_size=max(1, desc.length), concurrency=1,
-                         per_mount_limit=self.conf.per_mount_limit)
+                         per_mount_limit=self.conf.per_mount_limit,
+                         qos_enabled=self.conf.qos_enabled,
+                         tenant_limit=self.conf.tenant_limit)
 
     def _on_done(self, fetch: BlockFetch) -> None:
         # demote the mount only on the precise range-rejection
@@ -555,8 +605,16 @@ class UfsBlockFetcher:
 
     # -- entry point --------------------------------------------------------
     def fetch(self, ufs: UnderFileSystem, desc: UfsBlockDescriptor, *,
-              cache: bool = True, tier_alias: str = "") -> BlockFetch:
-        """Start (or join) the fetch of one cold block."""
+              cache: bool = True, tier_alias: str = "",
+              priority: int = ON_DEMAND, tenant: str = "") -> BlockFetch:
+        """Start (or join) the fetch of one cold block.
+
+        ``priority`` is the caller's QoS class (the async cache manager
+        passes ASYNC_FILL, the prefetch agent's loads PREFETCH); with
+        QoS disabled it is ignored.  Joining a queued lower-priority
+        fetch PROMOTES it: the moment an on-demand reader waits on a
+        prefetch-initiated fetch, its queued stripe tasks jump the
+        background work ahead of them."""
         with self._lock:
             if self._closed:
                 raise FetchError("fetcher is closed")
@@ -571,6 +629,7 @@ class UfsBlockFetcher:
             # not stall coalescing joins / fetch starts of other blocks
             fetch = BlockFetch(desc, conf, store=self._store,
                                on_done=self._on_done)
+            fetch.priority = priority
             with self._lock:
                 if self._closed:
                     raise FetchError("fetcher is closed")
@@ -581,6 +640,21 @@ class UfsBlockFetcher:
                     existing.waiters += 1
         if existing is not None:
             self._m.counter("Worker.UfsFetchCoalesced").inc()
+            promote_ex = None
+            if self.conf.qos_enabled:
+                # decide under the registry lock: two simultaneous
+                # joiners must not both read the stale priority and
+                # skip (or double-run) the promotion
+                with self._lock:
+                    if priority < existing.priority:
+                        existing.priority = priority
+                        promote_ex = self._executors.get(desc.mount_id)
+            if promote_ex is not None:
+                # an on-demand reader joined background work: its
+                # queued stripe tasks stop yielding to other queues
+                moved = promote_ex.promote(desc.block_id, priority)
+                if moved:
+                    self._m.counter("Worker.QosFetchPromoted").inc(moved)
             if cache:
                 # a caching reader joining a cache=False fetch upgrades
                 # it while that is still sound (nothing past the
@@ -593,11 +667,17 @@ class UfsBlockFetcher:
             # below are submitted, so it cannot race the frontier
             fetch.try_attach_cache_fill(self._store, tier_alias)
         self._m.counter("Worker.UfsFetchStarted").inc()
+        if self.conf.qos_enabled:
+            self._m.counter(
+                "Worker.QosFetch."
+                + PRIORITY_NAMES.get(priority, str(priority))).inc()
         try:
             ex = self._executor(desc.mount_id)
             workers = min(conf.concurrency, len(fetch.stripes))
             for _ in range(max(1, workers)):
-                ex.submit(self._stripe_loop, ufs, fetch)
+                ex.submit(self._stripe_loop, ufs, fetch,
+                          priority=priority, tenant=tenant,
+                          group=desc.block_id)
         except BaseException as e:  # closed/shutdown race: no workers
             fetch._fail(e)          # will ever land stripes — fail the
             raise                   # fetch so no waiter hangs on it

@@ -102,18 +102,28 @@ class AsyncCacheManager:
     limit — passive caching is advisory, the client already has the bytes.
     When a ``UfsBlockFetcher`` is wired in, cache fills ride the same
     coalescing registry as foreground reads, so a background fill never
-    duplicates an in-flight foreground fetch of the same block."""
+    duplicates an in-flight foreground fetch of the same block.
+
+    With worker QoS on (``prioritize=True``) the queue drains in
+    priority order — client-issued ASYNC_FILL requests before the
+    prefetch agent's speculative PREFETCH loads — and each request's
+    class and tenant ride into the coalescing fetch, so the per-mount
+    stripe executors see the true originator.  Off, the queue is exact
+    FIFO (today's behavior)."""
 
     def __init__(self, store: TieredBlockStore,
                  ufs_resolver: Callable[[int], UnderFileSystem],
                  num_threads: int = 1, queue_max: int = 512,
-                 fetcher=None) -> None:
+                 fetcher=None, prioritize: bool = False) -> None:
+        from alluxio_tpu.qos import PriorityTaskQueue
+
         self._store = store
         self._reader = UfsBlockReader(store)
         self._ufs_resolver = ufs_resolver
         self._fetcher = fetcher  # Optional[ufs_fetch.UfsBlockFetcher]
-        self._queue: "queue.Queue[Optional[UfsBlockDescriptor]]" = \
-            queue.Queue(maxsize=max(1, queue_max))
+        self._queue = PriorityTaskQueue(max(1, queue_max),
+                                        prioritize=prioritize)
+        self._prioritize = prioritize
         self._inflight: Dict[int, bool] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -123,9 +133,13 @@ class AsyncCacheManager:
         for t in self._threads:
             t.start()
 
-    def submit(self, desc: UfsBlockDescriptor) -> bool:
+    def submit(self, desc: UfsBlockDescriptor, *,
+               priority: Optional[int] = None, tenant: str = "") -> bool:
         from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.qos import ASYNC_FILL, PRIORITY_NAMES
 
+        if priority is None:
+            priority = ASYNC_FILL
         with self._lock:
             if self._closed or desc.block_id in self._inflight or \
                     self._store.has_block(desc.block_id):
@@ -138,18 +152,22 @@ class AsyncCacheManager:
                 return False
             self._inflight[desc.block_id] = True
         try:
-            self._queue.put_nowait(desc)
+            self._queue.put_nowait((desc, priority, tenant), priority)
         except queue.Full:
             with self._lock:
                 self._inflight.pop(desc.block_id, None)
             metrics().counter("Worker.AsyncCacheRejected").inc()
             return False
+        if self._prioritize:
+            metrics().counter(
+                "Worker.QosAsyncCache."
+                + PRIORITY_NAMES.get(priority, str(priority))).inc()
         return True
 
     def _run(self) -> None:
         while True:
             try:
-                desc = self._queue.get(timeout=0.2)
+                desc, priority, tenant = self._queue.get(timeout=0.2)
             except queue.Empty:
                 if self._closed:
                     return
@@ -166,9 +184,12 @@ class AsyncCacheManager:
                 if self._fetcher is not None:
                     # coalesces with any concurrent fetch of this block;
                     # joining a cache=False fetch upgrades it, and if
-                    # even that was too late, cache from the bytes
-                    data = self._fetcher.fetch(ufs, desc,
-                                               cache=True).result()
+                    # even that was too late, cache from the bytes.
+                    # The request's class/tenant ride into the stripe
+                    # executor so background fills queue as background
+                    data = self._fetcher.fetch(ufs, desc, cache=True,
+                                               priority=priority,
+                                               tenant=tenant).result()
                     if not self._store.has_block(desc.block_id):
                         self._reader.cache_block(desc.block_id, data)
                 else:

@@ -66,9 +66,23 @@ def test_two_process_mesh_block_cache(tmp_path):
                                   env=env, text=True)
                  for pid in (0, 1)]
         results = {}
-        for p in procs:
-            out, err = p.communicate(timeout=270)
+        outputs = [p.communicate(timeout=270) for p in procs]
+        for p, (out, err) in zip(procs, outputs):
+            if p.returncode != 0 and \
+                    "Multiprocess computations aren't implemented on " \
+                    "the CPU backend" in (err or ""):
+                # environment gap, not a product regression: this
+                # jaxlib's CPU backend has no gloo cross-process
+                # collectives, so the 2-process mesh cannot exist here.
+                # Skip on exactly this signature — any other failure
+                # mode still fails the test.
+                for rest in procs:
+                    if rest.poll() is None:
+                        rest.kill()
+                pytest.skip("jaxlib CPU backend lacks multiprocess "
+                            "collectives (gloo) in this environment")
             assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err[-3000:]}"
+        for p, (out, err) in zip(procs, outputs):
             line = [ln for ln in out.splitlines()
                     if ln.startswith("MH-OK ")][-1]
             import json

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import io
 import os
+import time
 
 import pytest
 
@@ -142,6 +143,16 @@ class TestFsShell:
         fs.write_all("/src/f", b"data" * 100)
         code, _, _ = run_shell(FS_SHELL, cluster, ["cp", "-R", "/src", "/cp"])
         assert code == 0 and fs.read_all("/cp/f") == b"data" * 100
+        # the cp wrote /cp/f with the default ASYNC_THROUGH type: let
+        # its async persist land before renaming, or the persist job
+        # races the mv, recreates the UFS cp/ directory (then fails on
+        # the renamed file) and metadata-on-demand resurrects /cp —
+        # observed ~1-in-3 on the 1-core CI host
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                fs.get_status("/cp/f").persistence_state != "PERSISTED":
+            time.sleep(0.05)
+        assert fs.get_status("/cp/f").persistence_state == "PERSISTED"
         code, _, _ = run_shell(FS_SHELL, cluster, ["mv", "/cp", "/moved"])
         assert code == 0 and fs.exists("/moved/f") and not fs.exists("/cp")
 

@@ -232,3 +232,28 @@ class TestCli:
         out = capsys.readouterr().out.strip().splitlines()
         assert len(out) == 1
         assert json.loads(out[0])["bench"] == "worker-random"
+
+
+class TestQosBench:
+    def test_smoke_toy_scale(self):
+        """The two-tenant QoS bench runs end-to-end at toy scale, emits
+        the gated metrics, and passes its own gates (QoS protects the
+        victim; FIFO does not; admission sheds bounded)."""
+        from alluxio_tpu.stress.qos_bench import run
+
+        # toy rtt with a RELAXED 3x gate: a 15ms sleep does not dwarf
+        # this 1-core host's scheduling jitter the way the real bench's
+        # 40ms does, and the smoke is about mechanics, not the
+        # production 2x gate (make bench-qos keeps that)
+        r = run(rtt_ms=15.0, block_kb=4, victim_reads=4,
+                flood_blocks=12, per_mount_limit=2, tenant_limit=1,
+                max_degradation=3.0,
+                admission_checks=5_000, admission_principals=500,
+                admission_max_principals=64)
+        assert r.errors == 0, r.metrics
+        m = r.metrics
+        assert m["victim_degradation_qos_x"] <= 3.0
+        assert m["victim_flood_fifo_p99_ms"] > m["victim_flood_qos_p99_ms"]
+        assert m["admission_shed"] > 0
+        assert m["admission_buckets_tracked"] <= 64
+        json.loads(r.json_line())  # wire contract holds

@@ -3,9 +3,18 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos sdist clean lint
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos sdist clean lint lint-changed lint-docs
 
-test:
+lint:  ## atpu-lint: conf-key/metric-name/lock/exception discipline (<30s budget)
+	$(PY) -m alluxio_tpu.lint --budget-s 30
+
+lint-changed:  ## fast mode: only files changed vs HEAD (registry-wide rules skipped)
+	$(PY) -m alluxio_tpu.lint --changed
+
+lint-docs:  ## regenerate docs/configuration.md + docs/metrics.md from the registries
+	$(PY) -m alluxio_tpu.lint --write-docs
+
+test: lint
 	$(PY) -m pytest tests/ -q
 
 test-fast:  ## skip multi-process (subprocess-spawning) tests

@@ -39,9 +39,12 @@ class BlockStoreClient:
                  passive_cache: bool = True,
                  write_unavailable_window_s: float = 15.0,
                  streaming_chunk_size: int = 1 << 20,
+                 streaming_writer_chunk_size: int = 1 << 20,
                  remote_read: Optional[RemoteReadConf] = None) -> None:
         """``streaming_chunk_size``: per-message chunk of the gRPC read
         streams (``atpu.user.streaming.reader.chunk.size.bytes``);
+        ``streaming_writer_chunk_size``: per-message chunk of the write
+        stream (``atpu.user.streaming.writer.chunk.size.bytes``);
         ``remote_read``: striped-read tuning — the default conf stripes
         large remote reads, ``RemoteReadConf(stripe_size=0)`` pins the
         legacy single-stream path."""
@@ -58,6 +61,7 @@ class BlockStoreClient:
         self._passive_cache = passive_cache
         self._write_unavailable_window_s = write_unavailable_window_s
         self._chunk_size = max(1, streaming_chunk_size)
+        self._writer_chunk_size = max(1, streaming_writer_chunk_size)
         #: the parallel remote-read runtime every GrpcBlockInStream of
         #: this store shares: stripe executor + per-worker latency EWMAs
         #: (hedging learns across reads, so it lives here, not per-stream)
@@ -263,7 +267,8 @@ class BlockStoreClient:
             except Exception:  # noqa: BLE001
                 pass
         return GrpcBlockOutStream(client, self.session_id, block_id,
-                                  tier=tier, pinned=pinned)
+                                  tier=tier, pinned=pinned,
+                                  chunk_size=self._writer_chunk_size)
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

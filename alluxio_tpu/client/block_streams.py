@@ -346,12 +346,14 @@ class GrpcBlockOutStream(BlockOutStream):
     _CHUNK = 1 << 20
 
     def __init__(self, worker: WorkerClient, session_id: int, block_id: int,
-                 *, tier: str = "", pinned: bool = False) -> None:
+                 *, tier: str = "", pinned: bool = False,
+                 chunk_size: Optional[int] = None) -> None:
         super().__init__(block_id)
         self._worker = worker
         self._session = session_id
         self._tier = tier
         self._pinned = pinned
+        self._chunk = max(1, chunk_size) if chunk_size else self._CHUNK
         self._queue: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_DEPTH)
         self._result: "futures.Future" = futures.Future()
         self._sender = threading.Thread(target=self._send, daemon=True,
@@ -384,10 +386,10 @@ class GrpcBlockOutStream(BlockOutStream):
 
     def write(self, data: bytes) -> None:
         view = memoryview(data)
-        for i in range(0, len(view), self._CHUNK):
+        for i in range(0, len(view), self._chunk):
             if self._result.done():  # sender died: surface its error
                 self._result.result()
-            self._queue.put(bytes(view[i:i + self._CHUNK]))
+            self._queue.put(bytes(view[i:i + self._chunk]))
         self.written += len(data)
 
     def close(self, cancel: bool = False) -> None:

@@ -21,6 +21,7 @@ from alluxio_tpu.conf import Configuration, Keys
 from alluxio_tpu.rpc.clients import (
     BlockMasterClient, FsMasterClient, MetaMasterClient,
 )
+from alluxio_tpu.utils.exceptions import best_effort
 from alluxio_tpu.utils.uri import AlluxioURI
 from alluxio_tpu.utils.wire import FileInfo, MountPointInfo, TieredIdentity
 
@@ -118,6 +119,8 @@ class FileSystem:
                 Keys.USER_BLOCK_WRITE_UNAVAILABLE_WINDOW),
             streaming_chunk_size=self._conf.get_bytes(
                 Keys.USER_STREAMING_READER_CHUNK_SIZE),
+            streaming_writer_chunk_size=self._conf.get_bytes(
+                Keys.USER_STREAMING_WRITER_CHUNK_SIZE),
             remote_read=RemoteReadConf.from_conf(self._conf))
         # pull cluster defaults once at start (reference: clients load
         # cluster-default config via the meta master on first connect)
@@ -150,6 +153,13 @@ class FileSystem:
             from alluxio_tpu.client.cache.manager import LocalCacheManager
 
             self._page_cache = LocalCacheManager.from_conf(self._conf)
+        #: config-hash handshake pacing (reference: ConfigHashSync): the
+        #: metrics heartbeat re-checks the cluster-default hash at most
+        #: once per atpu.user.conf.sync.interval — set BEFORE the
+        #: heartbeat thread starts, which may tick immediately
+        self._conf_sync_interval_s = self._conf.get_duration_s(
+            Keys.USER_CONF_SYNC_INTERVAL)
+        self._last_conf_sync = time.monotonic()
         self._metrics_thread = None
         if self._conf.get_bool(Keys.USER_METRICS_COLLECTION_ENABLED):
             from alluxio_tpu.heartbeat import (
@@ -176,6 +186,12 @@ class FileSystem:
         resp = self.meta_master.metrics_heartbeat(
             f"client-{socket.gethostname()}-{id(self):x}",
             metrics().snapshot(), spans=spans)
+        if self._conf_sync_interval_s > 0 and \
+                self._conf.get_bool(Keys.USER_CONF_CLUSTER_DEFAULT_ENABLED):
+            now = time.monotonic()
+            if now - self._last_conf_sync >= self._conf_sync_interval_s:
+                self._last_conf_sync = now
+                best_effort("config-hash sync", self.check_config_sync)
         if isinstance(resp, dict) and "conf_overlay_version" in resp:
             self.apply_conf_overlay(resp.get("conf_overlay") or {},
                                     int(resp["conf_overlay_version"]))
@@ -432,6 +448,12 @@ class FileSystem:
             rep = self.path_default(path, Keys.USER_FILE_REPLICATION_MIN)
             if rep is not None:
                 opts["replication_min"] = int(rep)
+        if "replication_max" not in opts:
+            rep = self.path_default(path, Keys.USER_FILE_REPLICATION_MAX)
+            if rep is None:
+                rep = self._conf.get_int(Keys.USER_FILE_REPLICATION_MAX)
+            if rep is not None and int(rep) >= 0:
+                opts["replication_max"] = int(rep)
         persist_on_complete = wt == WriteType.ASYNC_THROUGH
         info = self.fs_master.create_file(
             AlluxioURI(path).path, block_size_bytes=block_size_bytes,

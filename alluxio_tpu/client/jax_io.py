@@ -33,6 +33,7 @@ import numpy as np
 from alluxio_tpu.client.cache.hbm_store import HbmPageStore
 from alluxio_tpu.client.cache.meta import PageId
 from alluxio_tpu.client.file_system import FileSystem
+from alluxio_tpu.conf import Keys
 from alluxio_tpu.metrics import metrics
 from alluxio_tpu.metrics.stall import BUCKET_ADVICE, STALL_BUCKETS
 from alluxio_tpu.utils.tracing import annotate
@@ -165,7 +166,7 @@ class DeviceBlockLoader:
 
     def __init__(self, fs: FileSystem, paths: Sequence[str], *,
                  device=None, hbm_bytes: int = 0,
-                 prefetch: int = 2, dtype=np.uint8,
+                 prefetch: Optional[int] = None, dtype=np.uint8,
                  prefetch_service=None) -> None:
         import jax
 
@@ -175,6 +176,10 @@ class DeviceBlockLoader:
         self._device = device or jax.devices()[0]
         self._hbm = HbmPageStore(hbm_bytes, self._device) \
             if hbm_bytes > 0 else None
+        if prefetch is None:
+            # double-buffer depth for the zero-copy iterator
+            # (atpu.tpu.prefetch.buffer.batches, default 2)
+            prefetch = fs._conf.get_int(Keys.TPU_PREFETCH_BUFFER_BATCHES)
         self._prefetch = max(0, prefetch)
         # clairvoyant prefetch service (prefetch/service.py). None (the
         # default) leaves every code path byte-identical to a loader

@@ -286,10 +286,6 @@ class Keys:
     CLUSTER_NAME = _k("atpu.cluster.name", default="default-cluster",
                       consistency=ConsistencyLevel.ENFORCE)
     HOME = _k("atpu.home", default="/tmp/alluxio_tpu")
-    LOGS_DIR = _k("atpu.logs.dir", default="/tmp/alluxio_tpu/logs")
-    WEB_THREADS = _k("atpu.web.threads", KeyType.INT, default=4)
-    NETWORK_HOST_RESOLUTION_TIMEOUT = _k(
-        "atpu.network.host.resolution.timeout", KeyType.DURATION, default="5s")
     USER_BLOCK_SIZE_BYTES_DEFAULT = _k(
         "atpu.user.block.size.bytes.default", KeyType.BYTES, default="64MB",
         description="Default block size for new files "
@@ -450,8 +446,6 @@ class Keys:
     MASTER_METASTORE_INODE_CACHE_MAX_SIZE = _k(
         "atpu.master.metastore.inode.cache.max.size", KeyType.INT, default=100_000,
         scope=Scope.MASTER)
-    MASTER_HEARTBEAT_TIMEOUT = _k("atpu.master.heartbeat.timeout",
-                                  KeyType.DURATION, default="10min", scope=Scope.MASTER)
     MASTER_WORKER_TIMEOUT = _k("atpu.master.worker.timeout", KeyType.DURATION,
                                default="5min", scope=Scope.MASTER,
                                description="Silent-worker expiry "
@@ -554,9 +548,6 @@ class Keys:
         scope=Scope.MASTER,
         description="Scheduled backups kept after pruning (reference: "
                     "alluxio.master.daily.backup.files.retained).")
-    MASTER_METADATA_SYNC_EXECUTOR_POOL_SIZE = _k(
-        "atpu.master.metadata.sync.executor.pool.size", KeyType.INT, default=8,
-        scope=Scope.MASTER)
 
     # --- worker ---
     WORKER_HOSTNAME = _k("atpu.worker.hostname", default="localhost")
@@ -601,11 +592,6 @@ class Keys:
     WORKER_MANAGEMENT_PROMOTE_QUOTA_PERCENT = _k(
         "atpu.worker.management.tier.promote.quota.percent", KeyType.INT, default=90,
         scope=Scope.WORKER)
-    WORKER_REGISTER_LEASE_RETRY_MAX_DURATION = _k(
-        "atpu.worker.register.lease.retry.max.duration", KeyType.DURATION,
-        default="1min", scope=Scope.WORKER)
-    WORKER_FREE_SPACE_TIMEOUT = _k("atpu.worker.free.space.timeout",
-                                   KeyType.DURATION, default="10s", scope=Scope.WORKER)
     WORKER_SHM_DIR = _k("atpu.worker.shm.dir", default="/dev/shm/alluxio_tpu",
                         scope=Scope.WORKER,
                         description="Backing dir for the MEM tier; files here are "
@@ -690,9 +676,6 @@ class Keys:
         "atpu.user.block.write.location.policy", KeyType.ENUM, default="LOCAL_FIRST",
         choices=("LOCAL_FIRST", "LOCAL_FIRST_AVOID_EVICTION", "MOST_AVAILABLE",
                  "ROUND_ROBIN", "DETERMINISTIC_HASH", "SPECIFIC_HOST"),
-        scope=Scope.CLIENT)
-    USER_UFS_BLOCK_READ_CONCURRENCY_MAX = _k(
-        "atpu.user.ufs.block.read.concurrency.max", KeyType.INT, default=2147483647,
         scope=Scope.CLIENT)
     USER_SHORT_CIRCUIT_ENABLED = _k("atpu.user.short.circuit.enabled", KeyType.BOOL,
                                     default=True, scope=Scope.CLIENT)
@@ -1061,7 +1044,6 @@ class Keys:
     JOB_MASTER_LOST_WORKER_INTERVAL = _k(
         "atpu.job.master.lost.worker.interval", KeyType.DURATION,
         default="10s", scope=Scope.JOB_MASTER)
-    JOB_WORKER_RPC_PORT = _k("atpu.job.worker.rpc.port", KeyType.INT, default=30001)
     JOB_WORKER_THREADPOOL_SIZE = _k("atpu.job.worker.threadpool.size", KeyType.INT,
                                     default=8, scope=Scope.JOB_WORKER)
     JOB_WORKER_HEARTBEAT_INTERVAL = _k("atpu.job.worker.heartbeat.interval",
@@ -1105,17 +1087,10 @@ class Keys:
                     "the next placement plan issued once per tick.")
 
     # --- TPU / HBM data path (native additions) ---
-    TPU_MESH_SHAPE = _k("atpu.tpu.mesh.shape", KeyType.LIST, default=None,
-                        description="Logical mesh axes 'data=4,model=2' used by "
-                                    "the sharded prefetch path.")
     TPU_PREFETCH_BUFFER_BATCHES = _k("atpu.tpu.prefetch.buffer.batches", KeyType.INT,
                                      default=2,
                                      description="Device-side double-buffering depth "
                                                  "for the zero-copy iterator.")
-    TPU_STAGING_BUFFER_BYTES = _k("atpu.tpu.staging.buffer.bytes", KeyType.BYTES,
-                                  default="256MB",
-                                  description="Pinned host staging pool for "
-                                              "UFS->HBM decode paths.")
 
     # --- fault injection (chaos / self-healing tests; see utils/faults.py)
     DEBUG_FAULT_READ_LATENCY = _k(

@@ -1,0 +1,39 @@
+"""atpu-lint: the repo-native static-analysis suite.
+
+Re-design of the reference's correctness-tooling surface (SURVEY §5.2:
+checkstyle/findbugs build gates + TSAN-style lock tests) for a Python
+codebase whose three load-bearing registries — ``atpu.*`` conf keys,
+instance-prefixed metric/span names, and the typed wire-error map —
+grow by dozens of entries per PR and silently rot without a machine
+check: a typo'd metric name makes a health rule permanently blind with
+zero test failures.
+
+Four AST-based analyzers (run as ``make lint`` /
+``python -m alluxio_tpu.lint``):
+
+- ``conf-keys``     every ``atpu.*`` literal resolves to a registered
+                    ``PropertyKey`` (or span/service name), every
+                    registered key is read somewhere and documented,
+                    defaults parse under their declared types
+- ``metric-names``  emitters + consumers (health rules, benches, shell,
+                    docs) form one registry; near-miss typos, undocumented
+                    names and exposition-hostile names are flagged
+- ``lock-discipline`` blocking calls (RPC, UFS I/O, ``time.sleep``,
+                    unbounded ``.result()``/``.wait()``) made while
+                    holding a lock
+- ``exceptions``    ``except Exception`` on server dispatch / heartbeat /
+                    remediation paths that neither log nor re-raise, and
+                    wire-error classes outside the serialization map
+
+Each analyzer honors inline suppressions
+(``# lint: allow[rule] -- justification``) and a checked-in baseline
+(``alluxio_tpu/lint/baseline.json``) that freezes pre-existing findings;
+new findings fail the build.  The companion pytest plugin
+(``alluxio_tpu.lint.pytest_lockaudit``) is the dynamic half: it
+auto-instruments master/worker/store locks with
+``utils.race.LockOrderAuditor`` across every test and fails the run on
+any observed lock-order inversion.
+"""
+
+from alluxio_tpu.lint.findings import Finding, Suppression  # noqa: F401
+from alluxio_tpu.lint.runner import LintReport, run_lint  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from alluxio_tpu.lint.runner import main
+
+sys.exit(main())

@@ -1,0 +1,172 @@
+"""Always-on lock-order auditing for the test suite.
+
+Promotes ``utils/race.py``'s :class:`LockOrderAuditor` from a
+one-test curiosity into a pytest plugin (registered in
+``tests/conftest.py``): the coarse master/worker/store locks are
+auto-instrumented at construction time, every test runs with a fresh
+auditor, and ANY lock pair observed in both orders — on any schedule,
+even one that did not deadlock this run — fails that test with both
+acquisition stacks.  This is the dynamic complement to the static
+``lock-discipline`` analyzer (which cannot see cross-function blocking).
+
+A :class:`~alluxio_tpu.utils.race.Watchdog` arms around every test so a
+hang dumps every thread's stack to stderr instead of dying as a silent
+CI timeout; the dump is diagnostic-only (the watchdog never fails a
+slow-but-finishing test — this CI host steals CPU in multi-second
+bursts).
+
+Opt out per-run with ``ATPU_LOCK_AUDIT=0`` (e.g. when bisecting an
+unrelated failure) or per-test with ``@pytest.mark.no_lockaudit``;
+tune the hang-dump deadline with ``ATPU_LOCK_AUDIT_WATCHDOG_S``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import pytest
+
+from alluxio_tpu.utils.race import LockOrderAuditor, Watchdog, _LockProxy
+
+_ENABLED = os.environ.get("ATPU_LOCK_AUDIT", "1") not in ("0", "false", "")
+_WATCHDOG_S = float(os.environ.get("ATPU_LOCK_AUDIT_WATCHDOG_S", "240"))
+
+#: (module path, class name, lock attribute, audited lock name) —
+#: the coarse locks whose ordering defines the deadlock surface between
+#: metadata, block map, store and metrics planes.
+_INSTRUMENT: Tuple[Tuple[str, str, str, str], ...] = (
+    ("alluxio_tpu.master.inode_tree", "InodeTree", "lock",
+     "InodeTree.lock"),
+    ("alluxio_tpu.master.block_master", "BlockMaster", "_lock",
+     "BlockMaster._lock"),
+    ("alluxio_tpu.master.block_master", "BlockMaster", "_reserve_lock",
+     "BlockMaster._reserve_lock"),
+    ("alluxio_tpu.master.file_master", "FileSystemMaster", "_persist_mutex",
+     "FileSystemMaster._persist_mutex"),
+    ("alluxio_tpu.master.file_master", "FileSystemMaster",
+     "_listing_cache_lock", "FileSystemMaster._listing_cache_lock"),
+    ("alluxio_tpu.master.metrics_master", "MetricsStore", "_lock",
+     "MetricsStore._lock"),
+    ("alluxio_tpu.metrics.history", "MetricsHistory", "_lock",
+     "MetricsHistory._lock"),
+    ("alluxio_tpu.metrics.history", "MetricsHistory", "_pending_lock",
+     "MetricsHistory._pending_lock"),
+    ("alluxio_tpu.worker.tiered_store", "TieredBlockStore", "_alloc_lock",
+     "TieredBlockStore._alloc_lock"),
+    ("alluxio_tpu.worker.lock_manager", "BlockLockManager", "_meta_lock",
+     "BlockLockManager._meta_lock"),
+)
+
+
+class _AuditorDelegate:
+    """The auditor handle baked into every proxy: forwards to whichever
+    per-test auditor is active, no-ops between tests.  Instances built
+    in one test keep auditing correctly in the next — names, not object
+    identities, define the order graph."""
+
+    def __init__(self) -> None:
+        self.current: Optional[LockOrderAuditor] = None
+
+    def _before_acquire(self, name: str, blocking: bool = True) -> None:
+        a = self.current
+        if a is not None:
+            a._before_acquire(name, blocking=blocking)
+
+    def _acquired(self, name: str, *, record: bool = False) -> None:
+        a = self.current
+        if a is not None:
+            a._acquired(name, record=record)
+
+    def _abandoned(self, name: str) -> None:
+        a = self.current
+        if a is not None:
+            a._abandoned(name)
+
+    def _released(self, name: str) -> None:
+        a = self.current
+        if a is not None:
+            a._released(name)
+
+
+_DELEGATE = _AuditorDelegate()
+_installed = False
+_install_lock = threading.Lock()
+
+
+def _install() -> None:
+    """Patch each target class's ``__init__`` to wrap its lock attr in
+    an audited proxy.  Installed once per process, active for the whole
+    session; the delegate decides whether events are recorded."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import importlib
+
+        patched = {}
+        for module_name, cls_name, attr, lock_name in _INSTRUMENT:
+            mod = importlib.import_module(module_name)
+            cls = getattr(mod, cls_name)
+            patched.setdefault(cls, []).append((attr, lock_name))
+
+        for cls, attrs in patched.items():
+            orig_init = cls.__init__
+
+            @functools.wraps(orig_init)
+            def init(self, *a, _orig=orig_init, _attrs=tuple(attrs), **kw):
+                _orig(self, *a, **kw)
+                for attr, lock_name in _attrs:
+                    inner = getattr(self, attr, None)
+                    if inner is not None and \
+                            not isinstance(inner, _LockProxy):
+                        setattr(self, attr,
+                                _LockProxy(inner, lock_name, _DELEGATE))
+
+            cls.__init__ = init
+        _installed = True
+
+
+def pytest_configure(config) -> None:
+    config.addinivalue_line(
+        "markers",
+        "no_lockaudit: disable lock-order auditing for this test")
+    if _ENABLED:
+        _install()
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_audit(request):
+    if not _ENABLED or \
+            request.node.get_closest_marker("no_lockaudit") is not None:
+        yield
+        return
+    auditor = LockOrderAuditor()
+    _DELEGATE.current = auditor
+    wd = Watchdog(_WATCHDOG_S)
+    wd.__enter__()
+    try:
+        yield
+    finally:
+        # manual exit: the watchdog dump is diagnostic-only — never turn
+        # a slow-but-finishing test into a failure on a stolen-CPU box
+        if wd._timer is not None:
+            wd._timer.cancel()
+        _DELEGATE.current = None
+    if wd.fired:
+        import warnings
+
+        warnings.warn(
+            f"lockaudit watchdog fired after {_WATCHDOG_S:.0f}s "
+            f"(thread stacks were dumped to stderr)", stacklevel=1)
+    # raising in teardown errors the test — an observed inversion on ANY
+    # schedule proves a deadlocking schedule exists
+    auditor.assert_clean()
+
+
+def observed_edges() -> List[Tuple[str, str]]:
+    """Test helper: edges of the active auditor (empty between tests)."""
+    a = _DELEGATE.current
+    return sorted(a.edges) if a is not None else []

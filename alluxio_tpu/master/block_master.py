@@ -13,6 +13,7 @@ rebuilds the location map from re-registration, never from the journal.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -28,6 +29,8 @@ from alluxio_tpu.utils.exceptions import (
 from alluxio_tpu.utils.wire import (
     BlockInfo, BlockLocation, TieredIdentity, WorkerInfo, WorkerNetAddress,
 )
+
+LOG = logging.getLogger(__name__)
 
 
 class WorkerCommand:
@@ -232,8 +235,9 @@ class BlockMaster(Journaled):
         for listener in self.registered_worker_listeners:
             try:
                 listener(info)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - one bad hook must not block registration
+                LOG.warning("registered-worker listener failed for %s",
+                            info.id, exc_info=True)
 
     def worker_heartbeat(self, worker_id: int,
                          used_bytes_on_tiers: Dict[str, int],
@@ -305,8 +309,9 @@ class BlockMaster(Journaled):
             for listener in self.lost_worker_listeners:
                 try:
                     listener(info)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 - one bad hook must not block detection
+                    LOG.warning("lost-worker listener failed for %s",
+                                info.id, exc_info=True)
         return [i.id for i in newly_lost]
 
     def worker_id_for_source(self, source: str) -> Optional[int]:
@@ -368,8 +373,9 @@ class BlockMaster(Journaled):
         for listener in self.lost_worker_listeners:
             try:
                 listener(info)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - one bad hook must not block removal
+                LOG.warning("lost-worker listener failed for %s",
+                            info.id, exc_info=True)
 
     # --------------------------------------------------------------- blocks
     def commit_block(self, worker_id: int, used_bytes_on_tier: int,

@@ -38,7 +38,8 @@ from alluxio_tpu.utils.clock import Clock, SystemClock
 from alluxio_tpu.utils.exceptions import (
     DirectoryNotEmptyError, FileAlreadyCompletedError, FileAlreadyExistsError,
     FileDoesNotExistError, FileIncompleteError, InvalidArgumentError,
-    InvalidPathError, PermissionDeniedError, UnavailableError,
+    InvalidPathError, NotFoundError, PermissionDeniedError, UnavailableError,
+    register_wire_error,
 )
 from alluxio_tpu.utils.fingerprint import Fingerprint
 from alluxio_tpu.utils.uri import AlluxioURI
@@ -70,7 +71,8 @@ class FileSystemMaster:
                  clock: Optional[Clock] = None,
                  default_block_size: int = 64 << 20,
                  permission_checker=None,
-                 umask: int = 0o022) -> None:
+                 umask: int = 0o022,
+                 ufs_path_cache_capacity: int = 10_000) -> None:
         self._block_master = block_master
         self._journal = journal
         self._ufs = ufs_manager or UfsManager()
@@ -98,7 +100,8 @@ class FileSystemMaster:
         #: last-sync bookkeeping (reference: UfsSyncPathCache)
         self._sync_cache = UfsSyncPathCache()
         #: UFS paths known absent (reference: AsyncUfsAbsentPathCache)
-        self._absent_cache = AbsentPathCache()
+        self._absent_cache = AbsentPathCache(
+            max_size=max(1, ufs_path_cache_capacity))
         #: dir inode id -> (tree_version, location_version, wire dicts).
         #: Directory listing is the #1 metadata op for training-data
         #: discovery and re-lists the same (unchanged) dirs constantly;
@@ -319,8 +322,8 @@ class FileSystemMaster:
                     dres = self.mount_table.resolve(dir_uri)
                     d_ufs = dres.ufs_path.rstrip("/")
                     d_mount = dres.mount_id
-                except Exception:  # noqa: BLE001 unmounted region
-                    d_ufs, d_mount = "", 0
+                except (NotFoundError, InvalidPathError):
+                    d_ufs, d_mount = "", 0  # unmounted region
                 d_path = dir_uri.path if dir_uri.path != "/" else ""
                 for child in self.inode_tree.children(dir_inode):
                     child_path = f"{d_path}/{child.name}"
@@ -414,8 +417,8 @@ class FileSystemMaster:
                 resolution = self.mount_table.resolve(uri)
                 ufs_path = resolution.ufs_path
                 mount_id = resolution.mount_id
-            except Exception:  # noqa: BLE001 - unmounted: no UFS path
-                ufs_path, mount_id = "", 0
+            except (NotFoundError, InvalidPathError):
+                ufs_path, mount_id = "", 0  # unmounted: no UFS path
             is_mp = self.mount_table.is_mount_point(uri)
         return {
             "file_id": inode.id, "name": inode.name or "/", "path": path,
@@ -685,7 +688,7 @@ class FileSystemMaster:
     def _check_ufs_writable(self, uri: AlluxioURI) -> None:
         try:
             resolution = self.mount_table.resolve(uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return
         if resolution.mount_info.read_only:
             raise PermissionDeniedError(
@@ -694,7 +697,7 @@ class FileSystemMaster:
     def _delete_in_ufs(self, base_uri: AlluxioURI, inodes: List[Inode]) -> None:
         try:
             resolution = self.mount_table.resolve(base_uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return
         ufs = self._ufs.get(resolution.mount_id)
         # deepest-first ufs delete; base last
@@ -763,7 +766,7 @@ class FileSystemMaster:
         try:
             src_res = self.mount_table.resolve(src_uri)
             dst_res = self.mount_table.resolve(dst_uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return
         ufs = self._ufs.get(src_res.mount_id)
         if is_dir:
@@ -820,41 +823,45 @@ class FileSystemMaster:
         uri = AlluxioURI(path)
         if uri.is_root():
             raise InvalidPathError("root mount is set at startup")
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
-            if lookup.exists:
-                raise FileAlreadyExistsError(f"{uri} already exists")
-            if len(lookup.missing_components) > 1:
-                raise FileDoesNotExistError(f"parent of {uri} must exist")
-            self._check_parent_write(lookup)
-            mount_id = ids.create_mount_id()
-            # validate the UFS before journaling (link check, reference does
-            # the same via UnderFileSystem creation + status probe)
-            ufs = self._ufs.add_mount(mount_id, ufs_uri, properties)
+        # Validate the UFS BEFORE taking the tree lock: get_status is a
+        # backing-store round trip (seconds against a cold object store)
+        # and holding the global write lock across it would stall every
+        # metadata operation cluster-wide.  The fresh mount_id is not
+        # routable until ADD_MOUNT_POINT applies, so the early
+        # UfsManager registration is invisible to readers; any failure
+        # from here on removes it.
+        mount_id = ids.create_mount_id()
+        ufs = self._ufs.add_mount(mount_id, ufs_uri, properties)
+        try:
             status = ufs.get_status(ufs_uri)
             if status is None or not status.is_directory:
-                self._ufs.remove_mount(mount_id)
                 raise InvalidArgumentError(
                     f"UFS path {ufs_uri} is not an existing directory")
-            info = MountInfo(mount_id, uri.path, ufs_uri, read_only, shared,
-                             dict(properties or {}))
-            now = self._now()
-            cid = self._block_master.new_container_id()
-            dir_inode = Inode.new_directory(
-                ids.file_id_from_container(cid), lookup.deepest.id, uri.name,
-                now_ms=now)
-            dir_inode.mount_point = True
-            dir_inode.persistence_state = PersistenceState.PERSISTED
-            try:
+            with self.inode_tree.lock.write_locked():
+                lookup = self.inode_tree.lookup(uri)
+                if lookup.exists:
+                    raise FileAlreadyExistsError(f"{uri} already exists")
+                if len(lookup.missing_components) > 1:
+                    raise FileDoesNotExistError(f"parent of {uri} must exist")
+                self._check_parent_write(lookup)
+                info = MountInfo(mount_id, uri.path, ufs_uri, read_only,
+                                 shared, dict(properties or {}))
+                now = self._now()
+                cid = self._block_master.new_container_id()
+                dir_inode = Inode.new_directory(
+                    ids.file_id_from_container(cid), lookup.deepest.id,
+                    uri.name, now_ms=now)
+                dir_inode.mount_point = True
+                dir_inode.persistence_state = PersistenceState.PERSISTED
                 with self._journal.create_context() as ctx:
                     ctx.append(EntryType.INODE_DIRECTORY,
                                dir_inode.to_wire_dict())
                     ctx.append(EntryType.ADD_MOUNT_POINT, info.to_wire())
-            except Exception:
-                self._ufs.remove_mount(mount_id)
-                raise
-            # a new mount can reveal paths previously recorded absent
-            self._absent_cache.clear()
+                # a new mount can reveal paths previously recorded absent
+                self._absent_cache.clear()
+        except Exception:
+            self._ufs.remove_mount(mount_id)
+            raise
 
     def unmount(self, path: "str | AlluxioURI") -> None:
         uri = AlluxioURI(path)
@@ -1337,7 +1344,7 @@ class FileSystemMaster:
         status (e.g. from a directory listing) — skip the per-path probe."""
         try:
             resolution = self.mount_table.resolve(uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return False
         ufs = self._ufs.get(resolution.mount_id)
         if not status_known:
@@ -1376,7 +1383,7 @@ class FileSystemMaster:
         re-check known ones, drop persisted inodes the UFS lost."""
         try:
             resolution = self.mount_table.resolve(uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return False
         if not self._ufs.has(resolution.mount_id):
             return False
@@ -1433,7 +1440,7 @@ class FileSystemMaster:
             return None
         try:
             resolution = self.mount_table.resolve(uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return None
         if not self._ufs.has(resolution.mount_id):
             return None
@@ -1509,7 +1516,7 @@ class FileSystemMaster:
                     return
         try:
             resolution = self.mount_table.resolve(uri)
-        except Exception:  # noqa: BLE001
+        except (NotFoundError, InvalidPathError):
             return
         if not self._ufs.has(resolution.mount_id):
             return
@@ -1565,12 +1572,15 @@ class FileSystemMaster:
                     self.delete(uri, recursive=True, alluxio_only=not (
                         inode.persistence_state == PersistenceState.PERSISTED))
                 acted.append(uri.path)
-            except Exception:  # noqa: BLE001 - retried next tick
+            except Exception as e:  # noqa: BLE001 - retried next tick
+                LOG.warning("TTL action %s on %s failed (retrying next "
+                            "tick): %s", inode.ttl_action, uri, e)
                 continue
             self.inode_tree.ttl_buckets.remove(iid)
         return acted
 
 
+@register_wire_error
 class FailedToFreeNonPersistedError(InvalidArgumentError):
     pass
 

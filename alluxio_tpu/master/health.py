@@ -19,11 +19,14 @@ sampled by hand.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import statistics
 import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
 
 SEVERITIES = ("critical", "warning", "info")
 
@@ -446,6 +449,7 @@ def tenant_overload_rule(shed_counts_fn: Callable[[], Dict[str, int]],
         try:
             counts = shed_counts_fn()
         except Exception:  # noqa: BLE001 - never take the doctor down
+            LOG.debug("tenant-overload probe failed", exc_info=True)
             return []
         prev, at = state["prev"], state["at"]
         if at is not None and ctx.now - at < MIN_PROBE_WINDOW_S:
@@ -560,7 +564,8 @@ class HealthMonitor:
                 try:
                     expected = self._worker_sources_fn()
                 except Exception:  # noqa: BLE001 - never take the
-                    pass           # doctor down over a topology read
+                    # doctor down over a topology read
+                    LOG.debug("worker-topology read failed", exc_info=True)
             ctx = HealthContext(
                 getattr(self._mm, "history", None),
                 getattr(self._mm, "store", None), ts,
@@ -570,7 +575,10 @@ class HealthMonitor:
                     try:
                         violations = rule.probe(ctx)
                     except Exception:  # noqa: BLE001 - a broken rule
-                        continue      # must not take the doctor down
+                        # must not take the doctor down
+                        LOG.warning("health rule %s failed",
+                                    rule.name, exc_info=True)
+                        continue
                     self._apply(rule, violations, ts)
                 self._last_eval = ts
                 firing = [t.alert for t in self._tracked.values()
@@ -579,7 +587,9 @@ class HealthMonitor:
                 try:
                     listener(firing, ts)
                 except Exception:  # noqa: BLE001 - a broken actor must
-                    pass           # not take the doctor down either
+                    # not take the doctor down either
+                    LOG.warning("health alert listener failed",
+                                exc_info=True)
             return firing
 
     def _apply(self, rule: HealthRule,

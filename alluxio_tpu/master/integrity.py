@@ -25,6 +25,7 @@ from typing import List
 from alluxio_tpu.journal.format import EntryType
 from alluxio_tpu.master.inode import PersistenceState
 from alluxio_tpu.utils import ids
+from alluxio_tpu.utils.exceptions import NotFoundError
 from alluxio_tpu.utils.uri import AlluxioURI
 
 LOG = logging.getLogger(__name__)
@@ -164,8 +165,8 @@ class UfsCleaner:
         for mi in self._mounts.mount_points():
             try:
                 ufs = self._ufs.get(mi.mount_id)
-            except Exception:  # noqa: BLE001 unmounted mid-scan
-                continue
+            except NotFoundError:
+                continue  # unmounted mid-scan
             removed += self._sweep(ufs, mi.ufs_uri, now_ms, self._budget)
         return removed
 
@@ -178,6 +179,7 @@ class UfsCleaner:
             try:
                 entries = ufs.list_status(d) or []
             except Exception:  # noqa: BLE001 racing deletes
+                LOG.debug("UfsCleaner list of %s failed", d, exc_info=True)
                 continue
             for st in entries:
                 if seen >= budget:

@@ -302,5 +302,6 @@ class MetricsMaster:
     def merged_snapshot(self, own: Dict[str, float]) -> Dict[str, float]:
         merged = dict(own)
         merged.update(self.store.cluster_metrics())
-        merged["Cluster.metrics.sources"] = float(self.store.source_count())
+        # lint: allow[metric-unknown] -- synthetic aggregate minted at snapshot-merge time; no single emit site
+        merged["Cluster.MetricsSources"] = float(self.store.source_count())
         return merged

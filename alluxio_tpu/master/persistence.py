@@ -67,6 +67,8 @@ class PersistenceScheduler:
             try:
                 info = self._jobs.get_status(job_id)
             except Exception:  # noqa: BLE001 transient: retry next tick
+                LOG.debug("persist job %s status probe failed",
+                          job_id, exc_info=True)
                 continue
             if info.status == "COMPLETED":
                 del self._inflight[job_id]

@@ -85,7 +85,9 @@ class MasterProcess:
             default_block_size=conf.get_bytes(
                 Keys.USER_BLOCK_SIZE_BYTES_DEFAULT),
             permission_checker=checker,
-            umask=int(conf.get(Keys.SECURITY_AUTHORIZATION_PERMISSION_UMASK)))
+            umask=int(conf.get(Keys.SECURITY_AUTHORIZATION_PERMISSION_UMASK)),
+            ufs_path_cache_capacity=conf.get_int(
+                Keys.MASTER_UFS_PATH_CACHE_CAPACITY))
         from alluxio_tpu.master.path_properties import (
             ConfigurationChecker, PathProperties,
         )
@@ -560,7 +562,8 @@ class MasterProcess:
         t = HeartbeatThread(
             HC.MASTER_REPLICATION_CHECK, _Exec(checker.heartbeat),
             interval_s if interval_s is not None else
-            self._conf.get_duration_s(Keys.MASTER_REPLICATION_CHECK_INTERVAL))
+            self._conf.get_duration_s(
+                Keys.MASTER_REPLICATION_CHECK_INTERVAL))
         t.start()
         self._threads.append(t)
 
@@ -578,7 +581,8 @@ class MasterProcess:
         t = HeartbeatThread(
             HC.MASTER_PERSISTENCE_SCHEDULER, _Exec(scheduler.heartbeat),
             interval_s if interval_s is not None else
-            self._conf.get_duration_s(Keys.MASTER_REPLICATION_CHECK_INTERVAL))
+            self._conf.get_duration_s(
+                Keys.MASTER_PERSISTENCE_SCHEDULER_INTERVAL))
         t.start()
         self._threads.append(t)
         return scheduler

@@ -33,7 +33,9 @@ import time
 from typing import Dict, List, Set
 
 from alluxio_tpu.job.wire import Status
-from alluxio_tpu.utils.exceptions import NotFoundError
+from alluxio_tpu.utils.exceptions import (
+    BlockDoesNotExistError, NotFoundError,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -78,8 +80,8 @@ class ReplicationChecker:
                     continue
                 try:
                     info = self._bm.get_block_info(bid)
-                except Exception:  # noqa: BLE001 - block gone; skip
-                    continue
+                except (BlockDoesNotExistError, NotFoundError):
+                    continue  # block gone; skip
                 replicas = len(info.locations)
                 if rmin > 0 and replicas < rmin:
                     self._launch(bid, {"type": "replicate",
@@ -167,10 +169,12 @@ class ReplicationChecker:
                 # finished long ago — reap
                 done.add(bid)
                 continue
-            except Exception:  # noqa: BLE001 - transport blip: the job
-                # may well still be running; reaping now would drop the
-                # dedupe entry and double-launch on the next beat.
-                # Retry next heartbeat instead.
+            # transport blip: the job may well still be running; reaping
+            # now would drop the dedupe entry and double-launch on the
+            # next beat. Retried next heartbeat; launch failures are
+            # already WARN-logged rate-limited.
+            # lint: allow[except-swallow] -- deliberate silent retry: transport blip, job likely still running
+            except Exception:  # noqa: BLE001
                 continue
             if Status.is_finished(info.status):
                 done.add(bid)

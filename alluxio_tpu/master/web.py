@@ -28,7 +28,10 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from alluxio_tpu.conf import Keys
+
 LOG = logging.getLogger(__name__)
+
 
 def _dashboard_html() -> bytes:
     """Status page over the JSON routes (stand-in for the reference's
@@ -255,6 +258,7 @@ class MasterWebServer:
 
                     return {
                         "cluster_id": mp.cluster_id,
+                        "cluster_name": mp._conf.get(Keys.CLUSTER_NAME),
                         "start_time_ms": mp.start_time_ms,
                         "uptime_ms": max(0, int(_time.time() * 1000)
                                          - mp.start_time_ms),

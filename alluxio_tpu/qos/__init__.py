@@ -35,9 +35,12 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
 
 __all__ = [
     "ON_DEMAND", "ASYNC_FILL", "PREFETCH", "PRIORITY_NAMES",
@@ -313,7 +316,7 @@ class PriorityExecutor:
             try:
                 task.fn(*task.args)
             except BaseException:  # noqa: BLE001 - stripe loops own errors
-                pass
+                LOG.debug("priority-executor task raised", exc_info=True)
             finally:
                 with self._cond:
                     n = self._running.get(task.tenant, 0) - 1

@@ -22,7 +22,7 @@ from typing import Dict, Iterator, Tuple
 
 from alluxio_tpu.rpc.core import ServiceDefinition
 from alluxio_tpu.utils.exceptions import (
-    BlockDoesNotExistError, InvalidArgumentError,
+    BlockDoesNotExistError, InvalidArgumentError, best_effort,
 )
 from alluxio_tpu.worker.process import BlockWorker
 from alluxio_tpu.worker.ufs_io import UfsBlockDescriptor
@@ -218,10 +218,8 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
             worker.commit_block(session_id, block_id,
                                 pinned=header.get("pinned", False))
         except BaseException:
-            try:
-                worker.abort_block(session_id, block_id)
-            except Exception:  # noqa: BLE001
-                pass
+            best_effort("write abort", worker.abort_block,
+                        session_id, block_id)
             raise
         return {"length": length}
 

@@ -8,6 +8,7 @@ boundaries can round-trip typed errors.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 
@@ -155,5 +156,33 @@ _ALL = [v for v in list(globals().values())
 _BY_NAME = {c.__name__: c for c in _ALL}
 _BY_CODE = {c.code: c for c in reversed(_ALL)}
 
+
+def register_wire_error(cls: type) -> type:
+    """Register an :class:`AlluxioTpuError` subclass defined OUTSIDE this
+    module in the wire-serialization map, so :meth:`AlluxioTpuError.
+    from_wire` reconstructs the exact type instead of degrading to the
+    nearest base class (which silently breaks client-side
+    ``except SpecificError`` across RPC).  Usable as a decorator.
+    The ``wire-error-unregistered`` lint rule enforces this."""
+    _BY_NAME[cls.__name__] = cls
+    return cls
+
+
 #: Status codes that a retry policy should treat as transient.
 RETRYABLE_CODES = frozenset({"UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED"})
+
+
+def best_effort(what: str, fn, *args, log: Optional[logging.Logger] = None,
+                **kwargs):
+    """Run a cleanup/notification step that must never mask the primary
+    error path: failures are logged at DEBUG and swallowed.  Replaces
+    bare ``try: ... except Exception: pass`` blocks (which the
+    ``except-swallow`` lint rule rejects on server paths) with one
+    audited idiom."""
+    try:
+        return fn(*args, **kwargs)
+    except Exception:  # noqa: BLE001 - by contract: log and move on
+        (log or logging.getLogger(
+            getattr(fn, "__module__", None) or __name__)).debug(
+            "best-effort %s failed", what, exc_info=True)
+        return None

@@ -72,6 +72,7 @@ class AlignTask:
                     self._store.move_block(hot, upper.alias)
                     moved += 2
                 except Exception:  # noqa: BLE001 - busy blocks retry next tick
+                    LOG.debug("tier-align move skipped", exc_info=True)
                     continue
         return moved
 
@@ -102,7 +103,8 @@ class PromoteTask:
                 try:
                     self._store.move_block(hot, upper.alias)
                     moved += 1
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 - busy/full: retry next tick
+                    LOG.debug("tier-promote move skipped", exc_info=True)
                     break
         return moved
 

@@ -185,6 +185,9 @@ class StorageChecker(HeartbeatExecutor):
                         except Exception:  # noqa: BLE001
                             # busy/gone: still drop the record AND tell the
                             # master, or it keeps routing clients here
+                            LOG.debug("remove_block(%s) on failed dir "
+                                      "errored; dropping record", bid,
+                                      exc_info=True)
                             meta = d.remove_block(bid)
                             if meta is not None:
                                 d.release(meta.length)

@@ -14,6 +14,7 @@ without a copy (the short-circuit read path; reference:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -27,8 +28,10 @@ from alluxio_tpu.worker.meta import (
 )
 from alluxio_tpu.utils.exceptions import (
     AlreadyExistsError, BlockDoesNotExistError, InvalidArgumentError,
-    WorkerOutOfSpaceError,
+    WorkerOutOfSpaceError, best_effort,
 )
+
+LOG = logging.getLogger(__name__)
 
 
 class BlockWriter:
@@ -113,6 +116,8 @@ class CacheFill:
             self._writer.append(data)
             return True
         except Exception:  # noqa: BLE001 - cache fill is best-effort
+            LOG.debug("cache-fill append for block %s failed",
+                      self._block_id, exc_info=True)
             self.abort()
             return False
 
@@ -124,21 +129,18 @@ class CacheFill:
             self._writer = None
             self._store.commit_block(self._session, self._block_id)
             return True
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - cache fill is best-effort
+            LOG.debug("cache-fill commit for block %s failed",
+                      self._block_id, exc_info=True)
             self.abort()
             return False
 
     def abort(self) -> None:
         w, self._writer = self._writer, None
         if w is not None:
-            try:
-                w.close()
-            except Exception:  # noqa: BLE001
-                pass
-        try:
-            self._store.abort_block(self._session, self._block_id)
-        except Exception:  # noqa: BLE001
-            pass
+            best_effort("cache-fill writer close", w.close)
+        best_effort("cache-fill abort", self._store.abort_block,
+                    self._session, self._block_id)
 
 
 class TieredBlockStore:
@@ -175,10 +177,7 @@ class TieredBlockStore:
 
     def _emit(self, event: str, block_id: int) -> None:
         for fn in self._listeners:
-            try:
-                fn(event, block_id)
-            except Exception:  # noqa: BLE001
-                pass
+            best_effort("block-event listener", fn, event, block_id)
 
     # -- write path ---------------------------------------------------------
     def create_block(self, session_id: int, block_id: int, *,
@@ -281,10 +280,9 @@ class TieredBlockStore:
         for tier in self.meta.tiers:
             for d in tier.dirs:
                 for temp in d.temp_blocks_of_session(session_id):
-                    try:
-                        self.abort_block(session_id, temp.block_id)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    best_effort("session temp-block abort",
+                                self.abort_block, session_id,
+                                temp.block_id)
 
     # -- read path ----------------------------------------------------------
     def get_reader(self, block_id: int) -> BlockReader:
@@ -365,15 +363,10 @@ class TieredBlockStore:
         except AlreadyExistsError:
             return None
         except Exception:  # noqa: BLE001 - cache fill is best-effort
-            import logging
-
-            logging.getLogger(__name__).debug(
-                "cache fill for block %s failed to start", block_id,
-                exc_info=True)
-            try:
-                self.abort_block(session, block_id)
-            except Exception:  # noqa: BLE001
-                pass
+            LOG.debug("cache fill for block %s failed to start",
+                      block_id, exc_info=True)
+            best_effort("cache-fill abort", self.abort_block,
+                        session, block_id)
             return None
 
     # -- removal / movement -------------------------------------------------

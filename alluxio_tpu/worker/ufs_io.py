@@ -19,7 +19,7 @@ from typing import Callable, Dict, Optional
 
 from alluxio_tpu.underfs.base import UnderFileSystem
 from alluxio_tpu.utils import ids as id_utils
-from alluxio_tpu.utils.exceptions import AlreadyExistsError
+from alluxio_tpu.utils.exceptions import AlreadyExistsError, best_effort
 from alluxio_tpu.worker.tiered_store import TieredBlockStore
 
 LOG = logging.getLogger(__name__)
@@ -83,10 +83,10 @@ class UfsBlockReader:
             self._store.commit_block(session, block_id)
             return True
         except Exception:  # noqa: BLE001
-            try:
-                self._store.abort_block(session, block_id)
-            except Exception:  # noqa: BLE001
-                pass
+            LOG.debug("cache commit for block %s failed", block_id,
+                      exc_info=True)
+            best_effort("cache-fill abort", self._store.abort_block,
+                        session, block_id)
             return False
 
 

@@ -24,6 +24,11 @@ except ImportError:
 
 import pytest  # noqa: E402
 
+# Always-on lock-order auditing + hang watchdog (see
+# alluxio_tpu/lint/pytest_lockaudit.py): master/worker/store locks are
+# auto-instrumented and any observed lock-order inversion fails the test.
+pytest_plugins = ("alluxio_tpu.lint.pytest_lockaudit",)
+
 
 @pytest.fixture()
 def conf(tmp_path):

@@ -52,8 +52,8 @@ def test_write_waits_out_worker_lost_window(monkeypatch):
     bm = _FlappingBlockMaster(empty_calls=3)
     store = _make_store(bm, window_s=10.0)
     monkeypatch.setattr("alluxio_tpu.client.block_store.GrpcBlockOutStream",
-                        lambda client, session_id, block_id, tier, pinned:
-                        _StubWriter(client))
+                        lambda client, session_id, block_id, tier,
+                        pinned, **kw: _StubWriter(client))
     t0 = time.monotonic()
     writer = store.open_block_writer(7, size_hint=1 << 20)
     waited = time.monotonic() - t0
@@ -78,8 +78,8 @@ def test_failed_read_memory_does_not_affect_writes(monkeypatch):
     store = _make_store(bm, window_s=0.0)
     store.mark_failed(bm.worker.address)
     monkeypatch.setattr("alluxio_tpu.client.block_store.GrpcBlockOutStream",
-                        lambda client, session_id, block_id, tier, pinned:
-                        _StubWriter(client))
+                        lambda client, session_id, block_id, tier,
+                        pinned, **kw: _StubWriter(client))
     t0 = time.monotonic()
     writer = store.open_block_writer(7, size_hint=1 << 20)
     assert writer.address.host == "w1"

@@ -53,7 +53,7 @@ class TestMetricsStore:
         merged = m.merged_snapshot({"Master.Z": 1.0})
         assert merged["Master.Z"] == 1.0
         assert merged["Cluster.Y"] == 4.0
-        assert merged["Cluster.metrics.sources"] == 1.0
+        assert merged["Cluster.MetricsSources"] == 1.0
 
 
 @pytest.fixture()
@@ -76,8 +76,8 @@ class TestClusterAggregationEndToEnd:
         _MetricsReporter(w._meta_client, "worker-w0").heartbeat()
         snap = mc.get_metrics()
         cluster_keys = [k for k in snap if k.startswith("Cluster.")]
-        assert "Cluster.metrics.sources" in snap
-        assert snap["Cluster.metrics.sources"] >= 1.0
+        assert "Cluster.MetricsSources" in snap
+        assert snap["Cluster.MetricsSources"] >= 1.0
         assert len(cluster_keys) > 1
 
     def test_client_send_metrics(self, cluster):
@@ -85,7 +85,7 @@ class TestClusterAggregationEndToEnd:
         fs.write_all("/m.txt", b"x")
         fs.send_metrics()
         snap = cluster.meta_client().get_metrics()
-        assert snap["Cluster.metrics.sources"] >= 1.0
+        assert snap["Cluster.MetricsSources"] >= 1.0
 
 
 class TestAdminRpcAuthz:

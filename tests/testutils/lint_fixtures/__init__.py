@@ -1,0 +1,7 @@
+"""Seeded-violation fixture modules for atpu-lint's own tests.
+
+Each module plants an exact, counted set of violations (plus control
+sites that must NOT flag).  They are parsed by the analyzers, never
+imported, and live outside the lint walk roots so `make lint` on the
+shipped tree stays clean.
+"""

@@ -1,0 +1,12 @@
+"""Suppression WITHOUT a justification: must fail the build as
+``lint-bad-suppression`` rather than silently suppressing."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def naked_suppression():
+    with _lock:
+        time.sleep(0.1)  # lint: allow[lock-blocking-call]

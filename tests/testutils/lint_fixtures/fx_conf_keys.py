@@ -1,0 +1,16 @@
+"""conf-keys fixture: exactly ONE unknown-key finding.
+
+- UNKNOWN_KEY: near-miss of a real key -> conf-unknown-key
+- OK_KEY / OK_TEMPLATE / OK_SPANISH: resolve (registered key, template
+  instance, span name emitted by product code is NOT visible here, so
+  use a registered alias instead)
+- SUPPRESSED: unknown but inline-suppressed with a justification
+"""
+
+UNKNOWN_KEY = "atpu.master.rpcc.port"
+
+OK_KEY = "atpu.master.rpc.port"
+OK_ALIAS = "atpu.user.rpc.retry.duration"
+OK_TEMPLATE = "atpu.worker.tieredstore.level0.alias"
+
+SUPPRESSED = "atpu.totally.fake.key"  # lint: allow[conf-unknown-key] -- seeded fixture: suppression-path coverage

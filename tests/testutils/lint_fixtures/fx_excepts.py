@@ -1,0 +1,45 @@
+"""exception-discipline fixture: exactly ONE silent-except finding.
+
+Controls: a logging handler, a re-raising handler, a handler that
+routes the bound exception onward, and a suppressed swallow.
+"""
+
+import logging
+
+LOG = logging.getLogger(__name__)
+
+
+def bad_silent(fn):
+    try:
+        fn()
+    except Exception:  # finding 1: neither logs nor re-raises
+        return None
+
+
+def ok_logs(fn):
+    try:
+        fn()
+    except Exception:
+        LOG.warning("fn failed", exc_info=True)
+
+
+def ok_reraise(fn):
+    try:
+        fn()
+    except Exception:
+        raise
+
+
+def ok_routed(fn, sink):
+    try:
+        fn()
+    except Exception as e:
+        sink(e)
+
+
+def suppressed(fn):
+    try:
+        fn()
+    # lint: allow[except-swallow] -- seeded fixture: suppression-path coverage
+    except Exception:
+        return None

@@ -1,0 +1,49 @@
+"""lock-discipline fixture: exactly THREE blocking-under-lock findings.
+
+Controls: a bounded ``result(timeout=)``, a condition-variable ``wait``
+(releases its lock), work done after the region, and a suppressed sleep.
+"""
+
+import threading
+import time
+
+
+class Fixture:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)  # finding 1
+
+    def bad_rpc(self, channel):
+        with self._lock:
+            return channel.call("Svc", "method", {})  # finding 2
+
+    def bad_result(self, fut):
+        with self._lock:
+            return fut.result()  # finding 3
+
+    def ok_bounded_result(self, fut):
+        with self._lock:
+            return fut.result(timeout=1.0)
+
+    def ok_cond_wait(self):
+        with self._lock:
+            self._cond.wait()  # Condition.wait releases the lock
+
+    def ok_outside(self):
+        with self._lock:
+            x = 1
+        time.sleep(x * 0)
+
+    def ok_nested_def(self):
+        with self._lock:
+            def later():
+                time.sleep(0.1)  # runs outside the region
+            return later
+
+    def suppressed(self):
+        with self._lock:
+            time.sleep(0.01)  # lint: allow[lock-blocking-call] -- seeded fixture: suppression-path coverage

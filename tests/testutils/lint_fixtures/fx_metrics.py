@@ -1,0 +1,25 @@
+"""metric-names fixture: one typo (near-miss) + one unknown.
+
+The emit site defines the fixture-local registry; the consumers below
+miss it two different ways.
+"""
+
+
+def emit(m):
+    m.counter("Client.PrefetchFixtureHits").inc()
+    m.timer("Worker.FixtureReadTime").update(0.01)
+
+
+def consume_typo():
+    # edit distance 1 from the emitted name -> metric-typo
+    return "Client.PrefetchFixtureHitz"
+
+
+def consume_unknown():
+    # nowhere near anything emitted -> metric-unknown
+    return "Worker.CompletelyUnregisteredSeries"
+
+
+def consume_ok():
+    # derived timer percentile of an emitted name: resolves
+    return "Worker.FixtureReadTime.p99"

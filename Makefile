@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos sdist clean lint lint-changed lint-docs
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata sdist clean lint lint-changed lint-docs
 
 lint:  ## atpu-lint: conf-key/metric-name/lock/exception discipline (<30s budget)
 	$(PY) -m alluxio_tpu.lint --budget-s 30
@@ -48,6 +48,11 @@ bench-remote-read:  ## warm remote reads: striped vs single-stream GB/s + hedged
 
 bench-qos:  ## two-tenant QoS: victim read p99 under flood <=2x solo with QoS on + admission bounded-memory shedding
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress qos
+
+bench-metadata:  ## metadata control plane: striped-vs-single-lock >=3x, batched-journal CreateFile >=1.5x, cached GetStatus >=10x
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row striped
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row journal
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row cached
 
 sdist:
 	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist

@@ -10,7 +10,9 @@ config-hash live-reinit handshake (``FileSystemContextReinitializer.java:44``).
 from __future__ import annotations
 
 import socket
+import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from alluxio_tpu.client.block_store import BlockStoreClient
@@ -27,43 +29,115 @@ from alluxio_tpu.utils.wire import FileInfo, MountPointInfo, TieredIdentity
 
 
 class _MetadataCache:
-    """Path -> (FileInfo, expiry) cache
-    (reference: ``client/file/MetadataCache.java``)."""
+    """Bounded-LRU path -> FileInfo / listing cache with master-pushed
+    invalidation (reference: ``client/file/MetadataCache.java`` is
+    TTL-only; here every GetStatus/ListStatus response carries a
+    version stamp from the master's invalidation log and the metrics
+    heartbeat delivers invalidated path-prefixes, so a warm entry stays
+    coherent within one heartbeat interval — docs/metadata.md.  TTL
+    remains the belt-and-braces bound for partitioned clients).
+
+    Thread-safe: the heartbeat thread applies pushes while reader
+    threads hit the cache."""
+
+    #: listings live under ``path + _LIST`` so path-prefix invalidation
+    #: naturally covers them
+    _LIST = "\0list"
 
     def __init__(self, max_size: int, ttl_s: float) -> None:
         self._max = max_size
         self._ttl = ttl_s
-        self._entries: Dict[str, tuple] = {}
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+        #: highest master invalidation-log version applied here (None
+        #: until the first heartbeat establishes the floor)
+        self.applied_version: Optional[int] = None
 
+    # -- reads --------------------------------------------------------------
     def get(self, path: str) -> Optional[FileInfo]:
-        e = self._entries.get(path)
-        if e is None:
-            return None
-        info, expiry = e
-        if time.monotonic() > expiry:
-            del self._entries[path]
-            return None
-        return info
+        return self._get(path)
 
-    def put(self, path: str, info: FileInfo) -> None:
-        if len(self._entries) >= self._max:
-            self._entries.pop(next(iter(self._entries)))
-        self._entries[path] = (info, time.monotonic() + self._ttl)
+    def get_listing(self, path: str) -> Optional[List[FileInfo]]:
+        return self._get(path + self._LIST)
 
+    def _get(self, key: str):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            value, expiry, _stamp = e
+            if time.monotonic() > expiry:
+                del self._entries[key]
+                return None
+            self._entries.move_to_end(key)
+            return value
+
+    # -- writes -------------------------------------------------------------
+    def put(self, path: str, info: FileInfo,
+            stamp: Optional[int] = None) -> None:
+        self._put(path, info, stamp)
+
+    def put_listing(self, path: str, infos: List[FileInfo],
+                    stamp: Optional[int] = None) -> None:
+        self._put(path + self._LIST, infos, stamp)
+
+    def _put(self, key: str, value, stamp: Optional[int]) -> None:
+        with self._lock:
+            if stamp is not None and self.applied_version is not None \
+                    and stamp < self.applied_version:
+                # the response predates invalidations already applied
+                # here — caching it could retain a forever-stale entry
+                return
+            if key not in self._entries and \
+                    len(self._entries) >= self._max:
+                self._entries.popitem(last=False)
+            self._entries[key] = (value, time.monotonic() + self._ttl, stamp)
+            self._entries.move_to_end(key)
+
+    # -- invalidation -------------------------------------------------------
     def invalidate(self, path: str) -> None:
-        """Drop the path, its parent listing, and every cached descendant
-        (recursive delete / dir rename would otherwise leave live entries
-        for dead subtrees)."""
+        """Local write-through invalidation (this client's own mutation
+        — effective immediately, before any push): drop the path, its
+        parent's entry+listing, and every cached descendant."""
+        with self._lock:
+            self._invalidate_locked(path)
+
+    def _invalidate_locked(self, path: str) -> None:
         self._entries.pop(path, None)
+        self._entries.pop(path + self._LIST, None)
         prefix = path.rstrip("/") + "/"
         for p in [p for p in self._entries if p.startswith(prefix)]:
             self._entries.pop(p, None)
         parent = AlluxioURI(path).parent()
         if parent is not None:
             self._entries.pop(parent.path, None)
+            self._entries.pop(parent.path + self._LIST, None)
+
+    def apply_push(self, inv: dict) -> int:
+        """Apply a master invalidation batch
+        (``{"to": v, "prefixes": [...], "reset": bool}``) from the
+        metrics-heartbeat response; returns the number of prefixes
+        applied.  ``reset`` (first contact, or this client fell off the
+        master's bounded ring) drops everything."""
+        prefixes = inv.get("prefixes") or ()
+        with self._lock:
+            if inv.get("reset"):
+                self._entries.clear()
+            else:
+                for p in prefixes:
+                    self._invalidate_locked(p)
+            to = inv.get("to")
+            if to is not None:
+                self.applied_version = int(to)
+        return len(prefixes)
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
 
 class FileSystem:
@@ -145,7 +219,13 @@ class FileSystem:
         self._md_cache = _MetadataCache(
             md_cache_size,
             self._conf.get_duration_s(Keys.USER_METADATA_CACHE_EXPIRATION_TIME)
-        ) if md_cache_size > 0 else None
+        ) if md_cache_size > 0 and self._conf.get_bool(
+            Keys.USER_METADATA_CACHE_ENABLED) else None
+        from alluxio_tpu.metrics import metrics as _m
+
+        self._md_hits = _m().counter("Client.MetadataCacheHits")
+        self._md_misses = _m().counter("Client.MetadataCacheMisses")
+        self._md_inval = _m().counter("Client.MetadataCacheInvalidated")
         self._sync_interval_ms = int(1000 * self._conf.get_duration_s(
             Keys.USER_FILE_METADATA_SYNC_INTERVAL))
         self._page_cache = None
@@ -185,7 +265,14 @@ class FileSystem:
         spans = tracer().drain(500) if tracer().enabled else []
         resp = self.meta_master.metrics_heartbeat(
             f"client-{socket.gethostname()}-{id(self):x}",
-            metrics().snapshot(), spans=spans)
+            metrics().snapshot(), spans=spans,
+            md_cache_version=self._md_cache.applied_version
+            if self._md_cache is not None else None,
+            want_md_invalidations=self._md_cache is not None)
+        if self._md_cache is not None and isinstance(resp, dict) and \
+                isinstance(resp.get("md_invalidations"), dict):
+            self._md_inval.inc(
+                self._md_cache.apply_push(resp["md_invalidations"]))
         if self._conf_sync_interval_s > 0 and \
                 self._conf.get_bool(Keys.USER_CONF_CLUSTER_DEFAULT_ENABLED):
             now = time.monotonic()
@@ -261,14 +348,17 @@ class FileSystem:
     # ------------------------------------------------------------- metadata
     def get_status(self, path: "str | AlluxioURI") -> FileInfo:
         p = AlluxioURI(path).path
-        if self._md_cache is not None:
-            hit = self._md_cache.get(p)
-            if hit is not None:
-                return hit
-        info = self.fs_master.get_status(
-            p, sync_interval_ms=self._sync_interval_ms)
-        if self._md_cache is not None:
-            self._md_cache.put(p, info)
+        if self._md_cache is None:
+            return self.fs_master.get_status(
+                p, sync_interval_ms=self._sync_interval_ms)
+        hit = self._md_cache.get(p)
+        if hit is not None:
+            self._md_hits.inc()
+            return hit
+        self._md_misses.inc()
+        info, stamp = self.fs_master.get_status(
+            p, sync_interval_ms=self._sync_interval_ms, want_version=True)
+        self._md_cache.put(p, info, stamp)
         return info
 
     def exists(self, path: "str | AlluxioURI") -> bool:
@@ -276,9 +366,21 @@ class FileSystem:
 
     def list_status(self, path: "str | AlluxioURI",
                     recursive: bool = False) -> List[FileInfo]:
-        return self.fs_master.list_status(
-            AlluxioURI(path).path, recursive=recursive,
-            sync_interval_ms=self._sync_interval_ms)
+        p = AlluxioURI(path).path
+        if self._md_cache is None or recursive:
+            return self.fs_master.list_status(
+                p, recursive=recursive,
+                sync_interval_ms=self._sync_interval_ms)
+        hit = self._md_cache.get_listing(p)
+        if hit is not None:
+            self._md_hits.inc()
+            return list(hit)
+        self._md_misses.inc()
+        infos, stamp = self.fs_master.list_status(
+            p, recursive=False, sync_interval_ms=self._sync_interval_ms,
+            want_version=True)
+        self._md_cache.put_listing(p, infos, stamp)
+        return list(infos)
 
     def create_directory(self, path: "str | AlluxioURI", **opts) -> FileInfo:
         self._invalidate(path)

@@ -406,8 +406,12 @@ class Keys:
     MASTER_JOURNAL_FLUSH_BATCH_TIME = _k(
         "atpu.master.journal.flush.batch.time", KeyType.DURATION, default="5ms",
         scope=Scope.MASTER,
-        description="Batch window for group-commit journal flushes "
-                    "(reference: AsyncJournalWriter).")
+        description="Coalescing window of the dedicated journal flusher "
+                    "(group commit, reference: AsyncJournalWriter): the "
+                    "flusher accumulates up to this much arrival time "
+                    "into one file write + fsync; operations block only "
+                    "until their batch's fsync completes. 0 flushes "
+                    "every wakeup without coalescing.")
     MASTER_JOURNAL_CHECKPOINT_PERIOD_ENTRIES = _k(
         "atpu.master.journal.checkpoint.period.entries", KeyType.INT,
         default=2_000_000, scope=Scope.MASTER)
@@ -744,8 +748,20 @@ class Keys:
         description="Capacity of the HBM page-cache tier (pages as jax.Array). "
                     "0 disables the device tier. TPU-native addition; no "
                     "reference analogue.")
+    USER_METADATA_CACHE_ENABLED = _k(
+        "atpu.user.metadata.cache.enabled", KeyType.BOOL, default=False,
+        scope=Scope.CLIENT,
+        description="Cache GetStatus/ListStatus results client-side in a "
+                    "bounded LRU kept coherent by master-pushed "
+                    "invalidations on the metrics heartbeat (plus the "
+                    "expiration-time TTL as a fallback bound) — warm "
+                    "metadata reads become client-local. See "
+                    "docs/metadata.md.")
     USER_METADATA_CACHE_MAX_SIZE = _k("atpu.user.metadata.cache.max.size",
-                                      KeyType.INT, default=0, scope=Scope.CLIENT)
+                                      KeyType.INT, default=10_000,
+                                      scope=Scope.CLIENT,
+                                      description="Entry cap of the client "
+                                                  "metadata cache (LRU).")
     USER_METADATA_CACHE_EXPIRATION_TIME = _k(
         "atpu.user.metadata.cache.expiration.time", KeyType.DURATION, default="10min",
         scope=Scope.CLIENT)
@@ -896,6 +912,14 @@ class Keys:
         default="60s", scope=Scope.MASTER,
         description="Evidence window the input-stall rule averages "
                     "over.")
+    MASTER_HEALTH_METADATA_LOCK_WAIT_THRESHOLD = _k(
+        "atpu.master.health.metadata.lock.wait.threshold",
+        KeyType.DURATION, default="50ms", scope=Scope.MASTER,
+        description="metadata-lock-contention rule: fire when the "
+                    "master's inode-lock acquisition p99 "
+                    "(Master.MetadataInodeLockWaitTime.p99) stays above "
+                    "this over the stall window — sustained path-lock "
+                    "contention on the metadata control plane.")
     MASTER_HEALTH_FIRE_AFTER = _k(
         "atpu.master.health.fire.after", KeyType.DURATION, default="30s",
         scope=Scope.MASTER,

@@ -151,13 +151,22 @@ class NoopJournalSystem(JournalSystem):
             return JournalEntry(self._seq, entry_type, payload)
 
     def write_and_flush(self, entries: List[JournalEntry]) -> None:
-        for e in entries:
-            self._apply(e)
+        # serialize applies: with the striped inode tree, concurrent
+        # disjoint-subtree mutations reach here in parallel, and the
+        # Journaled components' registries assume one applier at a time
+        with self._lock:
+            for e in entries:
+                self._apply(e)
 
 
 class LocalJournalSystem(JournalSystem):
     """Durable single-writer journal over a directory (local disk or any
     mounted shared filesystem — the UFS-journal analogue)."""
+
+    #: bound on queued-but-unwritten entries in group-commit mode:
+    #: producers block (briefly — one flusher drain) at the cap, so a
+    #: flusher stall cannot grow the queue without bound
+    COMMIT_QUEUE_MAX_ENTRIES = 10_000
 
     def __init__(self, folder: str, *,
                  max_log_size: int = 64 << 20,
@@ -175,12 +184,32 @@ class LocalJournalSystem(JournalSystem):
         self._file_start_seq = 1
         self._lock = threading.RLock()
         self._closed = False
-        # group commit: one fsync covers every entry written before it
-        # (reference: AsyncJournalWriter's flush batching)
+        # Durability is tracked by WRITE TICKETS, not sequence numbers:
+        # a ticket is assigned under the main lock in the same critical
+        # section as the batch's acceptance, so "synced ticket >= mine"
+        # really means "my batch reached the disk".  (Sequence numbers
+        # cannot carry this: they are allocated before the write, so a
+        # batch written AFTER a covering fsync could carry a smaller
+        # seq and be acknowledged without ever being fsynced.)
+        self._write_ticket = 0    # batches accepted (inline: written)
+        self._synced_ticket = 0   # batches known fsync-durable
+        # inline group commit: one fsync covers every batch written
+        # before it (reference: AsyncJournalWriter's flush batching)
         self._flush_lock = threading.Lock()
-        self._written_seq = 0   # last seq written to the file buffer
-        self._durable_seq = 0   # last seq known fsync-durable
         self._deferred = threading.local()
+        # -- dedicated group-commit flusher (atpu.master.journal.flush.
+        # batch.time): entries are accepted + applied under the main
+        # lock, queued, and written+fsynced by ONE background flusher
+        # in timed batches; producers block only until their batch's
+        # fsync completes — the same acknowledged-durability point,
+        # off the callers' inode-lock critical sections.
+        self._commit_cond = threading.Condition(self._lock)
+        self._commit_queue: List[List[JournalEntry]] = []
+        self._commit_queue_entries = 0
+        self._batch_time_s = 0.0
+        self._flusher: "threading.Thread | None" = None
+        self._flusher_stop = False
+        self._flush_error: "BaseException | None" = None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -195,14 +224,117 @@ class LocalJournalSystem(JournalSystem):
             self._primary = True
 
     def lose_primacy(self) -> None:
+        self._stop_flusher()
         with self._lock:
             self._primary = False
             self._close_log()
 
     def stop(self) -> None:
+        self._stop_flusher()
         with self._lock:
             self._close_log()
             self._closed = True
+
+    # -- group-commit flusher ----------------------------------------------
+    def start_group_commit(self, batch_time_s: float = 0.005) -> None:
+        """Start the dedicated journal flusher
+        (``atpu.master.journal.flush.batch.time``): from here on,
+        ``write_and_flush`` queues entries instead of writing inline,
+        and the flusher coalesces up to ``batch_time_s`` of arrivals
+        into one file write + one fsync.  Idempotent."""
+        with self._lock:
+            if self._flusher is not None:
+                return
+            self._batch_time_s = max(0.0, float(batch_time_s))
+            self._flusher_stop = False
+            self._flusher = threading.Thread(
+                target=self._flusher_loop, name="journal-flusher",
+                daemon=True)
+            self._flusher.start()
+
+    def _stop_flusher(self) -> None:
+        with self._lock:
+            t = self._flusher
+            if t is None:
+                return
+            self._flusher_stop = True
+            self._commit_cond.notify_all()
+        t.join(timeout=30.0)
+        with self._lock:
+            self._flusher = None
+
+    def _flusher_loop(self) -> None:
+        from alluxio_tpu.metrics import metrics as _metrics
+
+        batch_timer = _metrics().timer("Master.MetadataJournalBatchSize")
+        flush_timer = _metrics().timer("Master.MetadataJournalFlushTime")
+        pressured = False  # queue was non-empty right after the last flush
+        while True:
+            with self._commit_cond:
+                while not self._commit_queue and not self._flusher_stop:
+                    self._commit_cond.wait(0.2)
+                if not self._commit_queue and self._flusher_stop:
+                    return
+            # Coalescing window (reference: AsyncJournalWriter waits up
+            # to the batch time for more entries) — applied ONLY under
+            # sustained pressure: a lone sequential writer flushes
+            # immediately (inline-class latency), while concurrent load
+            # — which refills the queue during the previous fsync —
+            # accumulates batch_time of arrivals into one fsync.
+            if pressured and self._batch_time_s > 0 and \
+                    not self._flusher_stop:
+                time.sleep(self._batch_time_s)
+            t0 = time.perf_counter()
+            fd = None
+            with self._commit_cond:
+                batches = self._commit_queue
+                self._commit_queue = []
+                n_entries = self._commit_queue_entries
+                self._commit_queue_entries = 0
+                ticket = self._write_ticket
+                try:
+                    if self._file is None:
+                        raise JournalClosedError(
+                            "journal log closed with entries queued")
+                    for batch in batches:
+                        for e in batch:
+                            self._file.write(e.encode())
+                    self._maybe_rotate()
+                    if self._seq - self._last_checkpoint_seq >= \
+                            self._checkpoint_period:
+                        self._checkpoint_locked()
+                    if self._file is not None:
+                        self._file.flush()
+                        fd = self._file.fileno()
+                except BaseException as e:  # noqa: BLE001 latch + surface
+                    self._flush_error = e
+                # free bounded-queue waiters
+                self._commit_cond.notify_all()
+            if fd is not None and self._flush_error is None:
+                try:
+                    self._fsync(fd)
+                except (OSError, ValueError) as e:
+                    # a concurrent rotation (checkpoint RPC) closes this
+                    # fd AFTER fsyncing it and marks the written tickets
+                    # synced — benign iff our ticket is already covered;
+                    # a real fsync failure is latched: an acknowledged-
+                    # durability journal must not limp on
+                    with self._commit_cond:
+                        if self._synced_ticket < ticket:
+                            self._flush_error = e
+            with self._commit_cond:
+                if self._flush_error is None and \
+                        ticket > self._synced_ticket:
+                    self._synced_ticket = ticket
+                pressured = bool(self._commit_queue)
+                self._commit_cond.notify_all()
+            batch_timer.update(float(n_entries))
+            flush_timer.update(time.perf_counter() - t0)
+
+    def _fsync(self, fd: int) -> None:
+        """The one fsync choke point (tests/benches override to model
+        slow devices or crash windows)."""
+        os.fsync(fd)
 
     def is_primary(self) -> bool:
         return self._primary
@@ -252,8 +384,13 @@ class LocalJournalSystem(JournalSystem):
         if self._file is None:
             return
         self._file.flush()
-        os.fsync(self._file.fileno())
-        self._durable_seq = max(self._durable_seq, self._written_seq)
+        self._fsync(self._file.fileno())
+        # every WRITTEN batch is in this file (or an earlier, already-
+        # fsynced one): rotation is a durability point.  Batches still
+        # in the commit queue (group-commit mode, one ticket each) are
+        # not written yet and must stay uncovered.
+        written = self._write_ticket - len(self._commit_queue)
+        self._synced_ticket = max(self._synced_ticket, written)
         self._file.close()
         self._file = None
         cur = os.path.join(self._log_dir, ACTIVE_LOG)
@@ -278,41 +415,61 @@ class LocalJournalSystem(JournalSystem):
             return JournalEntry(self._seq, entry_type, payload)
 
     def write_and_flush(self, entries: List[JournalEntry]) -> None:
-        """Write + apply this batch; make it durable before returning —
+        """Accept + apply this batch; make it durable before returning —
         either right here, or (inside a ``deferred_durability`` scope)
         once at scope exit so one fsync covers every context the RPC
         opened AND coalesces with other threads' flushes (group commit,
         reference ``AsyncJournalWriter``).
 
-        The write and the in-memory apply stay under the main lock (no
-        semantic change for state readers); only the fsync moves out.
-        An entry is applied before it is durable — same visibility
-        contract as the reference, which applies first and flushes
-        before the mutating RPC responds: no ACKNOWLEDGED mutation is
-        ever lost.
+        Inline mode writes the file under the main lock and fsyncs via
+        the flush convoy.  Group-commit mode (``start_group_commit``)
+        queues the batch for the dedicated flusher — the file write and
+        fsync both leave the caller's critical section, and the caller
+        blocks only until its batch's fsync completes.  Either way the
+        in-memory apply happens here, under the main lock, in
+        acceptance order — an entry is applied before it is durable:
+        the same visibility contract as the reference, which applies
+        first and flushes before the mutating RPC responds, so no
+        ACKNOWLEDGED mutation is ever lost.
         """
         if not entries:
             return
         with self._lock:
             if self._closed or self._file is None:
                 raise JournalClosedError("journal not open for writes")
-            for e in entries:
-                self._file.write(e.encode())
-            # monotonic: batches may write out of allocation order
-            # across threads; regressing this would make _ensure_durable
-            # under-record what an fsync covered (redundant fsyncs)
-            if entries[-1].sequence > self._written_seq:
-                self._written_seq = entries[-1].sequence
+            batched = self._flusher is not None
+            if batched:
+                if self._flush_error is not None:
+                    raise JournalClosedError(
+                        "journal flusher failed") from self._flush_error
+                while self._commit_queue_entries >= \
+                        self.COMMIT_QUEUE_MAX_ENTRIES:
+                    self._commit_cond.wait(0.5)
+                    if self._flush_error is not None:
+                        raise JournalClosedError(
+                            "journal flusher failed") from self._flush_error
+                    if self._closed or self._file is None:
+                        raise JournalClosedError("journal not open for writes")
+                self._commit_queue.append(list(entries))
+                self._commit_queue_entries += len(entries)
+            else:
+                for e in entries:
+                    self._file.write(e.encode())
+            self._write_ticket += 1
+            ticket = self._write_ticket
             for e in entries:
                 self._apply(e)
-            self._maybe_rotate()
-            if self._seq - self._last_checkpoint_seq >= self._checkpoint_period:
-                self._checkpoint_locked()
-        last = entries[-1].sequence
+            if batched:
+                self._commit_cond.notify_all()  # wake the flusher
+            else:
+                self._maybe_rotate()
+                if self._seq - self._last_checkpoint_seq >= \
+                        self._checkpoint_period:
+                    self._checkpoint_locked()
         if getattr(self._deferred, "on", False):
-            self._deferred.want = last
+            self._deferred.want = ticket
             return
-        self._ensure_durable(last)
+        self._ensure_durable(ticket)
 
     def deferred_durability(self):
         import contextlib
@@ -355,36 +512,62 @@ class LocalJournalSystem(JournalSystem):
 
         return scope()
 
-    def _ensure_durable(self, seq: int) -> None:
-        """Block until every entry up to ``seq`` is fsync-durable. One
-        flusher syncs for the whole convoy: waiters that arrive while an
-        fsync is in flight find their seq already covered and return
-        without issuing their own."""
-        if self._durable_seq >= seq:
+    def _ensure_durable(self, ticket: int) -> None:
+        """Block until the batch holding ``ticket`` is fsync-durable.
+
+        Group-commit mode: wait for the flusher to cover the ticket.
+        Inline mode: one flusher syncs for the whole convoy — waiters
+        that arrive while an fsync is in flight find their ticket
+        already covered and return without issuing their own.  Tickets
+        (assigned atomically with the write/acceptance) make coverage
+        exact: a batch accepted after an fsync began can never be
+        acknowledged by it."""
+        if self._synced_ticket >= ticket:  # racy fast path: monotonic
+            return
+        if self._flusher is not None:
+            with self._commit_cond:
+                while self._synced_ticket < ticket:
+                    if self._flush_error is not None:
+                        raise JournalClosedError(
+                            "journal flusher failed") from self._flush_error
+                    if self._flusher is None or self._closed:
+                        # stop() drains before closing; anything still
+                        # uncovered here was never made durable
+                        raise JournalClosedError("journal closed before "
+                                                 "flush completed")
+                    self._commit_cond.wait(0.5)
             return
         with self._flush_lock:
             with self._lock:
-                if self._durable_seq >= seq:
+                if self._synced_ticket >= ticket:
                     return
                 f = self._file
                 if f is None:
                     # rotation/close fsyncs everything it closes
                     return
                 f.flush()
-                target = self._written_seq
+                # tickets still sitting in the commit queue (one per
+                # batch) are NOT in this file: an fsync here must never
+                # cover them.  A caller whose own batch is among them
+                # (flusher-shutdown race) must fail, not false-ack.
+                target = self._write_ticket - len(self._commit_queue)
+                if target < ticket:
+                    raise JournalClosedError(
+                        "journal flusher stopped with this batch "
+                        "unwritten")
                 fd = f.fileno()
             try:
-                os.fsync(fd)
+                self._fsync(fd)
             except (OSError, ValueError):
                 # the log rotated under us and closed this fd — rotation
                 # fsyncs before closing, so our entries are durable
                 with self._lock:
-                    if self._durable_seq >= seq:
+                    if self._synced_ticket >= ticket:
                         return
                     raise
             with self._lock:
-                if target > self._durable_seq:
-                    self._durable_seq = target
+                if target > self._synced_ticket:
+                    self._synced_ticket = target
 
     # -- checkpoint ---------------------------------------------------------
     def checkpoint(self) -> None:

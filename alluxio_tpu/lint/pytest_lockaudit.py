@@ -40,6 +40,14 @@ _WATCHDOG_S = float(os.environ.get("ATPU_LOCK_AUDIT_WATCHDOG_S", "240"))
 _INSTRUMENT: Tuple[Tuple[str, str, str, str], ...] = (
     ("alluxio_tpu.master.inode_tree", "InodeTree", "lock",
      "InodeTree.lock"),
+    ("alluxio_tpu.master.inode_tree", "InodeTree", "registry_lock",
+     "InodeTree.registry_lock"),
+    # the journal's main lock doubles as the group-commit queue lock
+    # (write_and_flush enqueues + applies under it; the flusher drains
+    # under it) — auditing it proves the canonical order
+    # inode locks -> journal commit lock holds across every test
+    ("alluxio_tpu.journal.system", "LocalJournalSystem", "_lock",
+     "LocalJournalSystem._lock"),
     ("alluxio_tpu.master.block_master", "BlockMaster", "_lock",
      "BlockMaster._lock"),
     ("alluxio_tpu.master.block_master", "BlockMaster", "_reserve_lock",
@@ -126,6 +134,27 @@ def _install() -> None:
                                 _LockProxy(inner, lock_name, _DELEGATE))
 
             cls.__init__ = init
+
+        # Per-inode striped locks are created DYNAMICALLY by the
+        # InodeLockManager pool, so attribute patching cannot reach
+        # them; instead the manager's proxy-factory hook wraps every
+        # fresh RWLock.  All of them audit under ONE name — the
+        # root→leaf ordering *within* a path is structural (validated
+        # by the concurrent-metadata property tests), while this name
+        # puts the whole stripe set into the cross-plane order graph:
+        # InodeTree.lock -> InodeTree.inode_lock ->
+        # LocalJournalSystem._lock -> BlockMaster._lock.
+        from alluxio_tpu.master.inode_tree import InodeLockManager
+
+        mgr_init = InodeLockManager.__init__
+
+        @functools.wraps(mgr_init)
+        def lock_mgr_init(self, *a, **kw):
+            mgr_init(self, *a, **kw)
+            self._proxy_factory = lambda lock: _LockProxy(
+                lock, "InodeTree.inode_lock", _DELEGATE)
+
+        InodeLockManager.__init__ = lock_mgr_init
         _installed = True
 
 

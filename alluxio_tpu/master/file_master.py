@@ -8,9 +8,15 @@ getNewBlockIdForFile ``:1538``, delete ``:1621``, rename ``:2174``, mount
 ``:3209``) composed with the journaled ``InodeTree``, ``MountTable`` and
 ``BlockMaster``.
 
-Concurrency: validation + journal emission happen under the tree write lock
-(single-writer); reads take the tree read lock. Journal application is the
-only state mutator (see ``inode_tree.py`` rationale).
+Concurrency: hot metadata operations hold the tree lock in READ mode plus
+a per-inode lock list along their path (``InodeTree.lock_path`` — read
+locks on ancestors, write lock on the terminal), so independent subtrees
+no longer serialize; heavyweight multi-phase operations (mount/unmount,
+UFS metadata load, commit_persist) still take the tree-level WRITE lock,
+which excludes all path-locked operations.  Journal application is the
+only state mutator (see ``inode_tree.py`` rationale), and every mutation
+appends the affected path to the :class:`MetadataInvalidationLog` that
+keeps client metadata caches coherent (docs/metadata.md).
 """
 
 from __future__ import annotations
@@ -72,7 +78,8 @@ class FileSystemMaster:
                  default_block_size: int = 64 << 20,
                  permission_checker=None,
                  umask: int = 0o022,
-                 ufs_path_cache_capacity: int = 10_000) -> None:
+                 ufs_path_cache_capacity: int = 10_000,
+                 coarse_locking: bool = False) -> None:
         self._block_master = block_master
         self._journal = journal
         self._ufs = ufs_manager or UfsManager()
@@ -87,8 +94,15 @@ class FileSystemMaster:
             permission_checker = PermissionChecker(superuser=get_os_user())
         self._perm = permission_checker
         self._umask = umask
-        self.inode_tree = InodeTree(inode_store)
+        self.inode_tree = InodeTree(inode_store,
+                                    coarse_locking=coarse_locking)
         self.mount_table = MountTable()
+        from alluxio_tpu.master.invalidation import MetadataInvalidationLog
+
+        #: versioned push-invalidation log for client metadata caches;
+        #: GetStatus/ListStatus stamps and the metrics-heartbeat
+        #: piggyback both read it (docs/metadata.md)
+        self.invalidations = MetadataInvalidationLog()
         journal.register(self.inode_tree)
         journal.register(_MountTableJournal(self.mount_table))
         #: paths with in-flight async persist (file id -> alluxio path)
@@ -211,8 +225,8 @@ class FileSystemMaster:
                    sync_interval_ms: int = -1) -> FileInfo:
         uri = AlluxioURI(path)
         self._maybe_sync(uri, sync_interval_ms)
-        with self.inode_tree.lock.read_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri) as lip:
+            lookup = lip.lookup
             # POSIX stat semantics: EXECUTE on every ancestor (no READ on
             # the target itself) — without this, stat leaks metadata of
             # paths under 0700 directories
@@ -288,8 +302,8 @@ class FileSystemMaster:
                         queue.append(child)
         info = self._file_info_dict if wire else self._file_info
         out: List[FileInfo] = []
-        with self.inode_tree.lock.read_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri) as lip:
+            lookup = lip.lookup
             if not lookup.exists:
                 raise FileDoesNotExistError(f"path {uri} does not exist")
             from alluxio_tpu.security.authorization import READ
@@ -297,9 +311,13 @@ class FileSystemMaster:
             self._check_access(lookup, READ)
             if wire and not recursive:
                 # per-caller access check done above; the emitted child
-                # entries themselves are caller-independent
+                # entries themselves are caller-independent.  The cache
+                # stamp is the namespace-wide change_version: with
+                # striped locking the tree lock's own version no longer
+                # sees path-locked mutations, but every mutation still
+                # bumps change_version at journal-apply time.
                 dir_id = lookup.inode.id
-                tree_ver = self.inode_tree.lock.version
+                tree_ver = self.inode_tree.change_version
                 loc_ver = self._block_master.location_version
                 hit = self._listing_cache.get(dir_id)
                 if hit is not None and hit[0] == tree_ver and \
@@ -339,9 +357,11 @@ class FileSystemMaster:
 
             emit(lookup.inode, uri)
             if wire and not recursive and \
+                    self.inode_tree.change_version == tree_ver and \
                     self._block_master.location_version == loc_ver:
-                # tree_ver is stable while we hold the read lock; only a
-                # concurrent location change can invalidate mid-emit
+                # a mutation anywhere (version moved) or a location
+                # change mid-emit makes this listing uncacheable —
+                # serve it, but don't memoize a potentially torn view
                 cols = _transpose(out) if columnar else None
                 with self._listing_cache_lock:
                     # multiple listing threads share the tree READ lock;
@@ -357,8 +377,8 @@ class FileSystemMaster:
 
     def get_file_block_info_list(self, path: "str | AlluxioURI") -> List[FileBlockInfo]:
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.read_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri) as lip:
+            lookup = lip.lookup
             inode = lookup.inode
             from alluxio_tpu.security.authorization import READ
 
@@ -465,11 +485,18 @@ class FileSystemMaster:
             raise InvalidPathError("cannot create root")
         self._check_reserved_name(uri)
         block_size = block_size_bytes or self._default_block_size
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
+        # overwrite also write-locks the PARENT: the replace must stay
+        # atomic across the inner delete (which unlinks the terminal
+        # whose lock would otherwise be our only exclusion)
+        with self.inode_tree.lock_path(uri, write=True,
+                                       write_parent=overwrite) as lip:
+            lookup = lip.lookup
             if lookup.exists and overwrite and not \
                     lookup.inode.is_directory:
-                self.delete(uri)  # reentrant write lock: atomic replace
+                # atomic replace under the HELD parent+terminal write
+                # locks (no nested lock_path — the canonical order
+                # audit would flag re-entering the tree lock)
+                self._delete_locked(uri, lookup)
                 lookup = self.inode_tree.lookup(uri)
             if lookup.exists:
                 raise FileAlreadyExistsError(f"{uri} already exists")
@@ -504,6 +531,7 @@ class FileSystemMaster:
                 self._inherit_default_acl(prev, inode)
                 ctx.append(EntryType.INODE_FILE, inode.to_wire_dict())
             self._absent_cache.remove(uri.path)
+            self.invalidations.append(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def create_directory(self, path: "str | AlluxioURI", *,
@@ -515,8 +543,8 @@ class FileSystemMaster:
         if uri.is_root():
             raise InvalidPathError("cannot create root")
         self._check_reserved_name(uri)
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            lookup = lip.lookup
             if lookup.exists:
                 if allow_exists and lookup.inode.is_directory:
                     return self._file_info(lookup.inode, uri)
@@ -547,6 +575,7 @@ class FileSystemMaster:
                 self._inherit_default_acl(prev, inode)
                 ctx.append(EntryType.INODE_DIRECTORY, inode.to_wire_dict())
             self._absent_cache.remove(uri.path)
+            self.invalidations.append(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def _prepare_parents(self, lookup: PathLookup,
@@ -574,11 +603,11 @@ class FileSystemMaster:
     def get_new_block_id_for_file(self, path: "str | AlluxioURI") -> int:
         """Reference: ``getNewBlockIdForFile:1538``."""
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.write_locked():
+        with self.inode_tree.lock_path(uri, write=True) as lip:
             from alluxio_tpu.security.authorization import WRITE
 
-            self._check_access(self.inode_tree.lookup(uri), WRITE)
-            inode = self._existing_file(uri)
+            self._check_access(lip.lookup, WRITE)
+            inode = self._existing_inode(lip.lookup, uri)
             if inode.completed:
                 raise FileAlreadyCompletedError(f"{uri} is completed")
             bid = inode.next_block_id()
@@ -590,39 +619,68 @@ class FileSystemMaster:
     def complete_file(self, path: "str | AlluxioURI", *,
                       length: Optional[int] = None,
                       ufs_fingerprint: str = "") -> None:
-        """Reference: ``completeFile:1295``."""
-        uri = AlluxioURI(path)
-        with self.inode_tree.lock.write_locked():
-            from alluxio_tpu.security.authorization import WRITE
+        """Reference: ``completeFile:1295``.
 
-            self._check_access(self.inode_tree.lookup(uri), WRITE)
-            inode = self._existing_file(uri)
-            if inode.completed:
-                raise FileAlreadyCompletedError(f"{uri} already completed")
-            if length is None:
-                infos = self._block_master.get_block_infos(inode.block_ids)
-                length = sum(b.length for b in infos)
-            now = self._now()
-            anc = self._unpersisted_chain(
-                self.inode_tree.parent_of(inode), uri) \
-                if ufs_fingerprint else []
-            if anc:
-                # breadcrumbs BEFORE the durable flip: a crash after the
-                # journal fsync must not leave PERSISTED dirs that exist
-                # only as implicit object prefixes (steady state skips
-                # the UFS round-trip entirely)
-                self._ensure_ufs_parent_dirs(uri)
-            with self._journal.create_context() as ctx:
-                ctx.append(EntryType.COMPLETE_FILE, {
-                    "file_id": inode.id, "length": length, "op_time_ms": now})
-                if ufs_fingerprint:
-                    self._journal_persisted(ctx, inode, ufs_fingerprint,
-                                            ancestors=anc)
-            if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
-                self._persist_requests.add(inode.id)
+        Striped fast path: the terminal's write lock suffices while the
+        parent chain is already PERSISTED (steady state).  When a
+        fingerprinted complete must also flip unpersisted ANCESTOR
+        directories — inodes this path list only read-holds — it falls
+        back to the exclusive tree lock (rare: first persist under a
+        fresh directory).  Phase 2 re-derives EVERYTHING — access check,
+        target inode, length, ancestor chain — because nothing captured
+        under the released phase-1 locks is trustworthy (the same rule
+        ``mark_persisted``/``rename`` follow for their fallbacks)."""
+        uri = AlluxioURI(path)
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            if self._complete_locked(uri, lip.lookup, length,
+                                     ufs_fingerprint, anc_held=False):
+                return
+        with self.inode_tree.lock.write_locked():
+            self._complete_locked(uri, self.inode_tree.lookup(uri),
+                                  length, ufs_fingerprint, anc_held=True)
+
+    def _complete_locked(self, uri: AlluxioURI, lookup: PathLookup,
+                         length: "Optional[int]", ufs_fingerprint: str, *,
+                         anc_held: bool) -> bool:
+        """Validate + journal a complete under the caller's locks;
+        ``anc_held=False`` returns False — nothing journaled — when
+        unpersisted ancestors must flip (only the exclusive tree lock
+        covers those)."""
+        from alluxio_tpu.security.authorization import WRITE
+
+        self._check_access(lookup, WRITE)
+        inode = self._existing_inode(lookup, uri)
+        if inode.completed:
+            raise FileAlreadyCompletedError(f"{uri} already completed")
+        if length is None:
+            infos = self._block_master.get_block_infos(inode.block_ids)
+            length = sum(b.length for b in infos)
+        anc = self._unpersisted_chain(
+            self.inode_tree.parent_of(inode), uri) if ufs_fingerprint else []
+        if not anc_held and anc:
+            return False  # caller retries under the exclusive tree lock
+        if anc:
+            # breadcrumbs BEFORE the durable flip: a crash after the
+            # journal fsync must not leave PERSISTED dirs that exist
+            # only as implicit object prefixes
+            self._ensure_ufs_parent_dirs(uri)
+        with self._journal.create_context() as ctx:
+            ctx.append(EntryType.COMPLETE_FILE, {
+                "file_id": inode.id, "length": length,
+                "op_time_ms": self._now()})
+            if ufs_fingerprint:
+                self._journal_persisted(ctx, inode, ufs_fingerprint,
+                                        ancestors=anc)
+        if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
+            self._persist_requests.add(inode.id)
+        self.invalidations.append(uri.path)
+        return True
 
     def _existing_file(self, uri: AlluxioURI) -> Inode:
-        lookup = self.inode_tree.lookup(uri)
+        return self._existing_inode(self.inode_tree.lookup(uri), uri)
+
+    @staticmethod
+    def _existing_inode(lookup: PathLookup, uri: AlluxioURI) -> Inode:
         inode = lookup.inode
         if inode.is_directory:
             raise InvalidPathError(f"{uri} is a directory")
@@ -636,44 +694,53 @@ class FileSystemMaster:
         uri = AlluxioURI(path)
         if uri.is_root():
             raise InvalidPathError("cannot delete root")
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
-            inode = lookup.inode
-            self._check_delete(lookup)
-            if self.mount_table.is_mount_point(uri):
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            self._delete_locked(uri, lip.lookup, recursive=recursive,
+                                alluxio_only=alluxio_only)
+
+    def _delete_locked(self, uri: AlluxioURI, lookup: PathLookup, *,
+                       recursive: bool = False,
+                       alluxio_only: bool = False) -> None:
+        """Delete under the caller's locks (terminal write-held):
+        ``delete`` proper and ``create_file(overwrite=True)``'s atomic
+        replace both land here."""
+        inode = lookup.inode
+        self._check_delete(lookup)
+        if self.mount_table.is_mount_point(uri):
+            raise InvalidPathError(
+                f"{uri} is a mount point; unmount it instead")
+        victims: List[Inode] = []
+        if inode.is_directory:
+            kids = self.inode_tree.child_names(inode)
+            if kids and not recursive:
+                raise DirectoryNotEmptyError(
+                    f"{uri} is non-empty; need recursive")
+            if self.mount_table.contains_mount_below(uri):
                 raise InvalidPathError(
-                    f"{uri} is a mount point; unmount it instead")
-            victims: List[Inode] = []
-            if inode.is_directory:
-                kids = self.inode_tree.child_names(inode)
-                if kids and not recursive:
-                    raise DirectoryNotEmptyError(
-                        f"{uri} is non-empty; need recursive")
-                if self.mount_table.contains_mount_below(uri):
-                    raise InvalidPathError(
-                        f"{uri} contains nested mount points")
-                victims.extend(self.inode_tree.descendants(inode))
-            victims.append(inode)
-            block_ids: List[int] = []
-            persisted_paths: List[Inode] = []
+                    f"{uri} contains nested mount points")
+            victims.extend(self.inode_tree.descendants(inode))
+        victims.append(inode)
+        block_ids: List[int] = []
+        persisted_paths: List[Inode] = []
+        for v in victims:
+            block_ids.extend(v.block_ids)
+            if v.persistence_state == PersistenceState.PERSISTED:
+                persisted_paths.append(v)
+        if not alluxio_only and persisted_paths:
+            # fail fast BEFORE journaling: a read-only mount must leave
+            # both Alluxio and UFS state untouched
+            self._check_ufs_writable(uri)
+        now = self._now()
+        with self._journal.create_context() as ctx:
             for v in victims:
-                block_ids.extend(v.block_ids)
-                if v.persistence_state == PersistenceState.PERSISTED:
-                    persisted_paths.append(v)
-            if not alluxio_only and persisted_paths:
-                # fail fast BEFORE journaling: a read-only mount must leave
-                # both Alluxio and UFS state untouched
-                self._check_ufs_writable(uri)
-            now = self._now()
-            with self._journal.create_context() as ctx:
-                for v in victims:
-                    ctx.append(EntryType.DELETE_FILE,
-                               {"id": v.id, "op_time_ms": now})
-            if block_ids:
-                self._block_master.remove_blocks(block_ids,
-                                                 delete_metadata=True)
-            if not alluxio_only and persisted_paths:
-                self._delete_in_ufs(uri, persisted_paths)
+                ctx.append(EntryType.DELETE_FILE,
+                           {"id": v.id, "op_time_ms": now})
+        self.invalidations.append(uri.path)
+        if block_ids:
+            self._block_master.remove_blocks(block_ids,
+                                             delete_metadata=True)
+        if not alluxio_only and persisted_paths:
+            self._delete_in_ufs(uri, persisted_paths)
 
     def _check_reserved_name(self, uri: AlluxioURI) -> None:
         """Framework temp prefixes are reserved: a user file named like
@@ -709,57 +776,85 @@ class FileSystemMaster:
 
     # --------------------------------------------------------------- rename
     def rename(self, src: "str | AlluxioURI", dst: "str | AlluxioURI") -> None:
-        """Reference: ``rename:2174``."""
+        """Reference: ``rename:2174``.
+
+        Striped fast path: two per-inode lock lists acquired in
+        lexicographic path order (see ``InodeTree.lock_path_pair``) —
+        write on the src terminal, write on dst's deepest existing inode
+        (the parent gaining the edge).  When the rename must also flip
+        unpersisted ancestors ABOVE dst's parent to PERSISTED (inodes
+        the lists only read-hold), it falls back to the exclusive tree
+        lock — rare: persisted file renamed under a fresh dir chain."""
         src_uri, dst_uri = AlluxioURI(src), AlluxioURI(dst)
         if src_uri.is_root() or dst_uri.is_root():
             raise InvalidPathError("cannot rename to/from root")
         if src_uri.is_ancestor_of(dst_uri):
             raise InvalidPathError(f"cannot rename {src_uri} under itself")
         self._check_reserved_name(dst_uri)
+        with self.inode_tree.lock_path_pair(src_uri, dst_uri) as (
+                src_lip, dst_lip):
+            if self._rename_locked(src_uri, dst_uri, src_lip.lookup,
+                                   dst_lip.lookup, anc_held=False):
+                return
         with self.inode_tree.lock.write_locked():
-            src_lookup = self.inode_tree.lookup(src_uri)
-            inode = src_lookup.inode
-            self._check_delete(src_lookup)
-            if self.mount_table.is_mount_point(src_uri):
-                raise InvalidPathError(f"{src_uri} is a mount point")
-            # cross-mount renames are unsupported (reference behavior)
-            src_mp = self.mount_table.get_mount_point(src_uri)
-            dst_mp = self.mount_table.get_mount_point(dst_uri)
-            if src_mp != dst_mp:
-                raise InvalidPathError("rename across mount points")
-            dst_lookup = self.inode_tree.lookup(dst_uri)
-            if dst_lookup.exists:
-                raise FileAlreadyExistsError(f"{dst_uri} already exists")
-            self._check_parent_write(dst_lookup)
-            if len(dst_lookup.missing_components) > 1:
-                raise FileDoesNotExistError(
-                    f"parent of {dst_uri} does not exist")
-            new_parent = dst_lookup.deepest
-            if not new_parent.is_directory:
-                raise InvalidPathError(f"parent of {dst_uri} is a file")
-            now = self._now()
-            persisted = inode.persistence_state == PersistenceState.PERSISTED
-            if persisted:
-                self._check_ufs_writable(src_uri)
-            dst_anc = self._unpersisted_chain(new_parent, dst_uri) \
-                if persisted else []
-            if dst_anc:
-                # the UFS rename will implicitly create dst's parent
-                # chain; those inodes flip PERSISTED in the SAME journal
-                # context as the RENAME (a second context would leave a
-                # crash window replaying the rename with NOT_PERSISTED
-                # dst parents — re-opening the ghost-tree bug), and
-                # breadcrumbs land first
-                self._ensure_ufs_parent_dirs(dst_uri)
-            with self._journal.create_context() as ctx:
-                ctx.append(EntryType.RENAME, {
-                    "id": inode.id, "new_parent_id": new_parent.id,
-                    "new_name": dst_uri.name, "op_time_ms": now})
-                for cur in dst_anc:
-                    ctx.append(EntryType.PERSIST_FILE, {"id": cur.id})
-            if persisted:
-                self._rename_in_ufs(src_uri, dst_uri, inode.is_directory)
-            self._absent_cache.remove(dst_uri.path)
+            self._rename_locked(src_uri, dst_uri,
+                                self.inode_tree.lookup(src_uri),
+                                self.inode_tree.lookup(dst_uri),
+                                anc_held=True)
+
+    def _rename_locked(self, src_uri: AlluxioURI, dst_uri: AlluxioURI,
+                       src_lookup: PathLookup, dst_lookup: PathLookup, *,
+                       anc_held: bool) -> bool:
+        """Validate + journal a rename under the caller's locks.
+        ``anc_held=False`` (striped): returns False — nothing journaled
+        — when the op needs PERSISTED flips above dst's parent, which
+        only the exclusive tree lock covers."""
+        inode = src_lookup.inode
+        self._check_delete(src_lookup)
+        if self.mount_table.is_mount_point(src_uri):
+            raise InvalidPathError(f"{src_uri} is a mount point")
+        # cross-mount renames are unsupported (reference behavior)
+        src_mp = self.mount_table.get_mount_point(src_uri)
+        dst_mp = self.mount_table.get_mount_point(dst_uri)
+        if src_mp != dst_mp:
+            raise InvalidPathError("rename across mount points")
+        if dst_lookup.exists:
+            raise FileAlreadyExistsError(f"{dst_uri} already exists")
+        self._check_parent_write(dst_lookup)
+        if len(dst_lookup.missing_components) > 1:
+            raise FileDoesNotExistError(
+                f"parent of {dst_uri} does not exist")
+        new_parent = dst_lookup.deepest
+        if not new_parent.is_directory:
+            raise InvalidPathError(f"parent of {dst_uri} is a file")
+        now = self._now()
+        persisted = inode.persistence_state == PersistenceState.PERSISTED
+        if persisted:
+            self._check_ufs_writable(src_uri)
+        dst_anc = self._unpersisted_chain(new_parent, dst_uri) \
+            if persisted else []
+        if not anc_held and any(a.id != new_parent.id for a in dst_anc):
+            return False  # caller retries under the exclusive tree lock
+        if dst_anc:
+            # the UFS rename will implicitly create dst's parent
+            # chain; those inodes flip PERSISTED in the SAME journal
+            # context as the RENAME (a second context would leave a
+            # crash window replaying the rename with NOT_PERSISTED
+            # dst parents — re-opening the ghost-tree bug), and
+            # breadcrumbs land first
+            self._ensure_ufs_parent_dirs(dst_uri)
+        with self._journal.create_context() as ctx:
+            ctx.append(EntryType.RENAME, {
+                "id": inode.id, "new_parent_id": new_parent.id,
+                "new_name": dst_uri.name, "op_time_ms": now})
+            for cur in dst_anc:
+                ctx.append(EntryType.PERSIST_FILE, {"id": cur.id})
+        self.invalidations.append(src_uri.path)
+        self.invalidations.append(dst_uri.path)
+        if persisted:
+            self._rename_in_ufs(src_uri, dst_uri, inode.is_directory)
+        self._absent_cache.remove(dst_uri.path)
+        return True
 
     def _rename_in_ufs(self, src_uri: AlluxioURI, dst_uri: AlluxioURI,
                        is_dir: bool) -> None:
@@ -780,8 +875,8 @@ class FileSystemMaster:
         """Evict cached replicas; keep metadata + UFS copy
         (reference: ``free:2503``). Returns freed block ids."""
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            lookup = lip.lookup
             inode = lookup.inode
             from alluxio_tpu.security.authorization import WRITE
 
@@ -811,6 +906,7 @@ class FileSystemMaster:
                         if not t.is_directory and t.pinned:
                             ctx.append(EntryType.SET_ATTRIBUTE,
                                        {"id": t.id, "pinned": False})
+            self.invalidations.append(uri.path)
         if block_ids:
             self._block_master.remove_blocks(block_ids, delete_metadata=False)
         return block_ids
@@ -859,6 +955,7 @@ class FileSystemMaster:
                     ctx.append(EntryType.ADD_MOUNT_POINT, info.to_wire())
                 # a new mount can reveal paths previously recorded absent
                 self._absent_cache.clear()
+                self.invalidations.append(uri.path)
         except Exception:
             self._ufs.remove_mount(mount_id)
             raise
@@ -885,6 +982,7 @@ class FileSystemMaster:
                 self._block_master.remove_blocks(block_ids,
                                                  delete_metadata=True)
             self._ufs.remove_mount(info.mount_id)
+            self.invalidations.append(uri.path)
 
     def get_mount_points(self) -> List[MountPointInfo]:
         out = []
@@ -921,8 +1019,8 @@ class FileSystemMaster:
         if replication_min is not None and replication_max is not None and \
                 0 <= replication_max < replication_min:
             raise InvalidArgumentError("replication_max < replication_min")
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            lookup = lip.lookup
             inode = lookup.inode
             user = self._auth_user()
             self._perm.check_traverse(user, lookup.inodes[:-1])
@@ -967,6 +1065,7 @@ class FileSystemMaster:
                     if xattr is not None:
                         payload["xattr"] = xattr
                     ctx.append(EntryType.SET_ATTRIBUTE, payload)
+            self.invalidations.append(uri.path)
 
     # -------------------------------------------------------------- ACLs
     from alluxio_tpu.security.authorization import (
@@ -983,8 +1082,8 @@ class FileSystemMaster:
 
         AccessControlList.from_entries(entries)  # validate
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.write_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            lookup = lip.lookup
             inode = lookup.inode
             user = self._auth_user()
             self._perm.check_traverse(user, lookup.inodes[:-1])
@@ -1009,6 +1108,7 @@ class FileSystemMaster:
                         xattr.pop(key, None)
                     ctx.append(EntryType.SET_ACL, {
                         "id": t.id, "xattr": xattr, "op_time_ms": now})
+            self.invalidations.append(uri.path)
 
     def get_acl(self, path: "str | AlluxioURI") -> Dict[str, List[str]]:
         """Owner/group/mode base entries + extended + default entries
@@ -1016,8 +1116,8 @@ class FileSystemMaster:
         from alluxio_tpu.security.authorization import bits_to_string
 
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.read_locked():
-            lookup = self.inode_tree.lookup(uri)
+        with self.inode_tree.lock_path(uri) as lip:
+            lookup = lip.lookup
             inode = lookup.inode
             from alluxio_tpu.security.authorization import READ
 
@@ -1038,7 +1138,9 @@ class FileSystemMaster:
             }
 
     def get_pinned_file_ids(self) -> Set[int]:
-        with self.inode_tree.lock.read_locked():
+        # registry_lock, not the tree lock: striped mutations update the
+        # pinned set at journal-apply time without holding the tree lock
+        with self.inode_tree.registry_lock:
             return set(self.inode_tree.pinned_ids)
 
     def files_with_replication_constraints(self) -> List[Inode]:
@@ -1046,23 +1148,24 @@ class FileSystemMaster:
         ReplicationChecker's work list (reference:
         ``ReplicationChecker.java:57`` walks the replication-limited
         inode registry)."""
-        with self.inode_tree.lock.read_locked():
-            out = []
-            for iid in list(self.inode_tree.replication_limited_ids):
-                inode = self.inode_tree.get_inode(iid)
-                if inode is not None and inode.completed:
-                    out.append(inode)
-            return out
+        with self.inode_tree.registry_lock:
+            ids = list(self.inode_tree.replication_limited_ids)
+        out = []
+        for iid in ids:
+            inode = self.inode_tree.get_inode(iid)
+            if inode is not None and inode.completed:
+                out.append(inode)
+        return out
 
     # ------------------------------------------------------ persist control
     def schedule_async_persistence(self, path: "str | AlluxioURI") -> None:
         """Reference: ``scheduleAsyncPersistence:3209``."""
         uri = AlluxioURI(path)
-        with self.inode_tree.lock.write_locked():
+        with self.inode_tree.lock_path(uri, write=True) as lip:
             from alluxio_tpu.security.authorization import WRITE
 
-            self._check_access(self.inode_tree.lookup(uri), WRITE)
-            inode = self._existing_file(uri)
+            self._check_access(lip.lookup, WRITE)
+            inode = self._existing_inode(lip.lookup, uri)
             if not inode.completed:
                 raise FileIncompleteError(f"{uri} is not completed")
             if inode.persistence_state == PersistenceState.PERSISTED:
@@ -1072,6 +1175,7 @@ class FileSystemMaster:
                     "id": inode.id,
                     "persistence_state": PersistenceState.TO_BE_PERSISTED})
             self._persist_requests.add(inode.id)
+            self.invalidations.append(uri.path)
 
     def pop_persist_requests(self) -> "set[int]":
         """Drain scheduled persist work as inode IDS (consumed by the
@@ -1150,10 +1254,22 @@ class FileSystemMaster:
 
     def mark_persisted(self, path: "str | AlluxioURI",
                        ufs_fingerprint: str = "") -> None:
-        """A worker/job reports the file durable in the UFS."""
+        """A worker/job reports the file durable in the UFS.  Same
+        striped-fast-path / coarse-ancestor-flip split as
+        :meth:`complete_file`."""
         uri = AlluxioURI(path)
+        with self.inode_tree.lock_path(uri, write=True) as lip:
+            inode = self._existing_inode(lip.lookup, uri)
+            anc = self._unpersisted_chain(
+                self.inode_tree.parent_of(inode), uri)
+            if not anc:
+                with self._journal.create_context() as ctx:
+                    self._journal_persisted(ctx, inode, ufs_fingerprint,
+                                            ancestors=anc)
+                self.invalidations.append(uri.path)
+                return
         with self.inode_tree.lock.write_locked():
-            inode = self._existing_file(uri)
+            inode = self._existing_inode(self.inode_tree.lookup(uri), uri)
             anc = self._unpersisted_chain(
                 self.inode_tree.parent_of(inode), uri)
             if anc:  # breadcrumbs BEFORE the durable flip
@@ -1161,6 +1277,7 @@ class FileSystemMaster:
             with self._journal.create_context() as ctx:
                 self._journal_persisted(ctx, inode, ufs_fingerprint,
                                         ancestors=anc)
+            self.invalidations.append(uri.path)
 
     def commit_persist(self, path: "str | AlluxioURI",
                        temp_ufs_path: str, *,
@@ -1245,6 +1362,7 @@ class FileSystemMaster:
                     raise
                 with self._journal.create_context() as ctx:
                     self._journal_persisted(ctx, inode, fingerprint)
+                self.invalidations.append(uri.path)
                 return fingerprint
 
     def _discard_temp(self, uri: AlluxioURI, temp_ufs_path: str) -> None:
@@ -1497,6 +1615,7 @@ class FileSystemMaster:
                     self._block_master.commit_block_in_ufs(
                         bid, min(self._default_block_size, remaining))
                     remaining -= self._default_block_size
+            self.invalidations.append(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def _load_children_if_needed(self, uri: AlluxioURI,

@@ -181,7 +181,8 @@ def default_rules(*, stall_threshold: float = 0.5,
                   missing_source_grace_s: float = 300.0,
                   async_reject_rate_per_s: float = 0.01,
                   p99_regression_factor: float = 3.0,
-                  p99_floor_s: float = 0.001) -> List[HealthRule]:
+                  p99_floor_s: float = 0.001,
+                  inode_lock_wait_p99_s: float = 0.05) -> List[HealthRule]:
     """The shipped rule catalog (thresholds are the documented
     defaults; docs/observability.md carries the operator table)."""
 
@@ -359,7 +360,34 @@ def default_rules(*, stall_threshold: float = 0.5,
                  "p99_s": v}))
         return out
 
+    def metadata_lock_contention(ctx: HealthContext) -> List[Violation]:
+        # the master self-samples this series on the health tick
+        # (process._sample_metadata_history) — sustained inode-lock
+        # acquisition p99 means the striped metadata control plane is
+        # convoying (hot directory, coarse-fallback storm, or a slow
+        # journal flusher backing up writers)
+        metric = "Master.MetadataInodeLockWaitTime.p99"
+        v = ctx.window_mean(metric, "master", stall_window_s)
+        if v is None or v <= inode_lock_wait_p99_s:
+            return []
+        return [Violation(
+            "master", v,
+            f"inode-lock acquisition p99 {1e3 * v:.1f}ms sustained over "
+            f"{stall_window_s:.0f}s (threshold "
+            f"{1e3 * inode_lock_wait_p99_s:.0f}ms)",
+            {"metric": metric, "p99_s": v,
+             "threshold_s": inode_lock_wait_p99_s})]
+
     return [
+        HealthRule(
+            "metadata-lock-contention", severity="warning",
+            window_s=stall_window_s, threshold=inode_lock_wait_p99_s,
+            probe=metadata_lock_contention, needs_history=True,
+            description="metadata operations queue on inode path locks",
+            remediation="find the hot directory (spread writers across "
+                        "subtrees), check journal flush latency "
+                        "(Master.MetadataJournalFlushTime), and see "
+                        "docs/metadata.md for the locking model"),
         HealthRule(
             "input-stall-sustained", severity="critical",
             window_s=stall_window_s, threshold=stall_threshold,

@@ -5,24 +5,43 @@ Re-design of ``core/server/master/.../file/meta/InodeTree.java:84`` +
 
 **Locking rationale.** The reference implements fine-grained per-inode
 read/write locks with lock lists (``InodeLockManager.java:47``,
-``SimpleInodeLockList``) — ~8k LoC of subtle ordering. Here the tree is a
-**single-writer state machine behind one tree-level RW lock**: queries take
-the read lock; every mutation is serialized through the journal and applied
-under the write lock. On a Python control plane (GIL; 1 socket per master
-host) the fine-grained scheme buys nothing, and single-writer application is
-what makes journal replay trivially deterministic — the design SURVEY.md
-section 7 ("hard parts") recommends.
+``SimpleInodeLockList``). This tree started life as a single-writer state
+machine behind one tree-level RW lock; at millions-of-users metadata rates
+that one lock became the cluster ceiling (BENCH_SUITE: ListStatus ~1.6k
+ops/s while the data plane streams GB/s), so the scheme is now **two
+level**:
+
+- ``self.lock`` (tree-level RW lock) is held in READ mode by every
+  path-locked operation and in WRITE mode only by heavyweight multi-phase
+  operations (mount/unmount, UFS metadata load, commit_persist,
+  snapshot/restore).  A tree-write therefore still excludes everything —
+  the safe fallback for paths not worth striping.
+- ``lock_path()`` hands out a :class:`LockedInodePath` — per-inode
+  read/write locks acquired root→leaf along the path (read on ancestors,
+  write on the terminal/deepest-existing inode only), mirroring the
+  reference's ``SimpleInodeLockList``.  Independent subtrees — the common
+  case for per-host training shards — no longer serialize.
+
+Acquisition order is canonical and audited (``lint/pytest_lockaudit``):
+``InodeTree.lock`` (read) → ``InodeTree.inode_lock`` (root→leaf, write at
+the tail) → everything downstream (journal commit queue, BlockMaster).
+Multi-path operations (rename) acquire their two lock lists in
+lexicographic path order.
 
 All mutations arrive as journal entries via ``process_entry`` — the tree is
 a ``Journaled`` component; the FileSystemMaster validates + emits entries,
-it never pokes tree state directly.
+it never pokes tree state directly.  Applies are serialized by the journal
+system; the small id registries (pinned/TTL/persist sets) carry their own
+``registry_lock`` so snapshot readers never iterate a mutating set.
 """
 
 from __future__ import annotations
 
+import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Set
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from alluxio_tpu.journal.format import EntryType, JournalEntry, Journaled
 from alluxio_tpu.master.inode import Inode, PersistenceState
@@ -35,6 +54,276 @@ from alluxio_tpu.utils.locks import RWLock
 from alluxio_tpu.utils.uri import AlluxioURI
 
 ROOT_ID_PARENT = -1
+
+#: entry types that mutate the namespace — each application bumps
+#: ``InodeTree.change_version`` (the listing cache's coherence stamp)
+_MUTATING_TYPES = frozenset((
+    EntryType.INODE_DIRECTORY, EntryType.INODE_FILE, EntryType.UPDATE_INODE,
+    EntryType.NEW_BLOCK, EntryType.COMPLETE_FILE, EntryType.DELETE_FILE,
+    EntryType.RENAME, EntryType.SET_ATTRIBUTE, EntryType.SET_ACL,
+    EntryType.PERSIST_FILE,
+))
+
+#: (registry, timer) cache: the lock-wait timer updates on EVERY
+#: path-locked metadata op, so the per-call registry lock + dict lookup
+#: must stay off the hot path — but tests' ``reset_metrics()`` swaps the
+#: registry, so the cache keys on registry identity, not process
+#: lifetime (same constraint ``master/metrics_master.py`` documents)
+_timer_cache: "Tuple[object, object]" = (None, None)
+
+
+def _lock_wait_timer():
+    global _timer_cache
+    from alluxio_tpu.metrics import metrics
+
+    reg = metrics()
+    cached_reg, timer = _timer_cache
+    if cached_reg is not reg:
+        timer = reg.timer("Master.MetadataInodeLockWaitTime")
+        _timer_cache = (reg, timer)
+    return timer
+
+
+class InodeLockManager:
+    """Pool of per-inode RW locks, created on demand and swept when idle
+    (reference: ``InodeLockManager.java:47`` — there a weak-value map).
+
+    ``checkout``/``checkin`` refcount each lock so a sweep can never
+    evict a lock some thread still holds: two paths locking the same
+    inode MUST share one RWLock object, and eviction-while-held would
+    silently split them."""
+
+    #: idle locks are swept once the pool outgrows this (a pool entry
+    #: is ~a hundred bytes; 64k ≈ the hot working set of a large run)
+    MAX_IDLE_POOL = 65536
+
+    def __init__(self) -> None:
+        self._locks: Dict[int, list] = {}  # inode id -> [lock, refcount]
+        self._pool_lock = threading.Lock()
+        #: test-harness hook (lint/pytest_lockaudit): wraps every fresh
+        #: per-inode RWLock in an audited proxy named
+        #: ``InodeTree.inode_lock``
+        self._proxy_factory = None
+
+    def checkout(self, inode_id: int):
+        with self._pool_lock:
+            ent = self._locks.get(inode_id)
+            if ent is None:
+                lock = RWLock()
+                if self._proxy_factory is not None:
+                    lock = self._proxy_factory(lock)
+                ent = self._locks[inode_id] = [lock, 0]
+            ent[1] += 1
+            return ent[0]
+
+    def checkin(self, inode_id: int) -> None:
+        with self._pool_lock:
+            ent = self._locks.get(inode_id)
+            if ent is None:
+                return
+            ent[1] -= 1
+            if ent[1] <= 0 and len(self._locks) > self.MAX_IDLE_POOL:
+                # amortized sweep of ALL idle entries (refcount 0 means
+                # no thread can be inside acquire/release on it)
+                for iid in [i for i, e in self._locks.items() if e[1] <= 0]:
+                    del self._locks[iid]
+
+    def pool_size(self) -> int:
+        with self._pool_lock:
+            return len(self._locks)
+
+
+class LockedInodePath:
+    """An ordered per-inode lock list along ``uri`` (reference:
+    ``SimpleInodeLockList`` + ``LockedInodePath``): read locks root→parent,
+    write lock on the terminal inode — or, when the terminal does not
+    exist (create), on the deepest EXISTING inode, under which all new
+    inodes are linked.
+
+    Acquisition is optimistic: walk the tree unlocked (the store is
+    internally synchronized), acquire the planned locks root→leaf, then
+    re-validate every edge of the locked chain against the live tree —
+    a concurrent rename/delete/create that moved the path retries the
+    walk.  Validated chains are then stable: every inode in the chain is
+    read-held here, and any namespace mutation of it (or of the edge
+    below the deepest) requires a write lock this list excludes.
+    """
+
+    def __init__(self, tree: "InodeTree", uri: AlluxioURI, *,
+                 write: bool = False, write_parent: bool = False) -> None:
+        self._tree = tree
+        self.uri = uri
+        self.write = write
+        #: also write-lock the terminal's parent (atomic replace:
+        #: create(overwrite=True) deletes the terminal then re-creates
+        #: under the parent inside ONE lock scope)
+        self._write_parent = write_parent
+        self._held: List[Tuple[int, str, object]] = []
+        self.lookup: Optional[PathLookup] = None
+
+    # -- acquisition --------------------------------------------------------
+    def acquire(self) -> "LockedInodePath":
+        tree = self._tree
+        comps = self.uri.path_components()
+        try:
+            while True:
+                chain, modes, full = _plan(tree, comps, self.write,
+                                           self._write_parent)
+                _acquire_planned(tree, zip(chain, modes), self._held)
+                if _validate_chain(tree, chain, comps, full):
+                    self.lookup = PathLookup(uri=self.uri, inodes=chain)
+                    return self
+                self.release()
+        except BaseException:
+            # a store error (e.g. a SQLITE metastore hiccup) mid-plan or
+            # mid-validate must not leak held locks: a leaked terminal
+            # write lock would wedge its path forever
+            self.release()
+            raise
+
+    def release(self) -> None:
+        _release_held(self._tree, self._held)
+
+
+def _plan(tree: "InodeTree", comps, write: bool, write_parent: bool):
+    """Walk (unlocked) and plan lock modes root→leaf: read on
+    ancestors, write on the terminal — or the deepest EXISTING inode
+    when the terminal is missing (create)."""
+    root = tree.root
+    if root is None:
+        raise InvalidPathError("inode tree not initialized")
+    store = tree._store
+    chain: List[Inode] = [root]
+    cur = root
+    for name in comps:
+        cid = store.get_child_id(cur.id, name)
+        if cid is None:
+            break
+        child = store.get(cid)
+        if child is None:
+            break
+        chain.append(child)
+        cur = child
+    full = len(chain) == len(comps) + 1
+    modes = ["r"] * len(chain)
+    if write:
+        modes[-1] = "w"
+        if write_parent and full and len(chain) >= 2:
+            modes[-2] = "w"
+    return chain, modes, full
+
+
+def _acquire_planned(tree: "InodeTree", planned, held: List[Tuple]) -> None:
+    """Acquire ``(inode, mode)`` pairs in the given order, recording
+    into ``held`` (release via ``_release_held``)."""
+    mgr = tree.lock_manager
+    for inode, mode in planned:
+        lock = mgr.checkout(inode.id)
+        if mode == "w":
+            lock.acquire_write()
+        else:
+            lock.acquire_read()
+        held.append((inode.id, mode, lock))
+
+
+def _release_held(tree: "InodeTree", held: List[Tuple]) -> None:
+    for inode_id, mode, lock in reversed(held):
+        if mode == "w":
+            lock.release_write()
+        else:
+            lock.release_read()
+        tree.lock_manager.checkin(inode_id)
+    held.clear()
+
+
+def _validate_chain(tree: "InodeTree", chain: List[Inode], comps,
+                    full: bool) -> bool:
+    store = tree._store
+    if tree._root_id != chain[0].id:
+        return False
+    for i, child in enumerate(chain[1:]):
+        # validate against the REQUESTED component names, not the
+        # (mutable) inode.name attr: a same-parent rename keeps the
+        # edge consistent with inode.name while leaving our path
+        if store.get_child_id(chain[i].id, comps[i]) != child.id:
+            return False
+    if not full:
+        # the first missing component must still be missing, or the
+        # lock list stops above the true terminal
+        if store.get_child_id(chain[-1].id, comps[len(chain) - 1]) \
+                is not None:
+            return False
+    return True
+
+
+class LockedInodePathPair:
+    """Two lock lists acquired as ONE merged plan (rename).  The union
+    of both chains is taken with the strongest mode per inode — the two
+    root-down chains share exactly their common path prefix, so merging
+    avoids the same-thread read→write upgrade a sequential acquisition
+    would deadlock on — and is acquired prefix-first, then the two
+    divergent suffixes in lexicographic path order (the canonical order
+    all multi-path operations share)."""
+
+    def __init__(self, tree: "InodeTree", first: AlluxioURI,
+                 second: AlluxioURI) -> None:
+        self._tree = tree
+        self._first, self._second = first, second
+        self._held: List[Tuple[int, str, object]] = []
+        self.first_lookup: Optional[PathLookup] = None
+        self.second_lookup: Optional[PathLookup] = None
+
+    def acquire(self) -> "LockedInodePathPair":
+        tree = self._tree
+        a_uri, b_uri = sorted((self._first, self._second),
+                              key=lambda u: u.path)
+        a_comps, b_comps = a_uri.path_components(), b_uri.path_components()
+        try:
+            while True:
+                a_chain, a_modes, a_full = _plan(tree, a_comps, True, False)
+                b_chain, b_modes, b_full = _plan(tree, b_comps, True, False)
+                # merged plan: strongest mode per inode; shared inodes are
+                # exactly the chains' common prefix (root-down paths)
+                want: Dict[int, str] = {}
+                order: List[Inode] = []
+                for chain, modes in ((a_chain, a_modes),
+                                     (b_chain, b_modes)):
+                    for inode, mode in zip(chain, modes):
+                        if inode.id not in want:
+                            want[inode.id] = mode
+                            order.append(inode)
+                        elif mode == "w":
+                            want[inode.id] = "w"
+                _acquire_planned(tree, ((i, want[i.id]) for i in order),
+                                 self._held)
+                if _validate_chain(tree, a_chain, a_comps, a_full) and \
+                        _validate_chain(tree, b_chain, b_comps, b_full):
+                    lookups = {
+                        a_uri.path: PathLookup(uri=a_uri, inodes=a_chain),
+                        b_uri.path: PathLookup(uri=b_uri, inodes=b_chain),
+                    }
+                    self.first_lookup = lookups[self._first.path]
+                    self.second_lookup = lookups[self._second.path]
+                    return self
+                self.release()
+        except BaseException:
+            self.release()  # never leak a partial merged plan
+            raise
+
+    def release(self) -> None:
+        _release_held(self._tree, self._held)
+
+
+class _PathHandle:
+    """Minimal ``lock_path`` result holder: a resolved lookup whose
+    locks are managed by the enclosing scope (coarse mode and the
+    pair-lock wrapper both use it)."""
+
+    def __init__(self, lookup: "PathLookup") -> None:
+        self.lookup = lookup
+
+    def release(self) -> None:  # pragma: no cover - symmetry only
+        pass
 
 
 @dataclass
@@ -68,9 +357,25 @@ class PathLookup:
 class InodeTree(Journaled):
     journal_name = "InodeTree"
 
-    def __init__(self, store: Optional[InodeStore] = None) -> None:
+    def __init__(self, store: Optional[InodeStore] = None, *,
+                 coarse_locking: bool = False) -> None:
         self._store = store if store is not None else HeapInodeStore()
         self.lock = RWLock()
+        self.lock_manager = InodeLockManager()
+        #: True: ``lock_path`` degrades to the tree-level lock (the
+        #: pre-striping single-lock master) — bench baseline + escape
+        #: hatch; striped is the default
+        self.coarse_locking = coarse_locking
+        #: guards the id registries below (pinned/to-be-persisted/lost/
+        #: replication-limited sets + inode_count + change_version):
+        #: journal applies mutate them while snapshot readers copy them,
+        #: and striped locking means those no longer share the tree lock
+        self.registry_lock = threading.Lock()
+        #: monotonic namespace-mutation counter (bumped per applied
+        #: mutating journal entry).  "version unchanged" == "namespace
+        #: unchanged" — the listing cache's coherence stamp, replacing
+        #: the tree-write-lock version that striping made incomplete.
+        self.change_version = 0
         self._root_id: Optional[int] = None
         self.ttl_buckets = TtlBucketList()
         self.pinned_ids: Set[int] = set()
@@ -84,6 +389,67 @@ class InodeTree(Journaled):
         #: replication-limited inode registries in InodeTreePersistentState)
         self.replication_limited_ids: Set[int] = set()
         self._inode_count = 0
+
+    # ------------------------------------------------------------- locking
+    @contextlib.contextmanager
+    def lock_path(self, uri: AlluxioURI, *, write: bool = False,
+                  write_parent: bool = False):
+        """Scope holding the tree lock (read) plus an ordered per-inode
+        lock list along ``uri`` — read locks on ancestors, write lock on
+        the terminal (or deepest existing, for creates).  Yields the
+        list with a fresh :class:`PathLookup` in ``.lookup``.  In coarse
+        mode this is exactly the old single-lock critical section."""
+        if self.coarse_locking:
+            guard = self.lock.write_locked() if write \
+                else self.lock.read_locked()
+            with guard:
+                yield _PathHandle(self.lookup(uri))
+            return
+        t0 = time.perf_counter()
+        self.lock.acquire_read()
+        lip = LockedInodePath(self, uri, write=write,
+                              write_parent=write_parent)
+        try:
+            lip.acquire()
+        except BaseException:
+            self.lock.release_read()
+            raise
+        _lock_wait_timer().update(time.perf_counter() - t0)
+        try:
+            yield lip
+        finally:
+            lip.release()
+            self.lock.release_read()
+
+    @contextlib.contextmanager
+    def lock_path_pair(self, first: AlluxioURI, second: AlluxioURI, *,
+                       write: bool = True):
+        """Two lock lists for a two-path operation (rename).  Lists are
+        acquired in lexicographic path order — every multi-path caller
+        converging on the same total order is what keeps two concurrent
+        renames from deadlocking — and yielded in CALLER order."""
+        if self.coarse_locking:
+            guard = self.lock.write_locked() if write \
+                else self.lock.read_locked()
+            with guard:
+                yield (_PathHandle(self.lookup(first)),
+                       _PathHandle(self.lookup(second)))
+            return
+        t0 = time.perf_counter()
+        self.lock.acquire_read()
+        pair = LockedInodePathPair(self, first, second)
+        try:
+            pair.acquire()
+        except BaseException:
+            self.lock.release_read()
+            raise
+        _lock_wait_timer().update(time.perf_counter() - t0)
+        try:
+            yield (_PathHandle(pair.first_lookup),
+                   _PathHandle(pair.second_lookup))
+        finally:
+            pair.release()
+            self.lock.release_read()
 
     # ------------------------------------------------------------------ read
     @property
@@ -158,6 +524,17 @@ class InodeTree(Journaled):
 
     # ------------------------------------------------- journal application
     def process_entry(self, entry: JournalEntry) -> bool:
+        out = self._process_entry(entry)
+        # bump AFTER the mutation lands: a concurrent lister that read
+        # the pre-bump version can then never cache a post-mutation
+        # stamp on pre-mutation data — the race fails as a cache miss,
+        # never as a stale hit
+        if entry.type in _MUTATING_TYPES:
+            with self.registry_lock:
+                self.change_version += 1
+        return out
+
+    def _process_entry(self, entry: JournalEntry) -> bool:
         t, p = entry.type, entry.payload
         if t == EntryType.INODE_DIRECTORY or t == EntryType.INODE_FILE:
             self._apply_create(Inode.from_wire_dict(p))
@@ -183,7 +560,8 @@ class InodeTree(Journaled):
 
     def _apply_create(self, inode: Inode) -> None:
         self._store.put(inode)
-        self._inode_count += 1
+        with self.registry_lock:
+            self._inode_count += 1
         if inode.parent_id == ROOT_ID_PARENT:
             self._root_id = inode.id
         else:
@@ -195,9 +573,10 @@ class InodeTree(Journaled):
                 self._store.put(parent)
         if inode.ttl >= 0:
             self.ttl_buckets.insert(inode.id, inode.creation_time_ms, inode.ttl)
-        if inode.pinned:
-            self.pinned_ids.add(inode.id)
-        self._track_replication(inode)
+        with self.registry_lock:
+            if inode.pinned:
+                self.pinned_ids.add(inode.id)
+            self._track_replication(inode)
 
     def _apply_update(self, p: dict) -> None:
         inode = self._store.get(p["id"])
@@ -242,11 +621,12 @@ class InodeTree(Journaled):
             return
         self._store.remove_child(inode.parent_id, inode.name)
         self._store.remove(inode.id)
-        self._inode_count -= 1
-        self.pinned_ids.discard(inode.id)
-        self.to_be_persisted_ids.discard(inode.id)
-        self.lost_file_ids.discard(inode.id)
-        self.replication_limited_ids.discard(inode.id)
+        with self.registry_lock:
+            self._inode_count -= 1
+            self.pinned_ids.discard(inode.id)
+            self.to_be_persisted_ids.discard(inode.id)
+            self.lost_file_ids.discard(inode.id)
+            self.replication_limited_ids.discard(inode.id)
         if inode.ttl >= 0:
             self.ttl_buckets.remove(inode.id)
         parent = self._store.get(inode.parent_id)
@@ -274,12 +654,13 @@ class InodeTree(Journaled):
             return
         if "pinned" in p and p["pinned"] is not None:
             inode.pinned = p["pinned"]
-            if inode.pinned:
-                self.pinned_ids.add(inode.id)
-                inode.pinned_media = list(p.get("pinned_media") or [])
-            else:
-                self.pinned_ids.discard(inode.id)
-                inode.pinned_media = []
+            with self.registry_lock:
+                if inode.pinned:
+                    self.pinned_ids.add(inode.id)
+                    inode.pinned_media = list(p.get("pinned_media") or [])
+                else:
+                    self.pinned_ids.discard(inode.id)
+                    inode.pinned_media = []
         if "ttl" in p and p["ttl"] is not None:
             if inode.ttl >= 0:
                 self.ttl_buckets.remove(inode.id)
@@ -294,15 +675,16 @@ class InodeTree(Journaled):
                   "lost_pending_persist"):
             if p.get(k) is not None:
                 setattr(inode, k, p[k])
-        self._track_replication(inode)
-        if p.get("persistence_state") == PersistenceState.TO_BE_PERSISTED:
-            self.to_be_persisted_ids.add(inode.id)
-        elif p.get("persistence_state") is not None:
-            self.to_be_persisted_ids.discard(inode.id)
-        if p.get("persistence_state") == PersistenceState.LOST:
-            self.lost_file_ids.add(inode.id)
-        elif p.get("persistence_state") is not None:
-            self.lost_file_ids.discard(inode.id)
+        with self.registry_lock:
+            self._track_replication(inode)
+            if p.get("persistence_state") == PersistenceState.TO_BE_PERSISTED:
+                self.to_be_persisted_ids.add(inode.id)
+            elif p.get("persistence_state") is not None:
+                self.to_be_persisted_ids.discard(inode.id)
+            if p.get("persistence_state") == PersistenceState.LOST:
+                self.lost_file_ids.add(inode.id)
+            elif p.get("persistence_state") is not None:
+                self.lost_file_ids.discard(inode.id)
         if p.get("xattr") is not None:
             inode.xattr.update(p["xattr"])
         if p.get("op_time_ms"):
@@ -315,11 +697,13 @@ class InodeTree(Journaled):
             return
         inode.persistence_state = PersistenceState.PERSISTED
         inode.ufs_fingerprint = p.get("ufs_fingerprint", inode.ufs_fingerprint)
-        self.to_be_persisted_ids.discard(inode.id)
-        self.lost_file_ids.discard(inode.id)
+        with self.registry_lock:
+            self.to_be_persisted_ids.discard(inode.id)
+            self.lost_file_ids.discard(inode.id)
         self._store.put(inode)
 
     def _track_replication(self, inode: Inode) -> None:
+        # callers hold ``registry_lock``
         if not inode.is_directory and (inode.replication_min > 0 or
                                        inode.replication_max >= 0):
             self.replication_limited_ids.add(inode.id)
@@ -341,28 +725,31 @@ class InodeTree(Journaled):
     def restore(self, snap: dict) -> None:
         self._store.clear()
         self.ttl_buckets.clear()
-        self.pinned_ids.clear()
-        self.to_be_persisted_ids.clear()
-        self.lost_file_ids.clear()
-        self.replication_limited_ids.clear()
-        self._inode_count = 0
+        with self.registry_lock:
+            self.pinned_ids.clear()
+            self.to_be_persisted_ids.clear()
+            self.lost_file_ids.clear()
+            self.replication_limited_ids.clear()
+            self._inode_count = 0
+            self.change_version += 1
         self._root_id = snap.get("root_id")
         for d in snap.get("inodes", []):
             inode = Inode.from_wire_dict(d)
             self._store.put(inode)
-            self._inode_count += 1
             if inode.parent_id != ROOT_ID_PARENT:
                 self._store.add_child(inode.parent_id, inode.name, inode.id)
             if inode.ttl >= 0:
                 self.ttl_buckets.insert(inode.id, inode.creation_time_ms,
                                         inode.ttl)
-            if inode.pinned:
-                self.pinned_ids.add(inode.id)
-            if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
-                self.to_be_persisted_ids.add(inode.id)
-            if inode.persistence_state == PersistenceState.LOST:
-                self.lost_file_ids.add(inode.id)
-            self._track_replication(inode)
+            with self.registry_lock:
+                self._inode_count += 1
+                if inode.pinned:
+                    self.pinned_ids.add(inode.id)
+                if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
+                    self.to_be_persisted_ids.add(inode.id)
+                if inode.persistence_state == PersistenceState.LOST:
+                    self.lost_file_ids.add(inode.id)
+                self._track_replication(inode)
 
     def _empty_snapshot(self) -> dict:
         return {"root_id": None, "inodes": []}

@@ -80,8 +80,19 @@ class MasterProcess:
                 Keys.SECURITY_AUTHORIZATION_PERMISSION_SUPERGROUP)),
             superuser=get_os_user())
         self.permission_checker = checker
+        from alluxio_tpu.master.metastore import create_inode_store
+
+        # pluggable metastore backend (reference: HEAP/ROCKS/caching):
+        # HEAP serves from dicts; SQLITE spills metadata > RAM to disk;
+        # CACHING fronts SQLITE with a bounded write-back LRU
+        inode_store = create_inode_store(
+            str(conf.get(Keys.MASTER_METASTORE)),
+            conf.get(Keys.MASTER_METASTORE_DIR),
+            cache_size=conf.get_int(
+                Keys.MASTER_METASTORE_INODE_CACHE_MAX_SIZE))
         self.fs_master = FileSystemMaster(
             self.block_master, self.journal, clock=self._clock,
+            inode_store=inode_store,
             default_block_size=conf.get_bytes(
                 Keys.USER_BLOCK_SIZE_BYTES_DEFAULT),
             permission_checker=checker,
@@ -149,6 +160,32 @@ class MasterProcess:
         self.rpc_port: Optional[int] = None
 
     # -- safe mode ----------------------------------------------------------
+    def _sample_metadata_history(self) -> None:
+        """Push the metadata control plane's own gauges into the history
+        rings as ``master``-source series on the health tick (same
+        pattern as the remediation/admission samples): inode-lock wait
+        p99 — what the metadata-lock-contention rule watches — plus
+        journal group-commit batch/flush shape and the invalidation-log
+        counter."""
+        history = self.metrics_master.history \
+            if self.metrics_master is not None else None
+        if history is None:
+            return
+        reg = metrics()
+        history.ingest("master", {
+            "Master.MetadataInodeLockWaitTime.p99":
+                reg.timer("Master.MetadataInodeLockWaitTime")
+                .percentile(0.99),
+            "Master.MetadataJournalBatchSize.p50":
+                reg.timer("Master.MetadataJournalBatchSize")
+                .percentile(0.50),
+            "Master.MetadataJournalFlushTime.p99":
+                reg.timer("Master.MetadataJournalFlushTime")
+                .percentile(0.99),
+            "Master.MetadataCacheInvalidations": float(
+                reg.counter("Master.MetadataCacheInvalidations").count),
+        })
+
     def in_safe_mode(self) -> bool:
         return time.monotonic() < self._safe_mode_until
 
@@ -179,6 +216,11 @@ class MasterProcess:
     def _start_serving(self) -> int:
         """Primacy is held: start masters, heartbeats and the RPC server."""
         self.start_time_ms = self._clock.millis()
+        if hasattr(self.journal, "start_group_commit"):
+            # dedicated group-commit flusher: journal writes + fsyncs
+            # leave the striped inode-lock critical sections
+            self.journal.start_group_commit(self._conf.get_duration_s(
+                Keys.MASTER_JOURNAL_FLUSH_BATCH_TIME))
         self.fs_master.start(self._root_ufs_uri)
         self._safe_mode_until = time.monotonic() + self._conf.get_duration_s(
             Keys.MASTER_SAFEMODE_WAIT)
@@ -232,7 +274,8 @@ class MasterProcess:
             metrics_master=self.metrics_master,
             health_monitor=self.health_monitor,
             remediation_engine=self.remediation,
-            admission=self.admission))
+            admission=self.admission,
+            invalidation_log=self.fs_master.invalidations))
         self.rpc_port = self.rpc_server.start()
         if self._conf.get_bool(Keys.MASTER_FASTPATH_ENABLED):
             from alluxio_tpu.rpc.fastpath import (
@@ -317,7 +360,9 @@ class MasterProcess:
                 stall_threshold=conf.get_float(
                     Keys.MASTER_HEALTH_STALL_THRESHOLD),
                 stall_window_s=conf.get_duration_s(
-                    Keys.MASTER_HEALTH_STALL_WINDOW))
+                    Keys.MASTER_HEALTH_STALL_WINDOW),
+                inode_lock_wait_p99_s=conf.get_duration_s(
+                    Keys.MASTER_HEALTH_METADATA_LOCK_WAIT_THRESHOLD))
             if self.admission is not None:
                 from alluxio_tpu.master.health import (
                     tenant_overload_rule,
@@ -490,6 +535,7 @@ class MasterProcess:
                 # remediation samples do: flood shapes stay visible in
                 # `fsadmin report history` after the flood is gone
                 self.admission.sample_history(self.metrics_master.history)
+            self._sample_metadata_history()
 
         if self.health_monitor is not None or \
                 self.metrics_master.history is not None:

@@ -111,10 +111,18 @@ class _BaseClient:
 class FsMasterClient(_BaseClient):
     service = FS_SERVICE
 
-    def get_status(self, path: str, sync_interval_ms: int = -1) -> FileInfo:
-        return FileInfo.from_wire(self._call(
+    def get_status(self, path: str, sync_interval_ms: int = -1, *,
+                   want_version: bool = False):
+        """``want_version=True`` -> ``(FileInfo, stamp)`` where stamp is
+        the master's metadata-invalidation version taken BEFORE the
+        lookup (None against a server predating the stamp protocol) —
+        what the client metadata cache stores (docs/metadata.md)."""
+        resp = self._call(
             "get_status", {"path": str(path),
-                           "sync_interval_ms": sync_interval_ms}))
+                           "sync_interval_ms": sync_interval_ms})
+        stamp = resp.pop("md_version", None)
+        info = FileInfo.from_wire(resp)
+        return (info, stamp) if want_version else info
 
     def exists(self, path: str) -> bool:
         return self._call("exists", {"path": str(path)})["exists"]
@@ -130,14 +138,20 @@ class FsMasterClient(_BaseClient):
                 for row in zip(*(cols[k] for k in keys))]
 
     def list_status(self, path: str, recursive: bool = False,
-                    sync_interval_ms: int = -1) -> List[FileInfo]:
+                    sync_interval_ms: int = -1, *,
+                    want_version: bool = False):
+        """``want_version=True`` -> ``(infos, stamp)`` — see
+        :meth:`get_status`."""
         resp = self._call("list_status", {
             "path": str(path), "recursive": recursive,
             "sync_interval_ms": sync_interval_ms, "columnar": True})
+        stamp = resp.get("md_version")
         col = resp.get("columnar")
         if col is None:  # server predates the columnar listing format
-            return [FileInfo.from_wire(d) for d in resp["infos"]]
-        return self._decode_columnar(col["cols"])
+            infos = [FileInfo.from_wire(d) for d in resp["infos"]]
+        else:
+            infos = self._decode_columnar(col["cols"])
+        return (infos, stamp) if want_version else infos
 
     def iter_status(self, path: str, recursive: bool = False,
                     sync_interval_ms: int = -1,
@@ -405,16 +419,23 @@ class MetaMasterClient(_BaseClient):
 
     def metrics_heartbeat(self, source: str,
                           metrics: Dict[str, float],
-                          spans: Optional[List[dict]] = None) -> dict:
+                          spans: Optional[List[dict]] = None,
+                          md_cache_version: Optional[int] = None,
+                          want_md_invalidations: bool = False) -> dict:
         """Ship a node's metric snapshot — and any completed trace spans
         drained from its ring — for cluster aggregation / trace
         stitching (reference: ``metric_master.proto`` ClientMasterSync).
         The response may carry a remediation config overlay
         (``conf_overlay`` + ``conf_overlay_version``) the client is
-        expected to apply — see docs/self_healing.md."""
-        return self._call("metrics_heartbeat", {"source": source,
-                                                "metrics": metrics,
-                                                "spans": spans or []})
+        expected to apply — see docs/self_healing.md — and, when
+        ``want_md_invalidations`` is set, the metadata-cache
+        invalidation batch since ``md_cache_version``
+        (``md_invalidations`` — docs/metadata.md)."""
+        req = {"source": source, "metrics": metrics, "spans": spans or []}
+        if want_md_invalidations:
+            req["want_md_invalidations"] = True
+            req["md_cache_version"] = md_cache_version
+        return self._call("metrics_heartbeat", req)
 
     def get_metrics_history(self, name: str = "", *, source: str = "",
                             resolution: str = "raw", since: float = 0.0,

@@ -100,8 +100,19 @@ def fs_master_service(fsm: FileSystemMaster,
         u("get_sync_path_list", lambda r: {
             "paths": active_sync.sync_points()})
 
-    u("get_status", lambda r: fsm.get_status(
-        r["path"], sync_interval_ms=r.get("sync_interval_ms", -1)).to_wire())
+    def _get_status(r):
+        # stamp BEFORE the lookup: the payload is then at least as new
+        # as the stamp, so any later mutation carries a larger version
+        # and reaches the client as a heartbeat invalidation — the
+        # client metadata cache's coherence invariant (docs/metadata.md)
+        v = fsm.invalidations.version
+        out = fsm.get_status(
+            r["path"], sync_interval_ms=r.get("sync_interval_ms",
+                                              -1)).to_wire()
+        out["md_version"] = v
+        return out
+
+    u("get_status", _get_status)
     u("exists", lambda r: {"exists": fsm.exists(r["path"])})
     def _list_status_stream(r: dict):
         """Partial-response listing (reference: the streamed ListStatus
@@ -139,15 +150,21 @@ def fs_master_service(fsm: FileSystemMaster,
     _audited_resolve = u("list_status_stream.resolve", _resolve,
                          register=False)
     svc.stream_out("list_status_stream", _list_status_stream)
-    u("list_status", lambda r: (
-        {"columnar": fsm.list_status(
-            r["path"], recursive=r.get("recursive", False),
-            sync_interval_ms=r.get("sync_interval_ms", -1),
-            columnar=True)}
-        if r.get("columnar") else
-        {"infos": fsm.list_status(
-            r["path"], recursive=r.get("recursive", False),
-            sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)}))
+    def _list_status(r):
+        v = fsm.invalidations.version  # stamp-before-lookup, as above
+        if r.get("columnar"):
+            out = {"columnar": fsm.list_status(
+                r["path"], recursive=r.get("recursive", False),
+                sync_interval_ms=r.get("sync_interval_ms", -1),
+                columnar=True)}
+        else:
+            out = {"infos": fsm.list_status(
+                r["path"], recursive=r.get("recursive", False),
+                sync_interval_ms=r.get("sync_interval_ms", -1), wire=True)}
+        out["md_version"] = v
+        return out
+
+    u("list_status", _list_status)
     u("create_file", lambda r: fsm.create_file(
         r["path"], block_size_bytes=r.get("block_size_bytes"),
         recursive=r.get("recursive", True), ttl=r.get("ttl", -1),
@@ -260,7 +277,8 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         metrics_master=None,
                         health_monitor=None,
                         remediation_engine=None,
-                        admission=None) -> ServiceDefinition:
+                        admission=None,
+                        invalidation_log=None) -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
     config-hash handshake ``ConfigHashSync.java:36``, and the checkpoint
@@ -366,6 +384,13 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                 if overlay:
                     resp["conf_overlay"] = overlay
                 resp["conf_overlay_version"] = version
+            if invalidation_log is not None and \
+                    r.get("want_md_invalidations"):
+                # metadata-cache push invalidation rides the same
+                # channel (docs/metadata.md): prefixes invalidated
+                # since the client's applied version
+                resp["md_invalidations"] = invalidation_log.since(
+                    r.get("md_cache_version"))
             return resp
         return {}
 

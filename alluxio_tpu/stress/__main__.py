@@ -187,6 +187,31 @@ def build_parser() -> argparse.ArgumentParser:
     qo.add_argument("--admission-principals", type=int, default=20_000)
     qo.add_argument("--admission-max-principals", type=int, default=512)
 
+    md = sub.add_parser("metadata",
+                        help="metadata control-plane gates: striped "
+                             "inode locking + journal group commit vs "
+                             "the single-lock master (modeled slow "
+                             "fsync), and warm client-metadata-cache "
+                             "GetStatus vs uncached RPCs")
+    md.add_argument("--row", choices=("striped", "journal", "cached"),
+                    default="striped")
+    md.add_argument("--threads", type=int, default=None,
+                    help="driver threads (default 8; cached row 4)")
+    md.add_argument("--duration", type=float, default=None,
+                    metavar="SECONDS",
+                    help="per-mode measure window (default 2.0; "
+                         "cached row 1.5)")
+    md.add_argument("--fsync-ms", type=float, default=3.0,
+                    help="modeled journal fsync cost (local-disk/NFS "
+                         "class); must dwarf scheduler jitter")
+    md.add_argument("--batch-time-ms", type=float, default=2.0,
+                    help="group-commit coalescing window under test")
+    md.add_argument("--min-speedup", type=float, default=None,
+                    help="gate ratio (defaults: striped 3x, journal "
+                         "1.5x, cached 10x)")
+    md.add_argument("--master", default=None,
+                    help="cached row only: attach to a live cluster")
+
     sub.add_parser("suite", help="run the whole BASELINE config family")
     rp = sub.add_parser("report",
                         help="render suite JSON to a single-file HTML "
@@ -232,6 +257,9 @@ SUITE = (
     ("ufs-cold-read", ["ufscold"]),
     ("remote-warm-read", ["remoteread"]),
     ("qos-two-tenant", ["qos"]),
+    ("metadata-striped", ["metadata", "--row", "striped"]),
+    ("metadata-cached-getstatus", ["metadata", "--row", "cached"]),
+    ("metadata-journal-batch", ["metadata", "--row", "journal"]),
 )
 
 
@@ -438,6 +466,21 @@ def main(argv=None) -> int:
                 admission_checks=args.admission_checks,
                 admission_principals=args.admission_principals,
                 admission_max_principals=args.admission_max_principals)
+    elif args.bench == "metadata":
+        from alluxio_tpu.stress.metadata_bench import run
+
+        kw = {}
+        if args.threads is not None:
+            kw["threads"] = args.threads
+        if args.duration is not None:
+            kw["duration_s"] = args.duration
+        if args.min_speedup is not None:
+            kw["min_speedup"] = args.min_speedup
+        if args.row == "cached":
+            r = run(row="cached", master=args.master, **kw)
+        else:
+            r = run(row=args.row, fsync_ms=args.fsync_ms,
+                    batch_time_ms=args.batch_time_ms, **kw)
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1

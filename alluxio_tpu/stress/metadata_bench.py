@@ -1,0 +1,257 @@
+"""bench-metadata: metadata control-plane scale-out gates.
+
+Three suite rows, each a ratio against the pre-PR configuration:
+
+- ``metadata-striped`` — mixed CreateFile/GetStatus/ListStatus/Delete
+  across disjoint per-thread subtrees, striped inode locking + journal
+  group commit vs the single tree-wide lock with inline fsync (the
+  pre-PR master).  Gate: >= 3x ops/s.
+- ``metadata-journal-batch`` — CreateFile-only under the same
+  comparison, isolating the durability path.  Gate: >= 1.5x.
+- ``metadata-cached-getstatus`` — warm client-metadata-cache GetStatus
+  vs the uncached RPC round trip on a live in-process cluster.
+  Gate: >= 10x.
+
+The journal rides a **modeled slow fsync** (``--fsync-ms``, default
+3ms — local-disk/NFS class): on tmpfs-backed CI an fsync is nearly
+free, which would understate exactly the serialization the pre-PR
+master suffers on real media.  The model follows the established
+bench practice here (connection-limited worker/UFS models in
+bench-remote-read / bench-ufs-cold).  Gates are RATIOS with wide
+margins, so scheduler jitter moves both sides together.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import shutil
+import sys
+import tempfile
+import time
+from typing import Optional
+
+from alluxio_tpu.journal.system import LocalJournalSystem
+from alluxio_tpu.stress.base import BenchResult, drive, percentiles
+
+
+class _SlowFsyncJournal(LocalJournalSystem):
+    """LocalJournalSystem whose fsync costs ``fsync_s`` extra — the
+    disk model.  Counts fsyncs so batching is observable."""
+
+    def __init__(self, folder: str, fsync_s: float, **kw) -> None:
+        super().__init__(folder, **kw)
+        self.fsync_s = fsync_s
+        self.fsync_count = 0
+
+    def _fsync(self, fd: int) -> None:
+        self.fsync_count += 1
+        if self.fsync_s > 0:
+            time.sleep(self.fsync_s)
+        os.fsync(fd)
+
+
+class _Master:
+    """An in-process FileSystemMaster + journal, pre-PR (coarse +
+    inline fsync) or post-PR (striped + group commit) flavor."""
+
+    def __init__(self, base: str, *, coarse: bool, batched: bool,
+                 fsync_s: float, batch_time_s: float) -> None:
+        from alluxio_tpu.master.block_master import BlockMaster
+        from alluxio_tpu.master.file_master import FileSystemMaster
+
+        self.journal = _SlowFsyncJournal(base, fsync_s)
+        self.journal.start()
+        self.journal.gain_primacy()
+        if batched:
+            self.journal.start_group_commit(batch_time_s)
+        self.block_master = BlockMaster(self.journal)
+        self.fsm = FileSystemMaster(self.block_master, self.journal,
+                                    coarse_locking=coarse)
+        self.fsm.start(None)
+
+    def close(self) -> None:
+        self.fsm.stop()
+        self.journal.stop()
+
+
+def _mixed_body(fsm, threads: int):
+    """Per-thread cycle over its own subtree: create -> stat -> list ->
+    delete.  Disjoint subtrees are the training-shard common case the
+    striping targets."""
+    for t in range(threads):
+        fsm.create_directory(f"/t{t}", recursive=True, allow_exists=True)
+    counters = [itertools.count() for _ in range(threads)]
+
+    def body(t: int, i: int) -> int:
+        j = next(counters[t])
+        seq, phase = j // 4, j % 4
+        if phase == 0:
+            fsm.create_file(f"/t{t}/x-{seq:08d}")
+        elif phase == 1:
+            fsm.get_status(f"/t{t}/x-{seq:08d}")
+        elif phase == 2:
+            fsm.list_status(f"/t{t}")
+        else:
+            fsm.delete(f"/t{t}/x-{seq:08d}")
+        return 0
+
+    return body
+
+
+def _create_body(fsm, threads: int):
+    for t in range(threads):
+        fsm.create_directory(f"/t{t}", recursive=True, allow_exists=True)
+    counters = [itertools.count() for _ in range(threads)]
+
+    def body(t: int, i: int) -> int:
+        fsm.create_file(f"/t{t}/c-{next(counters[t]):09d}")
+        return 0
+
+    return body
+
+
+def _run_mode(make_body, *, coarse: bool, batched: bool, threads: int,
+              duration_s: float, fsync_s: float, batch_time_s: float):
+    base = tempfile.mkdtemp(prefix="atpu_mdbench_")
+    master = _Master(base, coarse=coarse, batched=batched,
+                     fsync_s=fsync_s, batch_time_s=batch_time_s)
+    try:
+        body = make_body(master.fsm, threads)
+        res = drive(threads, body, duration_s=duration_s)
+        return res, master.journal.fsync_count
+    finally:
+        master.close()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def _ratio_row(bench: str, make_body, *, threads: int, duration_s: float,
+               fsync_ms: float, batch_time_ms: float,
+               min_speedup: float) -> BenchResult:
+    t_start = time.monotonic()
+    fsync_s, batch_s = fsync_ms / 1e3, batch_time_ms / 1e3
+    base_res, base_fsyncs = _run_mode(
+        make_body, coarse=True, batched=False, threads=threads,
+        duration_s=duration_s, fsync_s=fsync_s, batch_time_s=batch_s)
+    new_res, new_fsyncs = _run_mode(
+        make_body, coarse=False, batched=True, threads=threads,
+        duration_s=duration_s, fsync_s=fsync_s, batch_time_s=batch_s)
+    speedup = new_res.ops_per_s / base_res.ops_per_s \
+        if base_res.ops_per_s > 0 else 0.0
+    ok = speedup >= min_speedup and base_res.errors == 0 and \
+        new_res.errors == 0
+    if not ok:
+        print(f"[{bench}] speedup {speedup:.2f}x below the "
+              f"{min_speedup}x gate (baseline "
+              f"{base_res.ops_per_s:.0f} ops/s, striped+batched "
+              f"{new_res.ops_per_s:.0f} ops/s, errors "
+              f"{base_res.errors}+{new_res.errors})", file=sys.stderr)
+    return BenchResult(
+        bench=bench,
+        params={"threads": threads, "duration_s": duration_s,
+                "fsync_ms": fsync_ms, "batch_time_ms": batch_time_ms,
+                "min_speedup": min_speedup},
+        metrics={"baseline_ops_per_s": round(base_res.ops_per_s, 1),
+                 "striped_batched_ops_per_s": round(new_res.ops_per_s, 1),
+                 "speedup": round(speedup, 3),
+                 "baseline_fsyncs": base_fsyncs,
+                 "striped_fsyncs": new_fsyncs,
+                 "baseline_" + "p99_us":
+                     percentiles(base_res.latencies_s)["p99_us"],
+                 "striped_p99_us":
+                     percentiles(new_res.latencies_s)["p99_us"],
+                 "gate_ok": ok},
+        errors=0 if ok else 1,
+        duration_s=time.monotonic() - t_start)
+
+
+def run_striped(*, threads: int = 8, duration_s: float = 2.0,
+                fsync_ms: float = 3.0, batch_time_ms: float = 2.0,
+                min_speedup: float = 3.0) -> BenchResult:
+    return _ratio_row("metadata-striped", _mixed_body, threads=threads,
+                      duration_s=duration_s, fsync_ms=fsync_ms,
+                      batch_time_ms=batch_time_ms, min_speedup=min_speedup)
+
+
+def run_journal_batch(*, threads: int = 8, duration_s: float = 2.0,
+                      fsync_ms: float = 3.0, batch_time_ms: float = 2.0,
+                      min_speedup: float = 1.5) -> BenchResult:
+    return _ratio_row("metadata-journal-batch", _create_body,
+                      threads=threads, duration_s=duration_s,
+                      fsync_ms=fsync_ms, batch_time_ms=batch_time_ms,
+                      min_speedup=min_speedup)
+
+
+def run_cached_getstatus(*, master: Optional[str] = None, threads: int = 4,
+                         duration_s: float = 1.5, files: int = 64,
+                         min_speedup: float = 10.0) -> BenchResult:
+    """Warm client-cache GetStatus vs the uncached RPC round trip on a
+    live (in-process by default) cluster."""
+    from alluxio_tpu.conf import Keys
+    from alluxio_tpu.stress.cluster import bench_cluster
+
+    t_start = time.monotonic()
+    with bench_cluster(master, block_size=1 << 20,
+                       worker_mem_bytes=64 << 20,
+                       conf_overrides={
+                           Keys.USER_METADATA_CACHE_ENABLED: True,
+                       }) as (fs, _cluster):
+        from alluxio_tpu.client.streams import WriteType
+
+        base = "/md-cache-bench"
+        fs.create_directory(base, recursive=True, allow_exists=True)
+        paths = [f"{base}/f-{i:04d}" for i in range(files)]
+        for p in paths:
+            fs.write_all(p, b"", write_type=WriteType.MUST_CACHE)
+
+        def uncached(t: int, i: int) -> int:
+            fs.fs_master.get_status(paths[i % files])
+            return 0
+
+        cold = drive(threads, uncached, duration_s=duration_s)
+        for p in paths:  # warm the cache
+            fs.get_status(p)
+        hits0 = fs._md_hits.count
+
+        def cached(t: int, i: int) -> int:
+            fs.get_status(paths[i % files])
+            return 0
+
+        warm = drive(threads, cached, duration_s=duration_s)
+        hits = fs._md_hits.count - hits0
+        try:
+            fs.delete(base, recursive=True)
+        except Exception:  # noqa: BLE001 cleanup is best-effort
+            pass
+    speedup = warm.ops_per_s / cold.ops_per_s if cold.ops_per_s else 0.0
+    # the warm pass must have been served by the CACHE, not by fast RPCs
+    ok = speedup >= min_speedup and hits >= warm.ops and \
+        cold.errors == 0 and warm.errors == 0
+    if not ok:
+        print(f"[metadata-cached-getstatus] speedup {speedup:.2f}x "
+              f"(gate {min_speedup}x), cache hits {hits}/{warm.ops}, "
+              f"errors {cold.errors}+{warm.errors}", file=sys.stderr)
+    return BenchResult(
+        bench="metadata-cached-getstatus",
+        params={"threads": threads, "duration_s": duration_s,
+                "files": files, "min_speedup": min_speedup,
+                "master": master or "in-process"},
+        metrics={"uncached_ops_per_s": round(cold.ops_per_s, 1),
+                 "cached_ops_per_s": round(warm.ops_per_s, 1),
+                 "speedup": round(speedup, 3),
+                 "cache_hits": hits,
+                 "uncached_p99_us": percentiles(cold.latencies_s)["p99_us"],
+                 "cached_p99_us": percentiles(warm.latencies_s)["p99_us"],
+                 "gate_ok": ok},
+        errors=0 if ok else 1,
+        duration_s=time.monotonic() - t_start)
+
+
+def run(*, row: str = "striped", **kw) -> BenchResult:
+    if row == "striped":
+        return run_striped(**kw)
+    if row == "journal":
+        return run_journal_batch(**kw)
+    if row == "cached":
+        return run_cached_getstatus(**kw)
+    raise ValueError(f"unknown metadata bench row {row!r}")

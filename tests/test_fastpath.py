@@ -271,8 +271,9 @@ class TestJournalDeferredScope:
             # applied immediately...
             assert kv.data == {"a": 1, "b": 2}
             # ...but not necessarily durable inside the scope
-        # after scope exit: durable
-        assert j._durable_seq >= 2
+        # after scope exit: durable (every accepted write ticket synced)
+        assert j._write_ticket >= 2
+        assert j._synced_ticket >= j._write_ticket
         j.stop()
 
         j2 = LocalJournalSystem(str(tmp_path / "j"))

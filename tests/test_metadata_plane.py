@@ -1,0 +1,487 @@
+"""Metadata control-plane scale-out tests: striped inode locking,
+journal group commit, and the client metadata cache with master-pushed
+invalidation (docs/metadata.md).
+
+The concurrency tests run under the always-on LockOrderAuditor plugin
+(lint/pytest_lockaudit): any observed lock-order inversion across the
+striped inode locks, the tree lock, the journal commit lock and the
+block-master lock fails the test with both stacks.
+"""
+
+import os
+import threading
+import time
+import random
+
+import pytest
+
+from alluxio_tpu.journal import LocalJournalSystem, NoopJournalSystem
+from alluxio_tpu.master import BlockMaster, FileSystemMaster
+from alluxio_tpu.master.invalidation import MetadataInvalidationLog
+from alluxio_tpu.utils.clock import ManualClock
+from alluxio_tpu.utils.exceptions import (
+    DirectoryNotEmptyError, FileAlreadyExistsError, FileDoesNotExistError,
+    InvalidPathError, JournalClosedError,
+)
+
+BLOCK_SIZE = 1024
+
+#: op races the property test treats as legitimate outcomes of
+#: concurrent interleaving, not failures
+_EXPECTED = (FileAlreadyExistsError, FileDoesNotExistError,
+             InvalidPathError, DirectoryNotEmptyError)
+
+
+def _make_fsm(journal=None):
+    journal = journal or NoopJournalSystem()
+    bm = BlockMaster(journal)
+    m = FileSystemMaster(bm, journal, default_block_size=BLOCK_SIZE)
+    m.start(None)
+    return m
+
+
+@pytest.fixture()
+def fsm():
+    m = _make_fsm()
+    yield m
+    m.stop()
+
+
+# --------------------------------------------------------------------------
+class TestLockedInodePath:
+    def test_basic_ops_striped(self, fsm):
+        assert not fsm.inode_tree.coarse_locking
+        fsm.create_file("/a/b/f", recursive=True)
+        assert fsm.get_status("/a/b/f").path == "/a/b/f"
+        fsm.rename("/a/b/f", "/a/b/g")
+        assert fsm.exists("/a/b/g") and not fsm.exists("/a/b/f")
+        fsm.delete("/a/b/g")
+        assert not fsm.exists("/a/b/g")
+
+    def test_lock_pool_drains(self, fsm):
+        fsm.create_file("/p/q/f", recursive=True)
+        fsm.get_status("/p/q/f")
+        # no operation in flight -> no lock is checked out; the pool may
+        # retain idle locks but every refcount must be zero
+        mgr = fsm.inode_tree.lock_manager
+        with mgr._pool_lock:
+            assert all(ent[1] == 0 for ent in mgr._locks.values())
+
+    def test_write_excludes_subtree_traversal(self, fsm):
+        """A write lock on a directory blocks path traversal into its
+        subtree (readers AND writers) until released — the window in
+        which an operation validates and journals is exclusive."""
+        from alluxio_tpu.utils.uri import AlluxioURI
+
+        fsm.create_file("/d/sub/f", recursive=True)
+        tree = fsm.inode_tree
+        entered, release = threading.Event(), threading.Event()
+
+        def holder():
+            with tree.lock_path(AlluxioURI("/d"), write=True):
+                entered.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        try:
+            assert entered.wait(5.0)
+            got = []
+            r = threading.Thread(
+                target=lambda: got.append(fsm.exists("/d/sub/f")))
+            w = threading.Thread(
+                target=lambda: fsm.create_file("/d/sub/g"))
+            r.start()
+            w.start()
+            r.join(0.2)
+            w.join(0.2)
+            assert r.is_alive(), "reader traversed a write-locked subtree"
+            assert w.is_alive(), "writer entered a write-locked subtree"
+            # a disjoint subtree is NOT blocked — the point of striping
+            fsm.create_file("/elsewhere/x", recursive=True)
+            release.set()
+            r.join(5.0)
+            w.join(5.0)
+            assert got == [True]
+            assert fsm.exists("/d/sub/g")
+        finally:
+            release.set()
+            t.join(5.0)
+        assert not t.is_alive()
+
+    def test_coarse_mode_still_works(self):
+        journal = NoopJournalSystem()
+        bm = BlockMaster(journal)
+        m = FileSystemMaster(bm, journal, default_block_size=BLOCK_SIZE,
+                             coarse_locking=True)
+        m.start(None)
+        try:
+            m.create_file("/x/y", recursive=True)
+            m.rename("/x/y", "/x/z")
+            assert [i.name for i in m.list_status("/x")] == ["z"]
+        finally:
+            m.stop()
+
+    def test_lockaudit_sees_striped_locks(self, fsm):
+        """Satellite proof: the per-inode locks and the tree lock are in
+        the auditor's order graph with the canonical edge direction."""
+        from alluxio_tpu.lint.pytest_lockaudit import observed_edges
+
+        fsm.create_file("/audit/f", recursive=True)
+        edges = observed_edges()
+        assert ("InodeTree.lock", "InodeTree.inode_lock") in edges
+        assert ("InodeTree.inode_lock", "InodeTree.lock") not in edges
+
+
+# --------------------------------------------------------------------------
+class TestConcurrentMetadata:
+    """Parallel create/rename/delete/list over overlapping AND disjoint
+    subtrees: observable results stay linearizable (every surviving path
+    resolves; the store graph is consistent) and the lockaudit plugin
+    asserts zero lock-order inversions on teardown."""
+
+    THREADS = 6
+    OPS = 120
+
+    def _worker(self, fsm, t, errors):
+        rng = random.Random(1000 + t)
+        own = f"/own{t}"
+        try:
+            fsm.create_directory(own, recursive=True, allow_exists=True)
+            for i in range(self.OPS):
+                dice = rng.random()
+                shared = f"/shared/s{rng.randrange(4)}"
+                try:
+                    if dice < 0.30:
+                        fsm.create_file(f"{own}/f-{i}")
+                    elif dice < 0.45:
+                        fsm.create_file(f"{shared}/f-{t}-{i}",
+                                        recursive=True)
+                    elif dice < 0.60:
+                        fsm.rename(f"{own}/f-{i - 1}", f"{own}/r-{i}") \
+                            if i else None
+                    elif dice < 0.70:
+                        fsm.rename(f"{shared}/f-{t}-{i - 1}",
+                                   f"/shared/s{rng.randrange(4)}/m-{t}-{i}")
+                    elif dice < 0.85:
+                        fsm.delete(f"{own}/r-{i - 2}") if i > 1 else None
+                    elif dice < 0.95:
+                        fsm.list_status(shared) \
+                            if rng.random() < 0.5 else \
+                            fsm.list_status(own)
+                    else:
+                        fsm.delete(shared, recursive=True)
+                except _EXPECTED:
+                    pass
+        except BaseException as e:  # noqa: BLE001 surfaced by the test
+            errors.append(e)
+
+    def test_parallel_mixed_ops_consistent(self, fsm):
+        fsm.create_directory("/shared", recursive=True, allow_exists=True)
+        errors = []
+        threads = [threading.Thread(target=self._worker,
+                                    args=(fsm, t, errors))
+                   for t in range(self.THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+            assert not t.is_alive(), "metadata op deadlocked"
+        assert not errors, errors
+        self._check_tree_consistent(fsm)
+
+    def _check_tree_consistent(self, fsm):
+        tree = fsm.inode_tree
+        seen = set()
+        stack = [(tree.root, "")]
+        while stack:
+            inode, path = stack.pop()
+            assert inode.id not in seen, f"cycle at {path}"
+            seen.add(inode.id)
+            for name in tree.child_names(inode):
+                cid = tree._store.get_child_id(inode.id, name)
+                assert cid is not None
+                child = tree.get_inode(cid)
+                assert child is not None, f"dangling edge {path}/{name}"
+                assert child.parent_id == inode.id
+                child_path = f"{path}/{name}"
+                # every reachable path resolves through the public API
+                assert fsm.get_status(child_path).file_id == child.id
+                assert str(tree.get_path(child)) == child_path
+                if child.is_directory:
+                    stack.append((child, child_path))
+                else:
+                    seen.add(child.id)
+        assert len(seen) == tree.inode_count, \
+            f"walked {len(seen)} inodes, count says {tree.inode_count}"
+
+
+# --------------------------------------------------------------------------
+def _scripted_ops(fsm):
+    """A deterministic op sequence touching every journaled mutation."""
+    fsm.create_directory("/dirs/a", recursive=True)
+    for i in range(8):
+        fsm.create_file(f"/dirs/a/f{i}", ttl=3_600_000 if i % 3 == 0 else -1)
+    fsm.rename("/dirs/a/f0", "/dirs/a/g0")
+    fsm.delete("/dirs/a/f1")
+    fsm.set_attribute("/dirs/a/f2", pinned=True)
+    fsm.set_acl("/dirs/a/f3", ["user:alice:rwx"])
+    bid = fsm.get_new_block_id_for_file("/dirs/a/f4")
+    assert bid
+    fsm.complete_file("/dirs/a/f4", length=123)
+    fsm.create_file("/dirs/a/f2.v2", recursive=True)
+
+
+class TestJournalGroupCommit:
+    def test_replay_equivalence_batched_vs_unbatched(self, tmp_path):
+        """The SAME op sequence journaled with and without the
+        group-commit flusher replays to identical trees."""
+        snaps = []
+        for mode, batched in (("inline", False), ("batched", True)):
+            d = str(tmp_path / mode)
+            journal = LocalJournalSystem(d)
+            journal.start()
+            journal.gain_primacy()
+            if batched:
+                journal.start_group_commit(0.001)
+            bm = BlockMaster(journal)
+            fsm = FileSystemMaster(bm, journal, clock=ManualClock(),
+                                   default_block_size=BLOCK_SIZE)
+            fsm.start(None)
+            _scripted_ops(fsm)
+            fsm.stop()
+            journal.stop()
+            # replay from disk into a FRESH stack
+            j2 = LocalJournalSystem(d)
+            bm2 = BlockMaster(j2)
+            fsm2 = FileSystemMaster(bm2, j2, clock=ManualClock(),
+                                    default_block_size=BLOCK_SIZE)
+            j2.standby_start()
+            snaps.append(fsm2.inode_tree.snapshot())
+            assert fsm2.exists("/dirs/a/g0")
+            assert not fsm2.exists("/dirs/a/f1")
+            j2.stop()
+
+        def _norm(snap):
+            return (snap["root_id"],
+                    sorted(map(tuple, (sorted(d.items())
+                                       for d in snap["inodes"]))))
+
+        assert _norm(snaps[0]) == _norm(snaps[1])
+
+    def test_ack_waits_for_fsync(self, tmp_path):
+        """A mutating op must not return before its batch's fsync — the
+        acknowledged-durability point is unchanged by batching."""
+        gate = threading.Event()
+        fsyncs = []
+
+        class BlockingFsync(LocalJournalSystem):
+            def _fsync(self, fd):
+                fsyncs.append(time.monotonic())
+                assert gate.wait(10.0)
+                os.fsync(fd)
+
+        journal = BlockingFsync(str(tmp_path / "j"))
+        journal.start()
+        journal.gain_primacy()
+        gate.set()            # boot-time rotation fsyncs pass through
+        journal.start_group_commit(0.001)
+        bm = BlockMaster(journal)
+        fsm = FileSystemMaster(bm, journal, default_block_size=BLOCK_SIZE)
+        fsm.start(None)
+        gate.clear()          # now hold the flusher's fsync hostage
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(fsm.create_file("/held")))
+        t.start()
+        t.join(0.5)
+        assert t.is_alive(), "create returned before its fsync completed"
+        assert not done
+        gate.set()
+        t.join(10.0)
+        assert not t.is_alive() and len(done) == 1
+        fsm.stop()
+        journal.stop()
+
+    def test_fsync_failure_fails_the_op(self, tmp_path):
+        """Crash-point: if the batch's fsync fails, the client sees an
+        error — never a success whose journal batch didn't reach disk."""
+        armed = []
+
+        class FailingFsync(LocalJournalSystem):
+            def _fsync(self, fd):
+                if armed:
+                    raise OSError(5, "injected fsync failure")
+                os.fsync(fd)
+
+        journal = FailingFsync(str(tmp_path / "j"))
+        journal.start()
+        journal.gain_primacy()
+        journal.start_group_commit(0.001)
+        bm = BlockMaster(journal)
+        fsm = FileSystemMaster(bm, journal, default_block_size=BLOCK_SIZE)
+        fsm.start(None)
+        armed.append(True)
+        with pytest.raises(JournalClosedError):
+            fsm.create_file("/doomed")
+        # the journal is latched broken: later ops fail fast too
+        with pytest.raises(JournalClosedError):
+            fsm.create_file("/also-doomed")
+        armed.clear()
+        journal.stop()
+
+    def test_bounded_commit_queue(self, tmp_path):
+        journal = LocalJournalSystem(str(tmp_path / "j"))
+        journal.COMMIT_QUEUE_MAX_ENTRIES = 4
+        journal.start()
+        journal.gain_primacy()
+        journal.start_group_commit(0.0)
+        bm = BlockMaster(journal)
+        fsm = FileSystemMaster(bm, journal, default_block_size=BLOCK_SIZE)
+        fsm.start(None)
+        for i in range(40):  # far past the queue bound
+            fsm.create_file(f"/q{i}")
+        with journal._lock:
+            assert journal._commit_queue_entries <= 4
+        fsm.stop()
+        journal.stop()
+
+
+# --------------------------------------------------------------------------
+class TestInvalidationLog:
+    def test_versions_and_since(self):
+        log = MetadataInvalidationLog(capacity=16)
+        assert log.since(None)["reset"] is True
+        v1 = log.append("/a")
+        v2 = log.append("/b")
+        assert v2 == v1 + 1
+        out = log.since(v1)
+        assert out == {"to": v2, "prefixes": ["/b"], "reset": False}
+        assert log.since(v2)["prefixes"] == []
+
+    def test_overflow_resets(self):
+        log = MetadataInvalidationLog(capacity=16)
+        v0 = log.append("/base")
+        for i in range(50):
+            log.append(f"/p{i}")
+        out = log.since(v0)
+        assert out["reset"] is True
+        assert out["to"] == log.version
+
+    def test_append_counts_metric(self):
+        from alluxio_tpu.metrics import metrics
+
+        before = metrics().counter("Master.MetadataCacheInvalidations").count
+        MetadataInvalidationLog().append("/m")
+        after = metrics().counter("Master.MetadataCacheInvalidations").count
+        assert after == before + 1
+
+
+class TestClientMetadataCache:
+    def _cache(self, max_size=4, ttl=60.0):
+        from alluxio_tpu.client.file_system import _MetadataCache
+
+        return _MetadataCache(max_size, ttl)
+
+    def test_lru_bound(self):
+        c = self._cache(max_size=2)
+        c.put("/a", "A", 1)
+        c.put("/b", "B", 1)
+        c.get("/a")            # /a becomes MRU
+        c.put("/c", "C", 1)    # evicts /b
+        assert c.get("/a") == "A"
+        assert c.get("/b") is None
+        assert c.get("/c") == "C"
+
+    def test_push_prefix_invalidation(self):
+        c = self._cache()
+        c.put("/d/x", "X", 1)
+        c.put_listing("/d", ["X"], 1)
+        c.put("/d/sub/y", "Y", 1)
+        c.put("/other", "O", 1)
+        n = c.apply_push({"to": 5, "prefixes": ["/d/x"], "reset": False})
+        assert n == 1
+        assert c.get("/d/x") is None
+        assert c.get_listing("/d") is None       # parent listing dropped
+        assert c.get("/other") == "O"
+        assert c.applied_version == 5
+
+    def test_stale_stamp_rejected(self):
+        c = self._cache()
+        c.apply_push({"to": 10, "prefixes": [], "reset": False})
+        c.put("/late", "stale", 7)     # predates applied invalidations
+        assert c.get("/late") is None
+        c.put("/fresh", "ok", 10)
+        assert c.get("/fresh") == "ok"
+
+    def test_reset_clears(self):
+        c = self._cache()
+        c.put("/a", "A", 1)
+        c.apply_push({"to": 99, "prefixes": [], "reset": True})
+        assert c.get("/a") is None
+        assert c.applied_version == 99
+
+
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+class TestPushInvalidationE2E:
+    def test_two_clients_converge_via_heartbeat(self, tmp_path):
+        """Client 1 caches a status; client 2 renames the file; client
+        1's next heartbeat delivers the invalidation and its next read
+        reflects the rename — no TTL expiry involved."""
+        from alluxio_tpu.conf import Keys
+        from alluxio_tpu.minicluster import LocalCluster
+
+        with LocalCluster(str(tmp_path), num_workers=1,
+                          conf_overrides={
+                              Keys.USER_METADATA_CACHE_ENABLED: True,
+                              Keys.USER_METADATA_CACHE_EXPIRATION_TIME:
+                                  "1h",  # push, not TTL, must do the work
+                          }) as cluster:
+            c1 = cluster.file_system()
+            c2 = cluster.file_system()
+            try:
+                c1.write_all("/watched", b"")
+                c1.send_metrics()            # establish the version floor
+                assert c1._md_cache.applied_version is not None
+                st = c1.get_status("/watched")
+                assert st is not None
+                assert c1.get_status("/watched") is st  # cache hit
+                c2.rename("/watched", "/moved")
+                # stale until the push lands — TTL is 1h, so only the
+                # heartbeat can fix this
+                assert c1.get_status("/watched") is st
+                c1.send_metrics()
+                with pytest.raises(FileDoesNotExistError):
+                    c1.get_status("/watched")
+                assert c1.get_status("/moved").path == "/moved"
+            finally:
+                c1.close()
+                c2.close()
+
+
+@pytest.mark.slow
+class TestMetastoreWiring:
+    @pytest.mark.parametrize("kind", ["SQLITE", "CACHING"])
+    def test_non_heap_metastore_serves_namespace(self, tmp_path, kind):
+        from alluxio_tpu.master.metastore import (
+            CachingInodeStore, SqliteInodeStore, create_inode_store,
+        )
+
+        store = create_inode_store(kind, str(tmp_path / "ms"),
+                                   cache_size=8)
+        assert isinstance(store, (SqliteInodeStore, CachingInodeStore))
+        journal = NoopJournalSystem()
+        bm = BlockMaster(journal)
+        fsm = FileSystemMaster(bm, journal, inode_store=store,
+                               default_block_size=BLOCK_SIZE)
+        fsm.start(None)
+        try:
+            for i in range(20):  # spill past the CACHING bound of 8
+                fsm.create_file(f"/ms/f{i}", recursive=True)
+            names = sorted(i.name for i in fsm.list_status("/ms"))
+            assert names == sorted(f"f{i}" for i in range(20))
+            fsm.rename("/ms/f0", "/ms/zz")
+            assert fsm.exists("/ms/zz")
+        finally:
+            fsm.stop()

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata sdist clean lint lint-changed lint-docs
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata bench-ha sdist clean lint lint-changed lint-docs
 
 lint:  ## atpu-lint: conf-key/metric-name/lock/exception discipline (<30s budget)
 	$(PY) -m alluxio_tpu.lint --budget-s 30
@@ -53,6 +53,9 @@ bench-metadata:  ## metadata control plane: striped-vs-single-lock >=3x, batched
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row striped
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row journal
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row cached
+
+bench-ha:  ## HA failover drill: MTTR <= 2 election timeouts, zero acked-write loss, standby staleness contract
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress ha
 
 sdist:
 	$(PY) -m build --sdist 2>/dev/null || $(PY) setup.py sdist

@@ -157,6 +157,22 @@ class FileSystem:
 
         md = tuple(client_metadata(self._conf))
         fp_dir = self._conf.get(Keys.MASTER_FASTPATH_DIR)
+        # HA: when the caller-supplied address names a member of the
+        # conf master list (atpu.master.rpc.addresses), widen to the
+        # whole list so every client path — metadata, block, and the
+        # metrics heartbeat — rides leader redirects and rotation
+        # across the quorum (docs/ha.md).  An explicit address OUTSIDE
+        # the list wins untouched: attaching to a specific master (or
+        # another cluster) must not be silently rerouted by site conf.
+        conf_list = [a.strip() for a in
+                     str(self._conf.get(Keys.MASTER_RPC_ADDRESSES)
+                         or "").split(",") if a.strip()]
+        given = [a.strip() for a in str(master_address).split(",")
+                 if a.strip()]
+        if conf_list and (not given or set(given) <= set(conf_list)):
+            addresses = ",".join(conf_list)
+        else:
+            addresses = str(master_address)
         # retry budget from conf (atpu.user.rpc.retry.duration):
         # overload drills shorten it so a flooded client gives up fast
         # instead of stacking 30s of backoff behind a shedding master
@@ -167,12 +183,14 @@ class FileSystem:
                 Keys.USER_RPC_RETRY_BASE_SLEEP),
             max_sleep_s=self._conf.get_duration_s(
                 Keys.USER_RPC_RETRY_MAX_SLEEP))
-        self.fs_master = FsMasterClient(master_address, metadata=md,
-                                        fastpath_dir=fp_dir, **retry_kw)
-        self.block_master = BlockMasterClient(master_address, metadata=md,
+        self.fs_master = FsMasterClient(
+            addresses, metadata=md, fastpath_dir=fp_dir,
+            standby_reads=self._conf.get_bool(
+                Keys.USER_STANDBY_READS_ENABLED), **retry_kw)
+        self.block_master = BlockMasterClient(addresses, metadata=md,
                                               fastpath_dir=fp_dir,
                                               **retry_kw)
-        self.meta_master = MetaMasterClient(master_address, metadata=md,
+        self.meta_master = MetaMasterClient(addresses, metadata=md,
                                             fastpath_dir=fp_dir,
                                             **retry_kw)
         identity = TieredIdentity.from_spec(
@@ -207,7 +225,7 @@ class FileSystem:
 
                 # short retry: an offline master must not stall client
                 # construction for the full 30s default retry window
-                quick = MetaMasterClient(master_address, metadata=md,
+                quick = MetaMasterClient(addresses, metadata=md,
                                          retry_duration_s=1.0)
                 resp = quick.get_configuration()
                 self._conf.merge(resp["properties"], Source.CLUSTER_DEFAULT)

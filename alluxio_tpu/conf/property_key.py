@@ -374,6 +374,22 @@ class Keys:
         scope=Scope.MASTER,
         description="Run the master fault-tolerant: file-lock election on "
                     "the shared journal dir, standby tailing until primacy.")
+    MASTER_HA_STANDBY_READS_ENABLED = _k(
+        "atpu.master.ha.standby.reads.enabled", KeyType.BOOL, default=True,
+        scope=Scope.MASTER,
+        description="Standby masters serve GetStatus/ListStatus/Exists "
+                    "off their tailing journal apply, stamped with the "
+                    "standby's own (journal-deterministic) md_version; "
+                    "every other RPC is refused with a typed "
+                    "NotPrimaryError carrying the current leader hint "
+                    "(docs/ha.md).")
+    MASTER_HA_PUBLISH_INTERVAL = _k(
+        "atpu.master.ha.publish.interval", KeyType.DURATION, default="1s",
+        scope=Scope.MASTER,
+        description="How often an HA master publishes its row (role, "
+                    "applied sequence, term) into the shared-journal "
+                    "master registry backing `fsadmin report masters` "
+                    "and the quorum-degraded health rule.")
     MASTER_WEB_PORT = _k("atpu.master.web.port", KeyType.INT, default=19999)
     MASTER_WEB_ENABLED = _k(
         "atpu.master.web.enabled", KeyType.BOOL, default=False,
@@ -683,6 +699,17 @@ class Keys:
         scope=Scope.CLIENT)
     USER_SHORT_CIRCUIT_ENABLED = _k("atpu.user.short.circuit.enabled", KeyType.BOOL,
                                     default=True, scope=Scope.CLIENT)
+    USER_STANDBY_READS_ENABLED = _k(
+        "atpu.user.standby.reads.enabled", KeyType.BOOL, default=False,
+        scope=Scope.CLIENT,
+        description="Route read-marked metadata RPCs (GetStatus/"
+                    "ListStatus/Exists) round-robin across the standby "
+                    "masters of atpu.master.rpc.addresses instead of "
+                    "the primary; responses carry the standby's "
+                    "md_version stamp so the client metadata cache "
+                    "stays coherent (docs/ha.md).  Requires "
+                    "atpu.master.ha.standby.reads.enabled on the "
+                    "masters.")
     USER_STREAMING_READER_CHUNK_SIZE = _k(
         "atpu.user.streaming.reader.chunk.size.bytes", KeyType.BYTES, default="1MB",
         scope=Scope.CLIENT)

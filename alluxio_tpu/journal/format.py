@@ -86,6 +86,12 @@ class EntryType:
     PERSIST_FILE = "persist_file"
     ASYNC_PERSIST_REQUEST = "async_persist_request"
     UPDATE_UFS_MODE = "update_ufs_mode"
+    #: client-cache invalidation with no metadata entry of its own
+    #: (block-location drift: worker loss/quarantine, re-replication,
+    #: free) — journaled so the invalidation-log version stays a pure
+    #: function of the applied journal on primary AND standbys
+    #: (docs/ha.md)
+    INVALIDATE_PATH = "invalidate_path"
     # block.proto equivalents
     BLOCK_CONTAINER_ID = "block_container_id"
     BLOCK_INFO = "block_info"

@@ -35,6 +35,7 @@ Raft to "keep Raft (etcd-style)" on the host network).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import random
@@ -284,12 +285,33 @@ class RaftNode:
         self.peers = {nid: addr for nid, addr in peers.items()
                       if nid != node_id}
         self.quorum_size = (len(peers) // 2) + 1
+        #: deterministic election-timeout stagger by member rank: after a
+        #: leader death every survivor's randomized timeout starts from
+        #: the same instant, and a scheduler stall (GIL pause, CI noise)
+        #: can land two draws inside one RPC round trip — a split vote
+        #: that costs a full extra election round.  Offsetting each
+        #: member by rank * 15% of the band makes the lowest-ranked
+        #: survivor usually campaign first and win clean, while the
+        #: random draw still decorrelates equal-rank restarts.
+        self._rank = sorted(peers).index(node_id) if node_id in peers else 0
         self.log = RaftLog(os.path.join(folder, "raft", node_id))
         self._folder = folder
         self._apply_fn = apply_fn or (lambda e: None)
         self._snapshot_fn = snapshot_fn or (lambda: {})
         self._restore_fn = restore_fn or (lambda s: None)
         self._snapshot_period = snapshot_period_entries
+        #: optional context-manager factory held around each apply-loop
+        #: batch (follower replication; leader barrier/orphan records).
+        #: A standby that serves reads installs the inode tree's write
+        #: lock: the apply loop holds no inode-path locks, so a served
+        #: read could otherwise observe a torn multi-step apply.
+        #: Acquired BEFORE _state_lock/lock — the same tree-first order
+        #: the propose path uses — so no lock cycle forms.  The
+        #: propose-wait apply path stays unwrapped: there the proposing
+        #: RPC thread already holds the path's write locks (and holds
+        #: the tree READ lock, which this write lock must not wait on
+        #: from the same thread).
+        self.apply_exclusion = None
 
         self.state = FOLLOWER
         self.leader_id: Optional[str] = None
@@ -335,7 +357,24 @@ class RaftNode:
         #: shims here; the MultiProcessCluster exercises real
         #: network failures, this seam covers asymmetric partitions)
         self.transport = _peer_call
+        #: monotonic stamp of each peer's last successful RPC response —
+        #: quorum_info serves it as last_contact_s, and the HA health
+        #: sampling counts "live" members from it
+        self.peer_contact: Dict[str, float] = {}
         self._step_down_cbs: List = []
+
+    def _call_peer(self, addr: str, method: str, req: dict,
+                   timeout: float):
+        """Peer RPC via the injectable transport, behind the chaos
+        injector's partition gate (outbound-only dropping cuts the link
+        both ways — responses ride the same call)."""
+        from alluxio_tpu.utils import faults
+
+        if faults.armed() and \
+                faults.injector().link_blocked(self.node_id, addr):
+            raise ConnectionError(
+                f"injected partition {self.node_id} -/- {addr}")
+        return self.transport(addr, method, req, timeout=timeout)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -451,7 +490,9 @@ class RaftNode:
     # -- elections -----------------------------------------------------------
     def _reset_election_deadline(self) -> None:
         lo, hi = self._election_timeout_ms
-        self._deadline = time.monotonic() + random.uniform(lo, hi) / 1000.0
+        stagger = self._rank * 0.15 * (hi - lo)
+        self._deadline = time.monotonic() + \
+            (random.uniform(lo, hi) + stagger) / 1000.0
 
     def _timer_loop(self) -> None:
         while True:
@@ -467,7 +508,16 @@ class RaftNode:
                 time.sleep(self._heartbeat_ms / 1000.0)
             else:
                 if expired:
-                    self._start_election()
+                    from alluxio_tpu.utils import faults
+
+                    if faults.armed() and faults.injector() \
+                            .election_frozen(self.node_id):
+                        # chaos: sit this one out (still votes) — the
+                        # drill decides who may win the next election
+                        with self.lock:
+                            self._reset_election_deadline()
+                    else:
+                        self._start_election()
                 time.sleep(0.02)
 
     def _start_election(self, *, force: bool = False) -> None:
@@ -500,9 +550,10 @@ class RaftNode:
 
         def ask(addr):
             try:
-                resp = self.transport(addr, "request_vote", {
+                resp = self._call_peer(addr, "request_vote", {
                     "term": term, "candidate_id": self.node_id,
                     "last_log_index": last_idx, "last_log_term": last_term,
+                    "force": force,
                 }, timeout=self._election_timeout_ms[0] / 1000.0)
             except Exception:  # noqa: BLE001 peer down: no vote
                 return
@@ -547,7 +598,7 @@ class RaftNode:
 
         def ask(addr):
             try:
-                resp = self.transport(addr, "request_vote", {
+                resp = self._call_peer(addr, "request_vote", {
                     "term": term, "candidate_id": self.node_id,
                     "last_log_index": last_idx, "last_log_term": last_term,
                     "pre_vote": True,
@@ -617,6 +668,21 @@ class RaftNode:
         if req.get("pre_vote"):
             return self._handle_pre_vote(req)
         with self.lock:
+            if not req.get("force") and req["term"] > self.log.term:
+                # Leader stickiness for REAL votes too (Raft §4.2.3):
+                # pre-vote gates a candidate on ITS view, but a candidate
+                # that passed pre-vote just before a leader emerged can
+                # still depose the fresh leader and churn terms (observed
+                # as back-to-back step-downs after a failover).  While we
+                # hear a live leader — or ARE one — ignore the candidate
+                # without adopting its term; a legitimately newer leader
+                # still flips us via AppendEntries, and leadership
+                # transfer (TimeoutNow) bypasses with ``force``.
+                lo_s = self._election_timeout_ms[0] / 1000.0
+                leader_fresh = self.state == LEADER or \
+                    (time.monotonic() - self._last_leader_contact) < lo_s
+                if leader_fresh:
+                    return {"term": self.log.term, "granted": False}
             if req["term"] > self.log.term:
                 self._become_follower(req["term"], None)
             granted = False
@@ -786,7 +852,7 @@ class RaftNode:
             if not caught_up:
                 return False  # abort: no TimeoutNow at a lagging target
             try:
-                self.transport(addr, "timeout_now",
+                self._call_peer(addr, "timeout_now",
                                {"term": term, "leader_id": self.node_id},
                                timeout=2.0)
             except Exception:  # noqa: BLE001 target unreachable
@@ -838,16 +904,23 @@ class RaftNode:
         return {"ok": True}
 
     def quorum_info(self) -> dict:
+        now = time.monotonic()
         with self.lock:
             members = [{"node_id": self.node_id, "address": "self",
                         "role": self.state,
-                        "match_index": self.log.last_index}]
+                        "match_index": self.log.last_index,
+                        "last_contact_s": 0.0}]
             for nid, addr in self.peers.items():
+                at = self.peer_contact.get(nid)
                 members.append({
                     "node_id": nid, "address": addr,
                     "role": "LEADER" if nid == self.leader_id else "UNKNOWN"
                     if self.state != LEADER else "FOLLOWER",
-                    "match_index": self.match_index.get(nid, 0)})
+                    "match_index": self.match_index.get(nid, 0),
+                    # None = never heard from (or we are not the leader,
+                    # so we do not probe peers at all)
+                    "last_contact_s": None if at is None
+                    else max(0.0, now - at)})
             return {"leader": self.leader_id, "term": self.log.term,
                     "commit_index": self.commit_index, "members": members}
 
@@ -979,9 +1052,10 @@ class RaftNode:
                         # take one, then retry with it available
                         self.take_snapshot()
                         continue
-                    resp = self.transport(addr, "install_snapshot", {
+                    resp = self._call_peer(addr, "install_snapshot", {
                         "term": term, "leader_id": self.node_id,
                         "snapshot": payload}, timeout=10.0)
+                    self.peer_contact[nid] = time.monotonic()
                     with self.lock:
                         if resp["term"] > self.log.term:
                             self._become_follower(resp["term"], None)
@@ -990,13 +1064,16 @@ class RaftNode:
                             self.match_index[nid] = payload["index"]
                             self.next_index[nid] = payload["index"] + 1
                     continue
-                resp = self.transport(addr, "append_entries", {
+                resp = self._call_peer(addr, "append_entries", {
                     "term": term, "leader_id": self.node_id,
                     "prev_index": prev, "prev_term": prev_term,
                     "records": recs, "leader_commit": commit,
                 }, timeout=2.0)
             except Exception:  # noqa: BLE001 peer unreachable: retry later
                 continue
+            # any decoded reply is proof of life (quorum view + the
+            # quorum-degraded health sampling read this)
+            self.peer_contact[nid] = time.monotonic()
             with self.lock:
                 if resp["term"] > self.log.term:
                     self._become_follower(resp["term"], None)
@@ -1020,10 +1097,20 @@ class RaftNode:
         """Applies committed NON-local records in order (replication on
         followers; barrier records and orphaned batches on leaders).
         Records whose proposer is live-waiting are left to that thread."""
+        from alluxio_tpu.utils import faults
+
         while True:
             with self.lock:
                 rec = None
                 while not self._stopped:
+                    if faults.armed() and faults.injector() \
+                            .tailer_frozen(self.node_id):
+                        # chaos tailer-freeze, Raft flavor: commit may
+                        # advance but this member stops APPLYING — its
+                        # served md_version stalls, exactly the standby
+                        # staleness drill
+                        self.apply_cv.wait(timeout=0.05)
+                        continue
                     if self.applied_index < self.commit_index:
                         nxt = self.log.get(self.applied_index + 1)
                         if nxt is not None and \
@@ -1033,25 +1120,36 @@ class RaftNode:
                     self.apply_cv.wait(timeout=0.5)
                 if self._stopped:
                     return
+                was_leader = self.state == LEADER
             # apply under _state_lock -> lock (same order as propose /
             # take_snapshot); re-verify the record is still the next one
             # (a conflict truncation may have replaced it while unlocked)
             snap_due = False
-            with self._state_lock:
-                with self.lock:
-                    if self._stopped:
-                        return
-                    if self.log.get(self.applied_index + 1) is not rec:
-                        continue
-                    for e in rec.entries:
-                        self._apply_fn(e)
-                        self.applied_seq = max(self.applied_seq, e.sequence)
-                        self._entries_since_snapshot += 1
-                    self.applied_index = rec.index
-                    self.commit_cv.notify_all()
-                    self.apply_cv.notify_all()
-                    snap_due = self._entries_since_snapshot >= \
-                        self._snapshot_period
+            # FOLLOWERS ONLY: a leader applying an orphan/barrier record
+            # must not wait on the tree write lock — a live-waiting
+            # proposer holds the tree READ lock until this very record
+            # applies, a cross-thread cycle that would stall every write
+            # for the propose timeout.  Leaders have no standby readers
+            # to exclude anyway; the rare just-deposed race (one batch
+            # applied unexcluded) closes on the next loop iteration.
+            excl = self.apply_exclusion if not was_leader else None
+            with (excl() if excl is not None else contextlib.nullcontext()):
+                with self._state_lock:
+                    with self.lock:
+                        if self._stopped:
+                            return
+                        if self.log.get(self.applied_index + 1) is not rec:
+                            continue
+                        for e in rec.entries:
+                            self._apply_fn(e)
+                            self.applied_seq = max(self.applied_seq,
+                                                   e.sequence)
+                            self._entries_since_snapshot += 1
+                        self.applied_index = rec.index
+                        self.commit_cv.notify_all()
+                        self.apply_cv.notify_all()
+                        snap_due = self._entries_since_snapshot >= \
+                            self._snapshot_period
             if snap_due:
                 try:
                     self.take_snapshot()

@@ -333,7 +333,12 @@ class LocalJournalSystem(JournalSystem):
 
     def _fsync(self, fd: int) -> None:
         """The one fsync choke point (tests/benches override to model
-        slow devices or crash windows)."""
+        slow devices; the chaos injector's ``fsync_errors`` countdown
+        fails the next N syncs here — the ack-durability crash drill)."""
+        from alluxio_tpu.utils import faults
+
+        if faults.armed() and faults.injector().take_fsync_error():
+            raise OSError("injected journal fsync failure")
         os.fsync(fd)
 
     def is_primary(self) -> bool:
@@ -411,6 +416,13 @@ class LocalJournalSystem(JournalSystem):
         with self._lock:
             if self._closed:
                 raise JournalClosedError("journal is closed")
+            if self._file is None:
+                # tail-only (standby) or not yet primary: sequences are
+                # assigned by the primary.  Allocating here would bump
+                # _seq past entries we have not tailed, and catch_up
+                # would then silently SKIP the primary's real entries
+                # at those sequences — fail the write attempt instead.
+                raise JournalClosedError("journal not open for writes")
             self._seq += 1
             return JournalEntry(self._seq, entry_type, payload)
 

@@ -132,6 +132,25 @@ class BlockMaster(Journaled):
         #: metrics heartbeat alone is not: a worker whose block-sync
         #: thread is wedged keeps shipping metrics while serving nothing)
         self.registered_worker_listeners: List = []
+        #: listeners fired (OUTSIDE the lock) with a batch of block ids
+        #: whose LOCATIONS drifted — worker loss, quarantine/release, a
+        #: re-replicated copy landing.  The master process routes these
+        #: into the metadata invalidation log so client caches repair on
+        #: the next heartbeat instead of waiting out their TTL
+        #: (docs/ha.md; ROADMAP "location drift repairs only on TTL")
+        self.location_change_listeners: List = []
+
+    def _notify_location_change(self, block_ids: List[int]) -> None:
+        """Fire location-drift listeners; caller must NOT hold the lock
+        (listeners resolve block->path through the inode tree)."""
+        if not block_ids:
+            return
+        for listener in self.location_change_listeners:
+            try:
+                listener(block_ids)
+            except Exception:  # noqa: BLE001 - one bad hook must not block
+                LOG.warning("location-change listener failed",
+                            exc_info=True)
 
     #: container ids are journaled as a high-water mark in chunks of this
     #: size: one BLOCK_CONTAINER_ID entry covers the next N allocations,
@@ -290,6 +309,7 @@ class BlockMaster(Journaled):
         self.prune_device_reports()
         now = self._clock.millis()
         newly_lost: List[MasterWorkerInfo] = []
+        drifted: List[int] = []
         with self._lock:
             for wid, info in list(self._workers.items()):
                 if now - info.last_contact_ms > self._worker_timeout_ms:
@@ -301,6 +321,7 @@ class BlockMaster(Journaled):
                     self._quarantined.pop(wid, None)
                     info.registered = False
                     self._refresh_top_tiers()
+                    drifted.extend(info.blocks)
                     for bid in list(info.blocks):
                         self._remove_location(bid, wid)
                     info.blocks.clear()
@@ -312,6 +333,7 @@ class BlockMaster(Journaled):
                 except Exception:  # noqa: BLE001 - one bad hook must not block detection
                     LOG.warning("lost-worker listener failed for %s",
                                 info.id, exc_info=True)
+        self._notify_location_change(drifted)
         return [i.id for i in newly_lost]
 
     def worker_id_for_source(self, source: str) -> Optional[int]:
@@ -337,7 +359,9 @@ class BlockMaster(Journaled):
                 return False
             self._quarantined[worker_id] = self._clock.millis()
             self.location_version += 1
-            return True
+            drifted = list(self._workers[worker_id].blocks)
+        self._notify_location_change(drifted)
+        return True
 
     def release_worker(self, worker_id: int) -> bool:
         """Lift a quarantine (probation passed, or operator override)."""
@@ -345,7 +369,10 @@ class BlockMaster(Journaled):
             if self._quarantined.pop(worker_id, None) is None:
                 return False
             self.location_version += 1
-            return True
+            info = self._workers.get(worker_id)
+            drifted = list(info.blocks) if info is not None else []
+        self._notify_location_change(drifted)
+        return True
 
     def quarantined_workers(self) -> Dict[int, int]:
         """worker id -> quarantine start (ms since epoch)."""
@@ -367,6 +394,7 @@ class BlockMaster(Journaled):
             self._lost_workers[worker_id] = info
             info.registered = False
             self._refresh_top_tiers()
+            drifted = list(info.blocks)
             for bid in list(info.blocks):
                 self._remove_location(bid, worker_id)
             info.blocks.clear()
@@ -376,6 +404,7 @@ class BlockMaster(Journaled):
             except Exception:  # noqa: BLE001 - one bad hook must not block removal
                 LOG.warning("lost-worker listener failed for %s",
                             info.id, exc_info=True)
+        self._notify_location_change(drifted)
 
     # --------------------------------------------------------------- blocks
     def commit_block(self, worker_id: int, used_bytes_on_tier: int,
@@ -385,12 +414,21 @@ class BlockMaster(Journaled):
         with self._journal.create_context() as ctx:
             ctx.append(EntryType.BLOCK_INFO,
                        {"block_id": block_id, "length": length})
+        drift = False
         with self._lock:
             info = self._workers.get(worker_id)
             if info is not None:
+                # an ADDITIONAL replica landing (re-replication after a
+                # quarantine/loss) is location drift other clients'
+                # caches should hear about; the FIRST copy is the
+                # writing client's own business
+                locs = self._locations.get(block_id)
+                drift = bool(locs) and worker_id not in locs
                 info.blocks[block_id] = tier_alias
                 info.used_bytes_on_tiers[tier_alias] = used_bytes_on_tier
                 self._add_location(block_id, worker_id, tier_alias)
+        if drift:
+            self._notify_location_change([block_id])
 
     def commit_block_in_ufs(self, block_id: int, length: int) -> None:
         """Block persisted directly to UFS with no cached copy."""

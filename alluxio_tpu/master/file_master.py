@@ -101,10 +101,21 @@ class FileSystemMaster:
 
         #: versioned push-invalidation log for client metadata caches;
         #: GetStatus/ListStatus stamps and the metrics-heartbeat
-        #: piggyback both read it (docs/metadata.md)
+        #: piggyback both read it (docs/metadata.md).  Fed from the
+        #: JOURNAL APPLY path (inode-tree + mount-table sinks below),
+        #: never from the RPC methods, so a tailing standby counts the
+        #: exact md_version sequence the primary stamps and standby-
+        #: served reads stay inside the cache coherence contract
+        #: (docs/ha.md).
         self.invalidations = MetadataInvalidationLog()
+        self.inode_tree.invalidation_sink = self.invalidations.append
+        # the tree also carries the log's version through checkpoint
+        # snapshot/restore: a bootstrap-from-checkpoint must not restart
+        # the count the skipped entries already advanced
+        self.inode_tree.invalidation_log = self.invalidations
         journal.register(self.inode_tree)
-        journal.register(_MountTableJournal(self.mount_table))
+        journal.register(_MountTableJournal(
+            self.mount_table, invalidation_sink=self.invalidations.append))
         #: paths with in-flight async persist (file id -> alluxio path)
         self._persist_requests: "set[int]" = set()
         # serializes persist commits' UFS IO (see commit_persist)
@@ -531,7 +542,6 @@ class FileSystemMaster:
                 self._inherit_default_acl(prev, inode)
                 ctx.append(EntryType.INODE_FILE, inode.to_wire_dict())
             self._absent_cache.remove(uri.path)
-            self.invalidations.append(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def create_directory(self, path: "str | AlluxioURI", *,
@@ -575,7 +585,6 @@ class FileSystemMaster:
                 self._inherit_default_acl(prev, inode)
                 ctx.append(EntryType.INODE_DIRECTORY, inode.to_wire_dict())
             self._absent_cache.remove(uri.path)
-            self.invalidations.append(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def _prepare_parents(self, lookup: PathLookup,
@@ -673,7 +682,6 @@ class FileSystemMaster:
                                         ancestors=anc)
         if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
             self._persist_requests.add(inode.id)
-        self.invalidations.append(uri.path)
         return True
 
     def _existing_file(self, uri: AlluxioURI) -> Inode:
@@ -733,9 +741,15 @@ class FileSystemMaster:
         now = self._now()
         with self._journal.create_context() as ctx:
             for v in victims:
-                ctx.append(EntryType.DELETE_FILE,
-                           {"id": v.id, "op_time_ms": now})
-        self.invalidations.append(uri.path)
+                payload = {"id": v.id, "op_time_ms": now}
+                if v is not inode:
+                    # the delete ROOT's entry invalidates the whole
+                    # subtree by client-side prefix semantics; marking
+                    # descendants "covered" keeps a recursive delete
+                    # from flooding the bounded invalidation ring into
+                    # a cluster-wide cache reset
+                    payload["covered"] = True
+                ctx.append(EntryType.DELETE_FILE, payload)
         if block_ids:
             self._block_master.remove_blocks(block_ids,
                                              delete_metadata=True)
@@ -849,8 +863,6 @@ class FileSystemMaster:
                 "new_name": dst_uri.name, "op_time_ms": now})
             for cur in dst_anc:
                 ctx.append(EntryType.PERSIST_FILE, {"id": cur.id})
-        self.invalidations.append(src_uri.path)
-        self.invalidations.append(dst_uri.path)
         if persisted:
             self._rename_in_ufs(src_uri, dst_uri, inode.is_directory)
         self._absent_cache.remove(dst_uri.path)
@@ -870,6 +882,20 @@ class FileSystemMaster:
             ufs.rename_file(src_res.ufs_path, dst_res.ufs_path)
 
     # ----------------------------------------------------------------- free
+    def journal_invalidations(self, paths: "List[str]") -> None:
+        """Journal client-cache invalidations that have no metadata
+        entry of their own (block-location drift: worker loss,
+        quarantine/release, re-replication).  Routed through an
+        ``INVALIDATE_PATH`` entry — never straight into the log — so the
+        invalidation version stays a pure function of the applied
+        journal and tailing standbys stamp the exact sequence the
+        primary does (docs/ha.md)."""
+        if not paths:
+            return
+        with self._journal.create_context() as ctx:
+            for p in paths:
+                ctx.append(EntryType.INVALIDATE_PATH, {"path": p})
+
     def free(self, path: "str | AlluxioURI", *, recursive: bool = False,
              forced: bool = False) -> List[int]:
         """Evict cached replicas; keep metadata + UFS copy
@@ -900,13 +926,20 @@ class FileSystemMaster:
                     raise FailedToFreeNonPersistedError(
                         f"{self.inode_tree.get_path(t)} is not persisted")
                 block_ids.extend(t.block_ids)
-            if forced:
+            if forced or block_ids:
                 with self._journal.create_context() as ctx:
-                    for t in targets:
-                        if not t.is_directory and t.pinned:
-                            ctx.append(EntryType.SET_ATTRIBUTE,
-                                       {"id": t.id, "pinned": False})
-            self.invalidations.append(uri.path)
+                    if forced:
+                        for t in targets:
+                            if not t.is_directory and t.pinned:
+                                ctx.append(EntryType.SET_ATTRIBUTE,
+                                           {"id": t.id, "pinned": False})
+                    if block_ids:
+                        # freed replicas change location-derived fields
+                        # (in-Alluxio state) under untouched inodes, so
+                        # no other entry pushes the invalidation; one
+                        # prefix covers the whole freed subtree
+                        ctx.append(EntryType.INVALIDATE_PATH,
+                                   {"path": uri.path})
         if block_ids:
             self._block_master.remove_blocks(block_ids, delete_metadata=False)
         return block_ids
@@ -955,7 +988,6 @@ class FileSystemMaster:
                     ctx.append(EntryType.ADD_MOUNT_POINT, info.to_wire())
                 # a new mount can reveal paths previously recorded absent
                 self._absent_cache.clear()
-                self.invalidations.append(uri.path)
         except Exception:
             self._ufs.remove_mount(mount_id)
             raise
@@ -976,13 +1008,16 @@ class FileSystemMaster:
             with self._journal.create_context() as ctx:
                 ctx.append(EntryType.DELETE_MOUNT_POINT, {"path": uri.path})
                 for v in victims:
-                    ctx.append(EntryType.DELETE_FILE,
-                               {"id": v.id, "op_time_ms": now})
+                    payload = {"id": v.id, "op_time_ms": now}
+                    if v is not lookup.inode:
+                        # unmount root's entry covers the subtree by
+                        # prefix; see _delete_locked
+                        payload["covered"] = True
+                    ctx.append(EntryType.DELETE_FILE, payload)
             if block_ids:
                 self._block_master.remove_blocks(block_ids,
                                                  delete_metadata=True)
             self._ufs.remove_mount(info.mount_id)
-            self.invalidations.append(uri.path)
 
     def get_mount_points(self) -> List[MountPointInfo]:
         out = []
@@ -1065,7 +1100,6 @@ class FileSystemMaster:
                     if xattr is not None:
                         payload["xattr"] = xattr
                     ctx.append(EntryType.SET_ATTRIBUTE, payload)
-            self.invalidations.append(uri.path)
 
     # -------------------------------------------------------------- ACLs
     from alluxio_tpu.security.authorization import (
@@ -1108,7 +1142,6 @@ class FileSystemMaster:
                         xattr.pop(key, None)
                     ctx.append(EntryType.SET_ACL, {
                         "id": t.id, "xattr": xattr, "op_time_ms": now})
-            self.invalidations.append(uri.path)
 
     def get_acl(self, path: "str | AlluxioURI") -> Dict[str, List[str]]:
         """Owner/group/mode base entries + extended + default entries
@@ -1175,7 +1208,6 @@ class FileSystemMaster:
                     "id": inode.id,
                     "persistence_state": PersistenceState.TO_BE_PERSISTED})
             self._persist_requests.add(inode.id)
-            self.invalidations.append(uri.path)
 
     def pop_persist_requests(self) -> "set[int]":
         """Drain scheduled persist work as inode IDS (consumed by the
@@ -1266,7 +1298,6 @@ class FileSystemMaster:
                 with self._journal.create_context() as ctx:
                     self._journal_persisted(ctx, inode, ufs_fingerprint,
                                             ancestors=anc)
-                self.invalidations.append(uri.path)
                 return
         with self.inode_tree.lock.write_locked():
             inode = self._existing_inode(self.inode_tree.lookup(uri), uri)
@@ -1277,7 +1308,6 @@ class FileSystemMaster:
             with self._journal.create_context() as ctx:
                 self._journal_persisted(ctx, inode, ufs_fingerprint,
                                         ancestors=anc)
-            self.invalidations.append(uri.path)
 
     def commit_persist(self, path: "str | AlluxioURI",
                        temp_ufs_path: str, *,
@@ -1362,7 +1392,6 @@ class FileSystemMaster:
                     raise
                 with self._journal.create_context() as ctx:
                     self._journal_persisted(ctx, inode, fingerprint)
-                self.invalidations.append(uri.path)
                 return fingerprint
 
     def _discard_temp(self, uri: AlluxioURI, temp_ufs_path: str) -> None:
@@ -1615,7 +1644,6 @@ class FileSystemMaster:
                     self._block_master.commit_block_in_ufs(
                         bid, min(self._default_block_size, remaining))
                     remaining -= self._default_block_size
-            self.invalidations.append(uri.path)
             return self._file_info(self.inode_tree.get_inode(inode.id), uri)
 
     def _load_children_if_needed(self, uri: AlluxioURI,
@@ -1709,15 +1737,22 @@ class _MountTableJournal:
 
     journal_name = "MountTable"
 
-    def __init__(self, table: MountTable) -> None:
+    def __init__(self, table: MountTable, *,
+                 invalidation_sink=None) -> None:
         self._table = table
+        self._invalidation_sink = invalidation_sink
 
     def process_entry(self, entry) -> bool:
         if entry.type == EntryType.ADD_MOUNT_POINT:
-            self._table.add(MountInfo.from_wire(entry.payload))
+            info = MountInfo.from_wire(entry.payload)
+            self._table.add(info)
+            if self._invalidation_sink is not None:
+                self._invalidation_sink(info.alluxio_path)
             return True
         if entry.type == EntryType.DELETE_MOUNT_POINT:
             self._table.delete(entry.payload["path"])
+            if self._invalidation_sink is not None:
+                self._invalidation_sink(entry.payload["path"])
             return True
         return False
 

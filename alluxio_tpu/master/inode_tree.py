@@ -41,7 +41,7 @@ import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
 
 from alluxio_tpu.journal.format import EntryType, JournalEntry, Journaled
 from alluxio_tpu.master.inode import Inode, PersistenceState
@@ -389,6 +389,18 @@ class InodeTree(Journaled):
         #: replication-limited inode registries in InodeTreePersistentState)
         self.replication_limited_ids: Set[int] = set()
         self._inode_count = 0
+        #: invalidation-log feed (FileSystemMaster installs
+        #: ``invalidations.append``).  Called from ``process_entry`` —
+        #: the JOURNAL APPLY path — so primary and tailing standbys
+        #: advance the same deterministic md_version sequence; the RPC
+        #: methods themselves never append (docs/ha.md).
+        self.invalidation_sink: Optional[Callable[[str], None]] = None
+        #: the log itself (FileSystemMaster wires it alongside the
+        #: sink): checkpoint snapshots carry its version so a master
+        #: bootstrapping from a checkpoint — which skips the entries the
+        #: checkpoint covers — still counts the same md_version a full
+        #: replay would (docs/ha.md)
+        self.invalidation_log = None
 
     # ------------------------------------------------------------- locking
     @contextlib.contextmanager
@@ -524,6 +536,35 @@ class InodeTree(Journaled):
 
     # ------------------------------------------------- journal application
     def process_entry(self, entry: JournalEntry) -> bool:
+        # Invalidation paths resolve around the apply: delete/rename need
+        # the PRE-apply path (the inode edge is gone after), creates the
+        # POST-apply one.  Feeding the sink from the apply path — not the
+        # RPC methods — makes the invalidation-log version a pure
+        # function of the applied journal, so a tailing standby counts
+        # the SAME md_version the primary stamps (docs/ha.md).
+        if entry.type == EntryType.INVALIDATE_PATH:
+            # a client-cache invalidation with no metadata mutation of
+            # its own (block-location drift, free): journaled purely so
+            # the version sequence advances identically on primary and
+            # tailing standbys
+            with self.registry_lock:
+                self.change_version += 1
+            sink = self.invalidation_sink
+            if sink is not None:
+                sink(entry.payload.get("path", "/"))
+            return True
+        pre_paths: List[str] = []
+        # a "covered" DELETE_FILE is a recursive delete's descendant:
+        # the delete ROOT's own entry invalidates the whole subtree by
+        # client-side prefix semantics, and appending one ring entry
+        # per victim would push a large delete past the bounded ring's
+        # horizon — a cluster-wide cache reset where one prefix does
+        covered = bool(entry.payload.get("covered"))
+        if self.invalidation_sink is not None and not covered and \
+                entry.type in (EntryType.DELETE_FILE, EntryType.RENAME):
+            uri = self.path_of_id(entry.payload.get("id"))
+            if uri is not None:
+                pre_paths.append(uri.path)
         out = self._process_entry(entry)
         # bump AFTER the mutation lands: a concurrent lister that read
         # the pre-bump version can then never cache a post-mutation
@@ -532,6 +573,21 @@ class InodeTree(Journaled):
         if entry.type in _MUTATING_TYPES:
             with self.registry_lock:
                 self.change_version += 1
+            sink = self.invalidation_sink
+            if sink is not None:
+                # post-apply resolution, same stale-hit ordering as the
+                # change_version bump above: the version moves only once
+                # the mutated state is visible
+                paths = list(pre_paths)
+                if entry.type not in (EntryType.DELETE_FILE,):
+                    target = entry.payload.get("id",
+                                               entry.payload.get("file_id"))
+                    uri = self.path_of_id(target) if target is not None \
+                        else None
+                    if uri is not None and uri.path not in paths:
+                        paths.append(uri.path)
+                for p in paths:
+                    sink(p)
         return out
 
     def _process_entry(self, entry: JournalEntry) -> bool:
@@ -717,12 +773,21 @@ class InodeTree(Journaled):
             inode = self._store.get(iid)
             if inode is not None:
                 inode_dicts.append(inode.to_wire_dict())
-        return {
+        snap = {
             "root_id": self._root_id,
             "inodes": inode_dicts,
         }
+        if self.invalidation_log is not None:
+            # restoring from this checkpoint skips the applied entries
+            # it covers, so the version they advanced must ride along —
+            # md_version stays a pure function of the applied journal
+            snap["invalidation_version"] = self.invalidation_log.version
+        return snap
 
     def restore(self, snap: dict) -> None:
+        if self.invalidation_log is not None:
+            self.invalidation_log.restore_version(
+                snap.get("invalidation_version", 0))
         self._store.clear()
         self.ttl_buckets.clear()
         with self.registry_lock:

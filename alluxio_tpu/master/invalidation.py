@@ -76,6 +76,14 @@ class MetadataInvalidationLog:
         _metrics().counter("Master.MetadataCacheInvalidations").inc()
         return v
 
+    def restore_version(self, version: int) -> None:
+        """Adopt a snapshot's version (journal component restore): the
+        ring's entries are not part of the snapshot — readers below the
+        floor get ``reset``, exactly as after a ring overflow."""
+        with self._lock:
+            self._version = int(version)
+            self._entries.clear()
+
     def since(self, version: Optional[int]) -> dict:
         """Invalidations newer than ``version`` in wire form:
         ``{"to": v, "prefixes": [...], "reset": bool}``.  ``None`` (a
@@ -87,7 +95,13 @@ class MetadataInvalidationLog:
             if version is None:
                 return {"to": cur, "prefixes": [], "reset": True}
             version = int(version)
-            if version >= cur:
+            if version > cur:
+                # a version we never issued: the client tracked a
+                # master that had applied MORE entries than us (e.g. a
+                # deposed leader's torn, never-committed tail).  Unknown
+                # horizon -> reset.
+                return {"to": cur, "prefixes": [], "reset": True}
+            if version == cur:
                 return {"to": cur, "prefixes": [], "reset": False}
             retained = len(self._entries)
             oldest = self._entries[0][0] if retained else cur + 1

@@ -158,6 +158,43 @@ class MasterProcess:
         self.start_time_ms = 0
         self._safe_mode_until = float("inf")
         self.rpc_port: Optional[int] = None
+        from alluxio_tpu.journal.ha import MasterRegistry
+
+        #: shared-journal presence registry behind `fsadmin report
+        #: masters` and the quorum-degraded health sampling (docs/ha.md)
+        self.master_registry = MasterRegistry(
+            str(conf.get(Keys.MASTER_JOURNAL_FOLDER)))
+        #: expected quorum size: the configured master list (client
+        #: addresses, falling back to the raft member list); 0 = not HA
+        self._ha_expected = max(
+            len(self._conf_address_list(Keys.MASTER_RPC_ADDRESSES)),
+            len(self._conf_address_list(
+                Keys.MASTER_EMBEDDED_JOURNAL_ADDRESSES)))
+        #: last quorum-liveness sample (health tick) — served as gauges
+        #: and ingested as `master` history series (docs/ha.md)
+        self._ha_live_sample = 1.0
+        self._ha_lag_sample = 0.0
+        #: (address-or-None, monotonic expiry) — bounds the registry
+        #: directory scan leader_address costs on the standby read path
+        self._leader_cache: "Optional[tuple]" = None
+        #: publishes registry rows / runs the publish heartbeat: multi-
+        #: master deployments only (FaultTolerantMasterProcess forces
+        #: True — the file-lock flavor can run without a configured
+        #: master list).  A plain single master must not grow a masters/
+        #: dir it rewrites every second for nobody.
+        self._ha_member = self._ha_expected > 1
+        if self._ha_expected > 1:
+            reg = metrics()
+            reg.register_gauge("Master.HaQuorumExpected",
+                               lambda: float(self._ha_expected))
+            reg.register_gauge("Master.HaQuorumLive",
+                               lambda: self._ha_live_sample)
+            reg.register_gauge("Master.HaStandbyLagEntries",
+                               lambda: self._ha_lag_sample)
+
+    def _conf_address_list(self, key) -> List[str]:
+        return [a.strip() for a in str(self._conf.get(key) or "").split(",")
+                if a.strip()]
 
     # -- safe mode ----------------------------------------------------------
     def _sample_metadata_history(self) -> None:
@@ -188,6 +225,203 @@ class MasterProcess:
 
     def in_safe_mode(self) -> bool:
         return time.monotonic() < self._safe_mode_until
+
+    # -- HA quorum view ------------------------------------------------------
+    #: a registry row older than this is counted dead by the quorum-
+    #: degraded sampling (3 missed refresh ticks, floor 3s for jittery
+    #: test hosts).  Standbys refresh their row on the journal-tailer
+    #: tick, not the publish heartbeat, so the threshold must cover the
+    #: SLOWER of the two cadences — else an operator raising the tail
+    #: interval makes every healthy standby read as dead and latches
+    #: the master-quorum-degraded alert on a healthy quorum.
+    def _ha_live_threshold_s(self) -> float:
+        return max(3.0,
+                   3 * self._conf.get_duration_s(
+                       Keys.MASTER_HA_PUBLISH_INTERVAL),
+                   3 * self._conf.get_duration_s(
+                       Keys.MASTER_STANDBY_TAIL_INTERVAL))
+
+    @property
+    def client_address(self) -> str:
+        """The address clients reach THIS master at (conf hostname +
+        the actually-bound RPC/standby port)."""
+        port = self.rpc_port or getattr(self, "standby_rpc_port", None) or \
+            self._conf.get_int(Keys.MASTER_RPC_PORT)
+        return f"{self._conf.get(Keys.MASTER_HOSTNAME)}:{port}"
+
+    def _raft_to_client_address(self, raft_addr: str) -> Optional[str]:
+        """Map a raft member address to its client RPC address by list
+        position (``atpu.master.rpc.addresses`` zipped with
+        ``atpu.master.embedded.journal.addresses``, the reference's
+        convention)."""
+        rpc = self._conf_address_list(Keys.MASTER_RPC_ADDRESSES)
+        raft = self._conf_address_list(
+            Keys.MASTER_EMBEDDED_JOURNAL_ADDRESSES)
+        if raft_addr in raft and len(rpc) == len(raft):
+            return rpc[raft.index(raft_addr)]
+        return None
+
+    def leader_address(self) -> Optional[str]:
+        """Best-known current primary (client address) — the hint a
+        standby's NotPrimaryError carries.  None when unknown.  A bound
+        primary RPC port plus live journal primacy IS primacy here: the
+        FT ``serving`` flag flips only after ``_start_serving`` returns,
+        and the registry must not publish a freshly-promoted master as a
+        standby in between.  The primacy check matters on the way DOWN
+        too: a deposed leader whose RPC server has not stopped yet must
+        hint the NEW leader (or nothing), never itself — a self-hint
+        would spin redirected clients on the deposed master."""
+        if self.rpc_port and self.journal.is_primary():
+            return self.client_address
+        node = getattr(self.journal, "node", None)
+        if node is not None:  # EMBEDDED: raft leader, mapped to rpc addr
+            leader_id = node.leader_id
+            if leader_id and leader_id != node.node_id:
+                return self._raft_to_client_address(leader_id)
+            return None
+        # shared-journal flavor: freshest published PRIMARY row.  The
+        # scan is synchronous disk IO (listdir + per-row json) and every
+        # standby-served read resolves the hint, so cache the answer for
+        # a fraction of the publish interval — the rows themselves are
+        # never fresher than that interval, and a wrong hint only costs
+        # the client one redirect hop
+        now = time.monotonic()
+        cached = self._leader_cache
+        if cached is not None and now < cached[1]:
+            return cached[0]
+        limit = self._ha_live_threshold_s()
+        best = None
+        for row in self.master_registry.list():
+            if row.get("role") != "PRIMARY":
+                continue
+            if row.get("last_contact_s", limit) >= limit:
+                continue
+            if row.get("address") == self.client_address:
+                continue  # ourselves (stale row from a previous term)
+            if best is None or row["last_contact_s"] < \
+                    best["last_contact_s"]:
+                best = row
+        addr = best["address"] if best else None
+        ttl = 0.5 * self._conf.get_duration_s(
+            Keys.MASTER_HA_PUBLISH_INTERVAL)
+        self._leader_cache = (addr, now + ttl)
+        return addr
+
+    def _publish_registry(self) -> None:
+        """One registry row for this master (role, applied sequence,
+        term) — primaries publish on their own heartbeat, standbys on
+        the tailer tick.  Role rides the same port+primacy signal as
+        ``leader_address`` so a deposed-but-not-demoted master never
+        advertises PRIMARY."""
+        if not self._ha_member:
+            return
+        role = "PRIMARY" if self.rpc_port and self.journal.is_primary() \
+            else "STANDBY"
+        node = getattr(self.journal, "node", None)
+        term = node.log.term if node is not None else 0
+        self.master_registry.publish(
+            self.client_address, role=role,
+            sequence=int(getattr(self.journal, "sequence", 0)), term=term)
+
+    def masters_report(self) -> dict:
+        """The quorum view served by ``get_masters`` (`fsadmin report
+        masters`, statuspage "Masters"): one row per known master,
+        merged from the shared-journal registry and — under the
+        EMBEDDED journal — live Raft quorum state."""
+        rows: dict = {}
+        for row in self.master_registry.list():
+            rows[row["address"]] = dict(row)
+        self._publish_registry()  # our own row, fresh
+        me = rows[self.client_address] = {
+            "address": self.client_address,
+            "role": "PRIMARY" if self.rpc_port and
+            self.journal.is_primary() else "STANDBY",
+            "sequence": int(getattr(self.journal, "sequence", 0)),
+            "term": 0, "last_contact_s": 0.0,
+        }
+        tailer = getattr(self, "_tailer", None)
+        if tailer is not None and me["role"] == "STANDBY":
+            me["tailer_lag_s"] = max(
+                0.0, time.monotonic() - tailer.last_caught_up)
+        quorum = None
+        if hasattr(self.journal, "quorum_info"):
+            quorum = self.journal.quorum_info()
+            me["term"] = quorum.get("term", 0)
+            for m in quorum.get("members", []):
+                addr = self._raft_to_client_address(m["node_id"]) or \
+                    m["node_id"]
+                if addr == self.client_address:
+                    continue
+                row = rows.setdefault(addr, {"address": addr,
+                                             "sequence": None})
+                row["role"] = {"LEADER": "PRIMARY",
+                               "FOLLOWER": "STANDBY"}.get(
+                    m.get("role", ""), "UNKNOWN")
+                row["term"] = quorum.get("term", 0)
+                row["match_index"] = m.get("match_index")
+                row["last_contact_s"] = m.get("last_contact_s")
+        # lag relative to the furthest-applied member we can see; raft
+        # members without a registry row still report replication
+        # progress through the leader's match_index
+        def _applied(r):
+            return r["sequence"] if r.get("sequence") is not None \
+                else r.get("match_index")
+
+        seqs = [_applied(r) for r in rows.values()
+                if _applied(r) is not None]
+        head = max(seqs) if seqs else 0
+        for r in rows.values():
+            if _applied(r) is not None:
+                r["lag_entries"] = head - _applied(r)
+        out = {"leader": self.leader_address(),
+               "masters": sorted(rows.values(),
+                                 key=lambda r: r["address"])}
+        if quorum is not None:
+            out["quorum"] = quorum
+        return out
+
+    def _sample_ha_history(self) -> None:
+        """Quorum liveness gauges into the history rings on the health
+        tick (``master`` source): what the ``master-quorum-degraded``
+        rule watches (docs/ha.md)."""
+        if self._ha_expected <= 1:
+            return
+        history = self.metrics_master.history \
+            if self.metrics_master is not None else None
+        if history is None:
+            return
+        limit = self._ha_live_threshold_s()
+        live = 1  # ourselves
+        lag = 0
+        node = getattr(self.journal, "node", None)
+        if node is not None:
+            info = node.quorum_info()
+            for m in info.get("members", []):
+                age = m.get("last_contact_s")
+                if m.get("address") != "self" and age is not None and \
+                        age < limit:
+                    live += 1
+            follower_match = [m.get("match_index", 0)
+                              for m in info.get("members", [])
+                              if m.get("address") != "self"]
+            if follower_match:
+                lag = max(0, info.get("commit_index", 0)
+                          - min(follower_match))
+        else:
+            my_seq = int(getattr(self.journal, "sequence", 0))
+            for row in self.master_registry.list():
+                if row.get("address") == self.client_address:
+                    continue
+                if row.get("last_contact_s", limit) < limit:
+                    live += 1
+                    lag = max(lag, my_seq - int(row.get("sequence", 0)))
+        self._ha_live_sample = float(live)
+        self._ha_lag_sample = float(lag)
+        history.ingest("master", {
+            "Master.HaQuorumExpected": float(self._ha_expected),
+            "Master.HaQuorumLive": float(live),
+            "Master.HaStandbyLagEntries": float(lag),
+        })
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
@@ -275,8 +509,22 @@ class MasterProcess:
             health_monitor=self.health_monitor,
             remediation_engine=self.remediation,
             admission=self.admission,
-            invalidation_log=self.fs_master.invalidations))
+            invalidation_log=self.fs_master.invalidations,
+            masters_fn=self.masters_report))
         self.rpc_port = self.rpc_server.start()
+        # announce primacy to the quorum view the moment the port is
+        # bound, then keep the row fresh on its own heartbeat
+        from alluxio_tpu.utils.exceptions import best_effort
+
+        if self._ha_member:
+            best_effort("master registry publish",
+                        self._publish_registry)
+            self._threads.append(HeartbeatThread(
+                HeartbeatContext.MASTER_LOST_MASTER_DETECTION,
+                _Exec(self._publish_registry),
+                self._conf.get_duration_s(
+                    Keys.MASTER_HA_PUBLISH_INTERVAL)))
+            self._threads[-1].start()
         if self._conf.get_bool(Keys.MASTER_FASTPATH_ENABLED):
             from alluxio_tpu.rpc.fastpath import (
                 FastPathServer, socket_path_for,
@@ -374,6 +622,14 @@ class MasterProcess:
                 # audit logs
                 rules.append(tenant_overload_rule(
                     self.admission.shed_counts))
+            if self._ha_expected > 1:
+                from alluxio_tpu.master.health import (
+                    quorum_degraded_rule,
+                )
+
+                # a lost standby costs nothing TODAY — which is exactly
+                # why it must alert: the next failure is the outage
+                rules.append(quorum_degraded_rule(self._ha_expected))
             if history is None:
                 # don't advertise rules that silently no-op without
                 # the history store: the report must only list rules
@@ -479,6 +735,31 @@ class MasterProcess:
             if self.metrics_master.history is not None:
                 self.metrics_master.history.revive_source(source)
 
+        def _on_location_drift(block_ids) -> None:
+            """Block-location drift (worker loss/quarantine/release,
+            re-replication) -> journaled ``INVALIDATE_PATH`` entries:
+            client caches repair their location-derived fields on the
+            next heartbeat instead of waiting out the cache TTL, and —
+            because the invalidation log only ever advances at journal
+            apply — tailing standbys count the same md_version the
+            primary stamps (docs/ha.md).  A mass event (whole worker's
+            residents) collapses to one root invalidation — full cache
+            drop beats flooding the bounded ring off its horizon one
+            path at a time."""
+            from alluxio_tpu.utils import ids as _ids
+
+            if len(block_ids) > 1024:
+                self.fs_master.journal_invalidations(["/"])
+                return
+            tree = self.fs_master.inode_tree
+            paths = set()
+            with tree.lock.read_locked():
+                for fid in {_ids.file_id_for_block(b) for b in block_ids}:
+                    uri = tree.path_of_id(fid)
+                    if uri is not None:
+                        paths.add(uri.path)
+            self.fs_master.journal_invalidations(sorted(paths))
+
         # once per process: _start_serving re-runs on every HA
         # re-promotion, and the closures resolve self.metrics_master at
         # call time, so a second registration would only duplicate work
@@ -486,6 +767,8 @@ class MasterProcess:
             self.block_master.lost_worker_listeners.append(_on_worker_lost)
             self.block_master.registered_worker_listeners.append(
                 _on_worker_registered)
+            self.block_master.location_change_listeners.append(
+                _on_location_drift)
             self._worker_lost_listener_installed = True
 
     def _start_heartbeats(self) -> None:
@@ -536,6 +819,7 @@ class MasterProcess:
                 # `fsadmin report history` after the flood is gone
                 self.admission.sample_history(self.metrics_master.history)
             self._sample_metadata_history()
+            self._sample_ha_history()
 
         if self.health_monitor is not None or \
                 self.metrics_master.history is not None:
@@ -646,6 +930,10 @@ class MasterProcess:
             self.audit_writer.stop()
         self.fs_master.stop()
         self.journal.stop()
+        from alluxio_tpu.utils.exceptions import best_effort
+
+        best_effort("master registry withdraw",
+                    self.master_registry.withdraw, self.client_address)
 
     @property
     def address(self) -> str:
@@ -664,6 +952,19 @@ class FaultTolerantMasterProcess(MasterProcess):
             FileLockPrimarySelector, JournalTailer,
         )
 
+        # standby-serving torn-read exclusion: the standby apply paths
+        # (tailer tick, raft apply loop) hold no inode-path locks, so a
+        # concurrently served read could observe a half-applied
+        # rename/delete — a state no journal version ever contained,
+        # which would break the advertised staleness contract.  Holding
+        # the tree-wide WRITE lock around each apply batch excludes the
+        # read handlers (which hold it in read mode via lock_path); it
+        # is acquired OUTSIDE the journal/node locks, the same
+        # tree-first canonical order the primary's RPC paths use
+        # (docs/ha.md).
+        def _apply_exclusion():
+            return self.fs_master.inode_tree.lock.write_locked()
+
         if selector is not None:
             self.selector = selector
         else:
@@ -679,16 +980,31 @@ class FaultTolerantMasterProcess(MasterProcess):
             else:
                 self.selector = FileLockPrimarySelector(
                     conf.get(Keys.MASTER_JOURNAL_FOLDER))
+        node = getattr(self.journal, "node", None)
+        if node is not None:  # EMBEDDED (any selector): raft apply loop
+            node.apply_exclusion = _apply_exclusion
         import threading
 
         self._tailer = JournalTailer(
             self.journal,
             interval_s=conf.get_duration_s(
-                Keys.MASTER_STANDBY_TAIL_INTERVAL))
+                Keys.MASTER_STANDBY_TAIL_INTERVAL),
+            node=self.client_address,
+            on_tick=self._publish_registry,
+            apply_exclusion=_apply_exclusion)
         self._promote_thread = None
         self._promote_lock = threading.Lock()
         self._stopped = False
         self.serving = False
+        # an FT master is an HA member even without a configured master
+        # list (the file-lock flavor discovers peers via the shared
+        # journal dir alone): always publish registry rows
+        self._ha_member = True
+        #: read-only RPC server while standby (atpu.master.ha.standby.
+        #: reads.enabled): GetStatus/ListStatus/Exists off the tailing
+        #: apply, everything else a NotPrimaryError redirect
+        self._standby_server = None
+        self.standby_rpc_port: Optional[int] = None
 
     def _init_from_backup_if_configured(self) -> None:
         backup = self._conf.get(Keys.MASTER_JOURNAL_INIT_FROM_BACKUP)
@@ -724,11 +1040,93 @@ class FaultTolerantMasterProcess(MasterProcess):
             return port
         self.journal.standby_start()
         self._tailer.start()
+        self._start_standby_serving()
         self._promote_thread = threading.Thread(
             target=self._wait_and_promote, name="primacy-waiter",
             daemon=True)
         self._promote_thread.start()
         return 0
+
+    def _start_standby_serving(self) -> None:
+        """Open the read-only RPC endpoint on the configured master
+        port: reads are served off the tailed state, stamped with this
+        standby's journal-deterministic md_version; every other RPC is
+        a typed NotPrimaryError redirect (docs/ha.md)."""
+        if not self._conf.get_bool(Keys.MASTER_HA_STANDBY_READS_ENABLED):
+            return
+        from alluxio_tpu.rpc.master_service import (
+            standby_block_service, standby_fs_service,
+            standby_meta_service,
+        )
+        from alluxio_tpu.security.authentication import Authenticator
+
+        server = RpcServer(
+            bind_host="0.0.0.0",
+            port=self._conf.get_int(Keys.MASTER_RPC_PORT),
+            authenticator=Authenticator(self._conf))
+        server.add_service(standby_fs_service(
+            self.fs_master, self.leader_address,
+            active_sync=self.active_sync))
+        server.add_service(standby_block_service(
+            self.block_master, self.leader_address))
+        server.add_service(standby_meta_service(
+            self._conf, leader_fn=self.leader_address,
+            cluster_id=self.cluster_id,
+            start_time_ms=self.start_time_ms, journal=self.journal,
+            masters_fn=self.masters_report,
+            permission_checker=self.permission_checker))
+        self.standby_rpc_port = server.start()
+        self._standby_server = server
+        LOG.info("standby master serving reads on port %d",
+                 self.standby_rpc_port)
+
+    def _stop_standby_serving(self) -> None:
+        if self._standby_server is not None:
+            self._standby_server.stop()
+            self._standby_server = None
+            self.standby_rpc_port = None
+
+    def _start_serving(self) -> int:
+        port = super()._start_serving()
+        self._fence_primary_reads()
+        return port
+
+    def _fence_primary_reads(self) -> None:
+        """Primacy-gate the serving FS reads: a deposed leader demotes
+        asynchronously (``_on_deposed`` runs on its own thread), and
+        until its RPC server actually stops it would keep serving reads
+        from state that now LAGS the new leader — without the standby
+        marker, so a strong client would trust them.  Checking live
+        primacy per read closes that window the moment the node learns
+        it stepped down.  (A partitioned leader that has not yet heard
+        the higher term can still serve briefly-stale reads — the
+        classic lease-read gap; terms fence every write. docs/ha.md.)"""
+        from alluxio_tpu.rpc.master_service import (
+            FS_SERVICE, STANDBY_FS_READS,
+        )
+
+        svc = self.rpc_server.service(FS_SERVICE)
+        if svc is None:
+            return
+        journal = self.journal
+
+        def gate(fn):
+            def handler(r):
+                if not journal.is_primary():
+                    from alluxio_tpu.utils.exceptions import (
+                        NotPrimaryError,
+                    )
+
+                    raise NotPrimaryError(
+                        "this master was deposed",
+                        leader=self.leader_address() or None)
+                return fn(r)
+
+            return handler
+
+        for name, (fn, kind) in list(svc.methods.items()):
+            if name in STANDBY_FS_READS:
+                svc.methods[name] = (gate(fn), kind)
 
     def _wait_and_promote(self) -> None:
         while not self._stopped:
@@ -766,9 +1164,15 @@ class FaultTolerantMasterProcess(MasterProcess):
                 if self.rpc_server is not None:
                     self.rpc_server.stop()
                     self.rpc_server = None
+                self.rpc_port = None
                 if getattr(self, "audit_writer", None) is not None:
                     self.audit_writer.stop()
                     self.audit_writer = None
+                # rejoin the quorum as a standby: resume tailing (a
+                # no-op tick under raft, but it publishes our STANDBY
+                # registry row) and re-open the read-only endpoint
+                self._tailer.start()
+                self._start_standby_serving()
                 self._promote_thread = threading.Thread(
                     target=self._wait_and_promote, name="primacy-waiter",
                     daemon=True)
@@ -780,8 +1184,10 @@ class FaultTolerantMasterProcess(MasterProcess):
     def promote(self) -> int:
         """Standby -> primary: stop tailing, finish the tail in place (no
         state reset — the standby is already caught up), open the write
-        log, start serving."""
+        log, start serving.  The standby read server is stopped FIRST so
+        ``_start_serving`` can bind the same configured port."""
         self._tailer.stop()
+        self._stop_standby_serving()
         if hasattr(self.journal, "gain_primacy_from_standby"):
             self.journal.gain_primacy_from_standby()
         else:
@@ -797,10 +1203,16 @@ class FaultTolerantMasterProcess(MasterProcess):
             self._promote_thread.join(timeout=10)
             self._promote_thread = None
         self._tailer.stop()
+        self._stop_standby_serving()
         was_serving = self.serving
         self.serving = False
         if was_serving:
             super().stop()
         else:
             self.journal.stop()
+            from alluxio_tpu.utils.exceptions import best_effort
+
+            best_effort("master registry withdraw",
+                        self.master_registry.withdraw,
+                        self.client_address)
         self.selector.release()

@@ -41,12 +41,14 @@ def _dashboard_html() -> bytes:
 
     return render(
         "alluxio-tpu master", "/api/v1/master",
-        sections=[("Cluster", "info"), ("Workers", "workers"),
+        sections=[("Cluster", "info"), ("Masters", "masters"),
+                  ("Workers", "workers"),
                   ("Mounts", "mounts"), ("Catalog", "catalog"),
                   ("Cluster health", "health"),
                   ("Self-healing", "remediation"),
                   ("Input doctor", "stall")],
-        raw_routes=["/api/v1/master/info", "/capacity", "/metrics",
+        raw_routes=["/api/v1/master/info", "/masters", "/capacity",
+                    "/metrics",
                     "/metrics/history", "/health", "/remediation",
                     "/mounts", "/catalog", "/trace", "/browse",
                     "/config", "/logs"],
@@ -56,6 +58,17 @@ def _dashboard_html() -> bytes:
     for (const k of ['cluster_id','rpc_port','safe_mode','live_workers',
                      'uptime_ms'])
       row(t, [k, String(info[k])]);
+    // HA quorum view: role/term/applied-seq per master (docs/ha.md)
+    const ms = await j('/masters');
+    const mst = document.getElementById('masters');
+    row(mst, ['address','role','term','applied seq','lag','contact'], true);
+    for (const x of ms.masters)
+      row(mst, [x.address + (x.address === ms.leader ? ' *' : ''),
+                x.role || '?', String(x.term ?? '-'),
+                String(x.sequence ?? '-'),
+                x.lag_entries != null ? String(x.lag_entries) : '-',
+                x.last_contact_s != null
+                  ? x.last_contact_s.toFixed(1) + 's' : '-']);
     const cap = await j('/capacity');
     const w = document.getElementById('workers');
     row(w, ['host','state','capacity','used'], true);
@@ -312,6 +325,8 @@ class MasterWebServer:
                         return {"enabled": False, "audit": [],
                                 "quarantined": [], "overlay": {}}
                     return engine.report()
+                if route == "/api/v1/master/masters":
+                    return mp.masters_report()
                 if route == "/api/v1/master/mounts":
                     return {"mounts": [
                         {"path": m.alluxio_path, "ufs": m.ufs_uri,

@@ -1,5 +1,8 @@
 """Test cluster harnesses (reference: ``minicluster/``)."""
 
+from alluxio_tpu.minicluster.ha_cluster import (  # noqa: F401
+    HaCluster, WriteLedger,
+)
 from alluxio_tpu.minicluster.local_cluster import LocalCluster  # noqa: F401
 from alluxio_tpu.minicluster.multi_process import (  # noqa: F401
     MultiProcessCluster,

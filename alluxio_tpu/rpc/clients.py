@@ -10,7 +10,8 @@ rest of the stack cannot tell transport from direct calls.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterator, List, Optional
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from alluxio_tpu.rpc.core import RpcChannel
 from alluxio_tpu.rpc.master_service import (
@@ -22,6 +23,25 @@ from alluxio_tpu.utils.wire import (
     BlockInfo, FileBlockInfo, FileInfo, MountPointInfo, WorkerInfo,
     WorkerNetAddress,
 )
+
+#: (registry, counters) cache — the failover counters sit on every RPC
+#: attempt, so resolve them once per registry generation, not per call
+#: (tests swap the registry via reset_metrics, hence the identity key)
+_failover_metrics_cache: Tuple[object, object] = (None, None)
+
+
+def _failover_metrics():
+    global _failover_metrics_cache
+    from alluxio_tpu.metrics import metrics
+
+    reg = metrics()
+    cached_reg, counters = _failover_metrics_cache
+    if cached_reg is not reg:
+        counters = (reg.counter("Client.FailoverRedirects"),
+                    reg.counter("Client.FailoverRotations"),
+                    reg.counter("Client.StandbyReads"))
+        _failover_metrics_cache = (reg, counters)
+    return counters
 
 
 def resolve_retry_duration_s(value: Optional[float] = None,
@@ -41,18 +61,34 @@ def resolve_retry_duration_s(value: Optional[float] = None,
 
 
 class _BaseClient:
-    """``address`` may be a comma-separated list for HA deployments: on an
-    UNAVAILABLE failure the client rotates to the next master and the retry
-    policy re-issues the call (reference: ``AbstractMasterClient``
-    re-resolving the leader across the configured masters)."""
+    """Multi-endpoint master client (reference: ``MasterInquireClient`` +
+    ``AbstractMasterClient`` re-resolving the leader across the
+    configured masters).  ``address`` may be a comma-separated list for
+    HA deployments; the client then
+
+    - follows **leader hints**: a standby's typed ``NotPrimaryError``
+      names the current primary, and the client jumps straight to it
+      without consuming a retry attempt (``retry.note_redirect``);
+    - **rotates** with full-jitter backoff on connection loss /
+      hint-less unavailability, so a dead primary's clients fan out
+      over the survivors instead of stampeding one;
+    - optionally routes **reads to standbys**
+      (``atpu.user.standby.reads.enabled``): read-marked RPCs
+      round-robin across the non-active members (endpoints that
+      recently failed sit out a short cooldown), keeping GetStatus/
+      ListStatus load off the primary (docs/ha.md)."""
 
     service = ""
+
+    #: seconds a failed endpoint sits out of standby-read rotation
+    _DOWN_COOLDOWN_S = 3.0
 
     def __init__(self, address: str, *,
                  retry_duration_s: Optional[float] = None,
                  base_sleep_s: float = 0.05, max_sleep_s: float = 3.0,
                  metadata=None, fastpath: bool = True,
-                 fastpath_dir: Optional[str] = None, conf=None) -> None:
+                 fastpath_dir: Optional[str] = None, conf=None,
+                 standby_reads: bool = False) -> None:
         """``fastpath_dir``: where master fastpath sockets live; pass the
         ``atpu.master.fastpath.dir`` property when a Configuration is at
         hand (FileSystem does) — otherwise the env override or /tmp.
@@ -60,27 +96,37 @@ class _BaseClient:
         ``atpu.user.rpc.retry.duration`` (30s)."""
         import os as _os
 
-        from alluxio_tpu.rpc.fastpath import HybridChannel
-
-        use_fast = fastpath and not _os.environ.get("ATPU_FASTPATH_DISABLE")
-        fast_dir = fastpath_dir or \
+        self._use_fast = fastpath and \
+            not _os.environ.get("ATPU_FASTPATH_DISABLE")
+        self._fast_dir = fastpath_dir or \
             _os.environ.get("ATPU_MASTER_FASTPATH_DIR", "/tmp")
         self._channels = []
+        self._addresses: List[str] = []
         for a in str(address).split(","):
             if not a.strip():
                 continue
-            ch = RpcChannel(a.strip(), metadata=metadata)
-            if use_fast:
-                # probes <dir>/atpu-master-<port>.sock; silently stays
-                # pure-gRPC when the master is remote or fastpath is off
-                ch = HybridChannel(ch, fastpath_dir=fast_dir)
-            self._channels.append(ch)
+            self._channels.append(self._make_channel(a.strip(), metadata))
+            self._addresses.append(a.strip())
         self._active = 0
+        self._standby_reads = bool(standby_reads)
+        self._read_rr = 0
+        self._down_until: Dict[int, float] = {}
+        self._endpoints_lock = threading.Lock()
         self._metadata = metadata
         self._retry_duration_s = resolve_retry_duration_s(
             retry_duration_s, conf)
         self._base_sleep_s = base_sleep_s
         self._max_sleep_s = max_sleep_s
+
+    def _make_channel(self, address: str, metadata):
+        from alluxio_tpu.rpc.fastpath import HybridChannel
+
+        ch = RpcChannel(address, metadata=metadata)
+        if self._use_fast:
+            # probes <dir>/atpu-master-<port>.sock; silently stays
+            # pure-gRPC when the master is remote or fastpath is off
+            ch = HybridChannel(ch, fastpath_dir=self._fast_dir)
+        return ch
 
     @property
     def _channel(self) -> RpcChannel:
@@ -89,17 +135,104 @@ class _BaseClient:
     def _rotate(self) -> None:
         self._active = (self._active + 1) % len(self._channels)
 
-    def _call(self, method: str, request: dict, timeout: float = 30.0):
-        from alluxio_tpu.utils.exceptions import UnavailableError
+    def _follow_leader(self, leader: str) -> None:
+        """Point the active (write) endpoint at the hinted primary,
+        minting a channel when the hint names a master outside the
+        configured list (e.g. a replacement member)."""
+        leader = leader.strip()
+        with self._endpoints_lock:
+            try:
+                self._active = self._addresses.index(leader)
+            except ValueError:
+                self._channels.append(
+                    self._make_channel(leader, self._metadata))
+                self._addresses.append(leader)
+                self._active = len(self._channels) - 1
+
+    def _mark_down(self, idx: int) -> None:
+        self._down_until[idx] = time.monotonic() + self._DOWN_COOLDOWN_S
+
+    def _handle_not_primary(self, leader, idx: int) -> None:
+        """Shared redirect/rotate bookkeeping for every not-primary
+        path (unary handler, strong-read conversion, stream
+        establishment — keep them identical): a hinted failure follows
+        the leader (the retry policy's free redirect); a hint-less one
+        rotates off the endpoint, so a standby that cannot name a
+        leader (mid-election, partitioned) is not re-picked for the
+        whole retry budget."""
+        redirects, rotations, _ = _failover_metrics()
+        if leader:
+            self._follow_leader(leader)
+            redirects.inc()
+        elif len(self._channels) > 1:
+            if idx == self._active:
+                self._rotate()
+            rotations.inc()
+
+    def _pick(self, read: bool) -> int:
+        """Endpoint for this attempt: writes (and single-endpoint
+        clients) go to the believed leader; standby-routed reads
+        round-robin the OTHER members, falling back to the leader when
+        every standby is cooling down."""
+        if not (read and self._standby_reads and len(self._channels) > 1):
+            return self._active
+        now = time.monotonic()
+        n = len(self._channels)
+        for _ in range(n):
+            self._read_rr = (self._read_rr + 1) % n
+            i = self._read_rr
+            if i == self._active:
+                continue
+            if self._down_until.get(i, 0.0) <= now:
+                return i
+        return self._active
+
+    def _call(self, method: str, request: dict, timeout: float = 30.0, *,
+              read: bool = False):
+        from alluxio_tpu.utils.exceptions import (
+            AlluxioTpuError, NotPrimaryError, UnavailableError,
+        )
 
         def attempt():
+            idx = self._pick(read)
             try:
-                return self._channel.call(self.service, method, request,
-                                          timeout=timeout)
-            except UnavailableError:
-                if len(self._channels) > 1:
-                    self._rotate()
+                out = self._channels[idx].call(
+                    self.service, method, request, timeout=timeout)
+                if read and isinstance(out, dict) and \
+                        out.pop("standby", False):
+                    hint = out.pop("leader", None)
+                    if not self._standby_reads and \
+                            len(self._channels) > 1:
+                        # a standby served a read this client expected
+                        # read-your-writes from — convert the mark back
+                        # into a redirect (single-endpoint clients
+                        # pointed AT a standby asked for what they got)
+                        raise NotPrimaryError(
+                            "read served by a standby", leader=hint)
+            except NotPrimaryError as e:
+                self._handle_not_primary(e.leader, idx)
                 raise
+            except UnavailableError:
+                self._mark_down(idx)
+                if idx == self._active and len(self._channels) > 1:
+                    self._rotate()
+                    _failover_metrics()[1].inc()
+                raise
+            except AlluxioTpuError as e:
+                if read and e.standby and not self._standby_reads and \
+                        len(self._channels) > 1:
+                    # a standby answered a strong read with an ERROR off
+                    # its bounded-stale state (e.g. NOT_FOUND for a path
+                    # the primary just acked): as untrustworthy as a
+                    # stale result — retry on the primary
+                    self._handle_not_primary(e.leader, idx)
+                    raise NotPrimaryError(
+                        "standby answered a strong read",
+                        leader=e.leader) from e
+                raise
+            if read and idx != self._active:
+                _failover_metrics()[2].inc()
+            return out
 
         return retry(
             attempt,
@@ -119,13 +252,15 @@ class FsMasterClient(_BaseClient):
         what the client metadata cache stores (docs/metadata.md)."""
         resp = self._call(
             "get_status", {"path": str(path),
-                           "sync_interval_ms": sync_interval_ms})
+                           "sync_interval_ms": sync_interval_ms},
+            read=True)
         stamp = resp.pop("md_version", None)
         info = FileInfo.from_wire(resp)
         return (info, stamp) if want_version else info
 
     def exists(self, path: str) -> bool:
-        return self._call("exists", {"path": str(path)})["exists"]
+        return self._call("exists", {"path": str(path)},
+                          read=True)["exists"]
 
     @staticmethod
     def _decode_columnar(cols: dict) -> List[FileInfo]:
@@ -144,7 +279,8 @@ class FsMasterClient(_BaseClient):
         :meth:`get_status`."""
         resp = self._call("list_status", {
             "path": str(path), "recursive": recursive,
-            "sync_interval_ms": sync_interval_ms, "columnar": True})
+            "sync_interval_ms": sync_interval_ms, "columnar": True},
+            read=True)
         stamp = resp.get("md_version")
         col = resp.get("columnar")
         if col is None:  # server predates the columnar listing format
@@ -171,16 +307,36 @@ class FsMasterClient(_BaseClient):
                    "batch_size": batch_size, "columnar": True}
 
         def attempt():
-            it = self._channel.call_stream(
+            from alluxio_tpu.utils.exceptions import NotPrimaryError
+
+            idx = self._pick(read=True)
+            it = self._channels[idx].call_stream(
                 self.service, "list_status_stream", request)
             try:
                 first = next(it)
             except StopIteration:
                 return None, it
+            except NotPrimaryError as e:
+                # must precede the UnavailableError arm (its subclass):
+                # a deposed leader's fence or a not-yet-caught-up
+                # standby names the leader — follow the hint instead of
+                # cooling down a healthy member and blind-rotating
+                self._handle_not_primary(e.leader, idx)
+                raise
             except UnavailableError:
-                if len(self._channels) > 1:
+                self._mark_down(idx)
+                if idx == self._active and len(self._channels) > 1:
                     self._rotate()
                 raise
+            if isinstance(first, dict) and first.get("standby") and \
+                    not self._standby_reads and len(self._channels) > 1:
+                # same strong-read contract as the unary path: a
+                # standby-served stream redirects instead of feeding a
+                # stale listing to a read-your-writes client
+                hint = first.get("leader")
+                self._handle_not_primary(hint, idx)
+                raise NotPrimaryError("read served by a standby",
+                                      leader=hint)
             return first, it
 
         first, it = retry(
@@ -395,6 +551,13 @@ class MetaMasterClient(_BaseClient):
 
     def get_quorum_info(self) -> dict:
         return self._call("get_quorum_info", {})
+
+    def get_masters(self) -> dict:
+        """Quorum view for ``fsadmin report masters``: per-master role,
+        term, last-applied sequence, tailer lag and last contact
+        (docs/ha.md).  Read-marked: standbys answer it too, so the view
+        survives a dead primary."""
+        return self._call("get_masters", {}, read=True)
 
     def transfer_quorum_leadership(self, target: str) -> dict:
         return self._call("transfer_quorum_leadership",

@@ -299,6 +299,12 @@ class RpcServer:
     def add_service(self, svc: ServiceDefinition) -> None:
         self._services[svc.name] = svc
 
+    def service(self, name: str) -> Optional[ServiceDefinition]:
+        """Registered service by name — dispatch reads the definition's
+        method map per call, so callers may wrap handlers in place even
+        after ``start()`` (the HA primacy fence does)."""
+        return self._services.get(name)
+
     def start(self) -> int:
         self._server.add_generic_rpc_handlers(
             (_GenericHandler(self._services, self._authenticator,

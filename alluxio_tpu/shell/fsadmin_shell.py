@@ -22,13 +22,13 @@ ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 class ReportCommand(Command):
     name = "report"
     description = ("Report cluster summary|capacity|ufs|metrics|"
-                   "jobservice|stall|history|health|qos.")
+                   "jobservice|stall|history|health|qos|masters.")
 
     def configure(self, p):
         p.add_argument("category", nargs="?", default="summary",
                        choices=["summary", "capacity", "ufs", "metrics",
                                 "jobservice", "stall", "history",
-                                "health", "qos"])
+                                "health", "qos", "masters"])
         p.add_argument("metric", nargs="?", default="",
                        help="history: metric name (omit to list "
                             "recorded names)")
@@ -91,6 +91,45 @@ class ReportCommand(Command):
         ctx.print(f"    Free Capacity: {human_size(total - used)} "
                   f"({100 - pct:.1f}% free)")
         return 0
+
+    def _masters(self, ctx):
+        """HA quorum view (docs/ha.md): one row per known master —
+        role, term, last-applied journal sequence, lag behind the
+        furthest member, tailer lag and last contact.  Exits nonzero
+        when no primary is visible: a scriptable 'is failover stuck'
+        probe."""
+        report = ctx.meta_client().get_masters()
+        leader = report.get("leader")
+        masters = report.get("masters", [])
+        ctx.print(f"Masters ({len(masters)} known, "
+                  f"leader: {leader or 'NONE'}):")
+        ctx.print(f"    {'Address':<24s} {'Role':>8s} {'Term':>6s} "
+                  f"{'Applied':>10s} {'Lag':>6s} {'Tailer':>8s} "
+                  f"{'Contact':>8s}")
+        for m in sorted(masters, key=lambda r: r.get("address", "")):
+            # EMBEDDED members without a registry row (per-folder
+            # registries): the leader still knows how far they have
+            # replicated — show match_index rather than a blank
+            seq = m.get("sequence")
+            if seq is None:
+                seq = m.get("match_index")
+            lag = m.get("lag_entries")
+            tailer = m.get("tailer_lag_s")
+            contact = m.get("last_contact_s")
+            mark = " *" if m.get("address") == leader else ""
+            ctx.print(
+                f"    {str(m.get('address', '?')) + mark:<24s} "
+                f"{m.get('role', '?'):>8s} "
+                f"{m.get('term', '-'):>6} "
+                f"{seq if seq is not None else '-':>10} "
+                f"{lag if lag is not None else '-':>6} "
+                f"{f'{tailer:.1f}s' if tailer is not None else '-':>8s} "
+                f"{f'{contact:.1f}s' if contact is not None else '-':>8s}")
+        has_primary = any(m.get("role") == "PRIMARY" for m in masters)
+        if not has_primary:
+            ctx.eprint("WARN: no PRIMARY visible — failover in "
+                       "progress or quorum lost (docs/ha.md)")
+        return 0 if has_primary else 1
 
     def _capacity(self, ctx):
         workers = ctx.block_client().get_worker_infos(

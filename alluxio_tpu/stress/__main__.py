@@ -212,6 +212,20 @@ def build_parser() -> argparse.ArgumentParser:
     md.add_argument("--master", default=None,
                     help="cached row only: attach to a live cluster")
 
+    ha = sub.add_parser("ha", help="HA failover drill: kill the primary "
+                                   "under live load; gates MTTR <= 2 "
+                                   "election timeouts, zero acked-write "
+                                   "loss, standby staleness contract")
+    ha.add_argument("--masters", type=int, default=3)
+    ha.add_argument("--election-timeout", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="election timeout upper bound (seconds-scale "
+                         "on purpose: the in-process quorum shares one "
+                         "GIL with the load; the gate must measure "
+                         "failover, not scheduler jitter)")
+    ha.add_argument("--warmup", type=float, default=2.0,
+                    help="seconds of load before the kill")
+
     sub.add_parser("suite", help="run the whole BASELINE config family")
     rp = sub.add_parser("report",
                         help="render suite JSON to a single-file HTML "
@@ -260,6 +274,7 @@ SUITE = (
     ("metadata-striped", ["metadata", "--row", "striped"]),
     ("metadata-cached-getstatus", ["metadata", "--row", "cached"]),
     ("metadata-journal-batch", ["metadata", "--row", "journal"]),
+    ("ha-failover", ["ha"]),
 )
 
 
@@ -481,6 +496,12 @@ def main(argv=None) -> int:
         else:
             r = run(row=args.row, fsync_ms=args.fsync_ms,
                     batch_time_ms=args.batch_time_ms, **kw)
+    elif args.bench == "ha":
+        from alluxio_tpu.stress.ha_bench import run
+
+        r = run(masters=args.masters,
+                election_timeout_s=args.election_timeout,
+                warmup_s=args.warmup)
     elif args.bench == "suite":
         results = run_suite()
         return 0 if all(x.errors == 0 for x in results) else 1

@@ -21,12 +21,25 @@ class AlluxioTpuError(Exception):
 
     code = "INTERNAL"
     retry_after_s: Optional[float] = None
+    #: HA redirect hint: the current primary's client RPC address, set by
+    #: a standby master shedding a non-read RPC (NotPrimaryError).  The
+    #: multi-endpoint client follows it without consuming a retry attempt.
+    leader: Optional[str] = None
+    #: set on errors a STANDBY raised while serving a read: the answer
+    #: reflects bounded-stale state (e.g. NOT_FOUND for a path the
+    #: primary just acked).  A strong multi-endpoint client retries such
+    #: errors on the primary instead of trusting them (docs/ha.md).
+    standby: bool = False
 
     def to_wire(self) -> dict:
         d = {"code": self.code, "message": str(self),
              "type": type(self).__name__}
         if self.retry_after_s is not None:
             d["retry_after_s"] = float(self.retry_after_s)
+        if self.leader is not None:
+            d["leader"] = str(self.leader)
+        if self.standby:
+            d["standby"] = True
         return d
 
     @staticmethod
@@ -38,6 +51,11 @@ class AlluxioTpuError(Exception):
         ra = d.get("retry_after_s")
         if ra is not None:
             e.retry_after_s = float(ra)
+        ld = d.get("leader")
+        if ld is not None:
+            e.leader = str(ld)
+        if d.get("standby"):
+            e.standby = True
         return e
 
 
@@ -133,6 +151,19 @@ class UfsError(AlluxioTpuError):
 
 class JournalClosedError(UnavailableError):
     pass
+
+
+class NotPrimaryError(UnavailableError):
+    """A standby master refusing a write/non-idempotent RPC.  Carries
+    ``leader`` (the current primary's client address, when known) so the
+    multi-endpoint client can redirect instead of blind-rotating; code
+    UNAVAILABLE keeps it transparently retryable for idempotent ops."""
+
+    def __init__(self, message: str = "", *,
+                 leader: Optional[str] = None) -> None:
+        super().__init__(message or "this master is not the primary")
+        if leader:
+            self.leader = str(leader)
 
 
 class BackupError(AlluxioTpuError):

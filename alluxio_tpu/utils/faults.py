@@ -22,17 +22,31 @@ substring so a multi-worker cluster can break exactly one node:
   ``service.method`` key (e.g. scope ``create_file`` rejects only
   CreateFile).
 
-The hooks are gated on a single module flag, so a production cluster
-that never sets ``atpu.debug.fault.*`` pays one attribute read per
-hook site.  Everything here is test/chaos machinery: see
-``docs/self_healing.md`` for how the remediation tests use it.
+The HA chaos drill (docs/ha.md) adds four programmatic faults — set by
+the minicluster / :class:`FaultPlan`, not by conf, since they only make
+sense against an orchestrated multi-master cluster:
+
+- **tailer freeze** — a standby's journal tailer (or Raft apply loop)
+  stops applying: its advertised ``md_version`` stops advancing, which
+  is exactly what the standby-read staleness invariant must survive;
+- **election freeze** — a quorum member skips starting elections while
+  frozen, making "who wins the next election" deterministic in drills;
+- **partition** — Raft peer calls touching a matching node id are
+  dropped with a ``ConnectionError`` (responses ride the same call, so
+  one-sided dropping cuts the link both ways);
+- **fsync errors** — the next N journal fsyncs raise ``OSError`` at the
+  ``LocalJournalSystem._fsync`` choke point: the crash-point drill for
+  "latch broken, never ack-then-lose".
+
+``FaultPlan`` sequences such faults (plus cluster actions like
+kill/restart-primary) into one deterministic, replayable schedule.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 class FaultInjector:
@@ -47,9 +61,16 @@ class FaultInjector:
         self.rpc_reject_rate: float = 0.0
         self.rpc_reject_retry_after_s: float = 0.05
         self.scope: str = ""
+        #: HA chaos faults (programmatic; see module docstring)
+        self.tailer_freeze_scope: str = ""
+        self.election_freeze_scope: str = ""
+        self.partitioned: "frozenset[str]" = frozenset()
+        self.fsync_errors: int = 0
         #: injected-fault tallies, for tests and fsadmin spelunking
         self.injected = {"read_latency": 0, "heartbeat_freeze": 0,
-                         "ufs_error": 0, "rpc_reject": 0}
+                         "ufs_error": 0, "rpc_reject": 0,
+                         "tailer_freeze": 0, "election_freeze": 0,
+                         "partition_drop": 0, "fsync_error": 0}
         self._ufs_reads = 0
         self._ufs_failed = 0
         self._rpc_calls = 0
@@ -74,7 +95,11 @@ class FaultInjector:
             heartbeat_freeze: Optional[bool] = None,
             ufs_error_rate: Optional[float] = None,
             rpc_reject_rate: Optional[float] = None,
-            scope: Optional[str] = None) -> None:
+            scope: Optional[str] = None,
+            tailer_freeze_scope: Optional[str] = None,
+            election_freeze_scope: Optional[str] = None,
+            partitioned: Optional[Sequence[str]] = None,
+            fsync_errors: Optional[int] = None) -> None:
         global _armed
         with self._lock:
             if read_latency_s is not None:
@@ -89,8 +114,24 @@ class FaultInjector:
                     0.0, float(rpc_reject_rate)))
             if scope is not None:
                 self.scope = str(scope)
-            _armed = bool(self.read_latency_s or self.heartbeat_freeze
-                          or self.ufs_error_rate or self.rpc_reject_rate)
+            if tailer_freeze_scope is not None:
+                self.tailer_freeze_scope = str(tailer_freeze_scope)
+            if election_freeze_scope is not None:
+                self.election_freeze_scope = str(election_freeze_scope)
+            if partitioned is not None:
+                self.partitioned = frozenset(
+                    str(p) for p in partitioned if str(p))
+            if fsync_errors is not None:
+                self.fsync_errors = max(0, int(fsync_errors))
+            self._rearm_locked()
+
+    def _rearm_locked(self) -> None:
+        global _armed
+        _armed = bool(self.read_latency_s or self.heartbeat_freeze
+                      or self.ufs_error_rate or self.rpc_reject_rate
+                      or self.tailer_freeze_scope
+                      or self.election_freeze_scope
+                      or self.partitioned or self.fsync_errors)
 
     def reset(self) -> None:
         global _armed
@@ -100,6 +141,10 @@ class FaultInjector:
             self.ufs_error_rate = 0.0
             self.rpc_reject_rate = 0.0
             self.scope = ""
+            self.tailer_freeze_scope = ""
+            self.election_freeze_scope = ""
+            self.partitioned = frozenset()
+            self.fsync_errors = 0
             self._ufs_reads = 0
             self._ufs_failed = 0
             self._rpc_calls = 0
@@ -138,6 +183,55 @@ class FaultInjector:
                 return True
         return False
 
+    def tailer_frozen(self, node: str) -> bool:
+        """True while ``node`` matches the tailer-freeze scope: the
+        standby's tailer (or Raft apply loop) skips applying, so its
+        advertised md_version stops advancing — the staleness-contract
+        drill."""
+        scope = self.tailer_freeze_scope
+        if scope and scope in node:
+            self.injected["tailer_freeze"] += 1
+            return True
+        return False
+
+    def election_frozen(self, node: str) -> bool:
+        """True while ``node`` matches the election-freeze scope: the
+        member sits out elections (still votes), making drill outcomes
+        deterministic."""
+        scope = self.election_freeze_scope
+        if scope and scope in node:
+            self.injected["election_freeze"] += 1
+            return True
+        return False
+
+    def link_blocked(self, a: str, b: str) -> bool:
+        """True when either endpoint of a peer call matches a
+        partitioned node id.  Checked on the SENDING side only —
+        responses ride the same call, so dropping outbound traffic at
+        both members cuts the link bidirectionally."""
+        part = self.partitioned
+        if not part:
+            return False
+        for p in part:
+            if p in a or p in b:
+                self.injected["partition_drop"] += 1
+                return True
+        return False
+
+    def take_fsync_error(self) -> bool:
+        """True when this journal fsync should fail (countdown armed by
+        ``fsync_errors=N``): the crash-point drill for the journal's
+        latch-broken-never-ack-then-lose contract."""
+        if self.fsync_errors <= 0:
+            return False
+        with self._lock:
+            if self.fsync_errors <= 0:
+                return False
+            self.fsync_errors -= 1
+            self.injected["fsync_error"] += 1
+            self._rearm_locked()
+            return True
+
     def take_rpc_reject(self, method_key: str) -> float:
         """Retry-after seconds when this RPC dispatch should be shed
         with an injected ``ResourceExhausted``; 0.0 = admit.  Same
@@ -171,3 +265,78 @@ def armed() -> bool:
 class InjectedFaultError(IOError):
     """Raised by the UFS hook; a distinct type so tests can tell an
     injected failure from a real one."""
+
+
+class FaultStep:
+    """One scheduled chaos action: at ``at_s`` seconds into the plan,
+    call the action named ``action`` with ``kwargs``."""
+
+    __slots__ = ("at_s", "action", "kwargs")
+
+    def __init__(self, at_s: float, action: str, **kwargs) -> None:
+        self.at_s = float(at_s)
+        self.action = str(action)
+        self.kwargs = kwargs
+
+    def __repr__(self) -> str:
+        return f"FaultStep({self.at_s}, {self.action!r}, {self.kwargs})"
+
+
+class FaultPlan:
+    """A deterministic, replayable chaos schedule.
+
+    The plan is data (ordered :class:`FaultStep`\\ s); the cluster under
+    test supplies the ``actions`` catalog (kill_primary, restart_master,
+    freeze_tailer, partition, fail_fsync, delay_elections, ...) — the
+    HA minicluster exposes exactly that (``HaCluster.chaos_actions``).
+    ``run`` executes steps strictly in schedule order, records an
+    execution log (step, wall offset, result/error), and never lets one
+    failing step silently skip the rest: errors are logged per step and
+    re-raised at the end unless ``continue_on_error``.
+
+    Determinism contract: step ORDER and each action's semantics are
+    deterministic; wall-clock offsets are best-effort (the driver
+    sleeps to each step's ``at_s``).  Invariant checkers run BETWEEN
+    steps via the optional ``between`` callback, so every interleaving
+    the plan creates is also observed."""
+
+    def __init__(self, steps: Sequence[FaultStep]) -> None:
+        self.steps: List[FaultStep] = sorted(
+            steps, key=lambda s: s.at_s)
+
+    def run(self, actions: Dict[str, Callable], *,
+            between: Optional[Callable[[FaultStep], None]] = None,
+            continue_on_error: bool = False,
+            sleep: Callable[[float], None] = time.sleep,
+            clock: Callable[[], float] = time.monotonic) -> List[dict]:
+        unknown = [s.action for s in self.steps if s.action not in actions]
+        if unknown:
+            raise KeyError(f"fault plan names unknown actions {unknown}; "
+                           f"available: {sorted(actions)}")
+        t0 = clock()
+        log: List[dict] = []
+        first_error: Optional[BaseException] = None
+        for step in self.steps:
+            wait = t0 + step.at_s - clock()
+            if wait > 0:
+                sleep(wait)
+            entry = {"at_s": step.at_s, "action": step.action,
+                     "kwargs": dict(step.kwargs),
+                     "ran_at_s": clock() - t0}
+            try:
+                entry["result"] = actions[step.action](**step.kwargs)
+                entry["ok"] = True
+            except Exception as e:  # noqa: BLE001 - logged + surfaced below
+                entry["ok"] = False
+                entry["error"] = f"{type(e).__name__}: {e}"
+                if first_error is None:
+                    first_error = e
+                if not continue_on_error:
+                    log.append(entry)
+                    raise
+            log.append(entry)
+            if between is not None:
+                between(step)
+        if first_error is not None and continue_on_error:
+            raise first_error
+        return log

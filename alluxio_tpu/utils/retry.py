@@ -88,7 +88,13 @@ class SleepingRetry(RetryPolicy):
 
 
 class ExponentialBackoffRetry(RetryPolicy):
-    """Exponential backoff with jitter, bounded by retry count."""
+    """Exponential backoff with FULL jitter, bounded by retry count.
+
+    Full jitter (sleep uniform in ``[0, backoff]``, AWS-style) rather
+    than the earlier ``[backoff/2, backoff]`` band: clients that all
+    started retrying a dead primary at the same instant (a failover)
+    must decorrelate, not stampede the new leader in half-synchronized
+    waves."""
 
     def __init__(self, base_sleep_s: float, max_sleep_s: float, max_retries: int,
                  sleep_fn: Callable[[float], None] = time.sleep,
@@ -102,7 +108,7 @@ class ExponentialBackoffRetry(RetryPolicy):
 
     def _next_sleep(self) -> float:
         backoff = min(self._max_sleep, self._base * (2 ** (self._count - 1)))
-        return backoff * (0.5 + 0.5 * self._rng.random())
+        return backoff * self._rng.random()
 
     def attempt(self) -> bool:
         if self._count == 0:
@@ -140,12 +146,27 @@ class ExponentialTimeBoundedRetry(RetryPolicy):
         self._rng = rng or _SHARED_RNG
         self._count = 0
         self._retry_after_s = 0.0
+        self._redirect = False
+        self._free_redirects = 3
 
     def note_retry_after(self, hint_s: float) -> None:
         """Server-supplied backoff hint (admission-control shedding):
         the NEXT sleep is at least this long, so a shed client stops
         hammering at exactly the rate the master asked it to."""
         self._retry_after_s = max(0.0, float(hint_s))
+
+    def note_redirect(self) -> None:
+        """HA leader-hint redirect: the failed attempt told us exactly
+        where to go (NotPrimaryError.leader), so the NEXT attempt runs
+        immediately and does not consume a retry attempt — no sleep, no
+        backoff growth.  Bounded per policy instance (a redirect chain
+        during failover is a few hops at most): after the budget, a
+        redirect loop between two confused masters — each hinting the
+        other — degrades to normal backoff instead of a zero-sleep RPC
+        spin for the whole retry window."""
+        if self._free_redirects > 0:
+            self._free_redirects -= 1
+            self._redirect = True
 
     def attempt(self) -> bool:
         now = self._time_fn()
@@ -154,9 +175,15 @@ class ExponentialTimeBoundedRetry(RetryPolicy):
             return True
         if now >= self._deadline:
             return False
+        if self._redirect:
+            self._redirect = False
+            return True
+        # FULL jitter (uniform in [0, backoff]): failover makes every
+        # client of the dead primary retry in sync — a half-jitter band
+        # would stampede the new leader in correlated waves
         backoff = min(self._max_sleep, self._base * (2 ** (self._count - 1)))
         hint, self._retry_after_s = self._retry_after_s, 0.0
-        sleep = min(max(hint, backoff * (0.5 + 0.5 * self._rng.random())),
+        sleep = min(max(hint, backoff * self._rng.random()),
                     max(0.0, self._deadline - now))
         self._sleep_fn(sleep)
         self._count += 1
@@ -189,6 +216,7 @@ def retry(fn: Callable[[], T], policy: RetryPolicy,
     """
     last: Optional[BaseException] = None
     note = getattr(policy, "note_retry_after", None)
+    note_redirect = getattr(policy, "note_redirect", None)
     while policy.attempt():
         try:
             return fn()
@@ -199,5 +227,9 @@ def retry(fn: Callable[[], T], policy: RetryPolicy,
             hint = getattr(e, "retry_after_s", None)
             if hint and note is not None:
                 note(hint)
+            # a leader-hint redirect (NotPrimaryError.leader) names the
+            # exact master to try next: go there NOW, free of charge
+            if getattr(e, "leader", None) and note_redirect is not None:
+                note_redirect()
     assert last is not None
     raise last

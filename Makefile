@@ -31,8 +31,10 @@ bench-prefetch:  ## clairvoyant prefetch: hit-rate + p50/p99 block-ready latenes
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress prefetch --clairvoyant \
 		--num-workers 1 --num-files 4 --file-mb 8 --epochs 2
 
-bench-obs:  ## tracing overhead: spans/sec + on-vs-off read latency (<2% budget)
+bench-obs:  ## observability gates: tracing + profiler overhead (<2% budget), critical-path attribution (>=90%)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs --row profile
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs --row critical-path --file-mb 2 --reads 80
 
 bench-health:  ## metrics-history ingestion: heartbeat hot-path overhead (<5% gate, fake clock)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress health

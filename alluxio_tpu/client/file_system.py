@@ -153,6 +153,9 @@ class FileSystem:
         from alluxio_tpu.utils.tracing import apply_trace_conf
 
         apply_trace_conf(self._conf)
+        from alluxio_tpu.utils.profiler import apply_profile_conf
+
+        apply_profile_conf(self._conf)
         from alluxio_tpu.security.authentication import client_metadata
 
         md = tuple(client_metadata(self._conf))
@@ -281,9 +284,12 @@ class FileSystem:
         from alluxio_tpu.utils.tracing import tracer
 
         spans = tracer().drain(500) if tracer().enabled else []
+        from alluxio_tpu.utils.profiler import profiler
+
+        flame = profiler().drain() if profiler().running else None
         resp = self.meta_master.metrics_heartbeat(
             f"client-{socket.gethostname()}-{id(self):x}",
-            metrics().snapshot(), spans=spans,
+            metrics().snapshot(), spans=spans, profile=flame,
             md_cache_version=self._md_cache.applied_version
             if self._md_cache is not None else None,
             want_md_invalidations=self._md_cache is not None)

@@ -35,8 +35,9 @@ from alluxio_tpu.client.cache.meta import PageId
 from alluxio_tpu.client.file_system import FileSystem
 from alluxio_tpu.conf import Keys
 from alluxio_tpu.metrics import metrics
-from alluxio_tpu.metrics.stall import BUCKET_ADVICE, STALL_BUCKETS
-from alluxio_tpu.utils.tracing import annotate
+from alluxio_tpu.metrics.stall import (BUCKET_ADVICE, SIZE_BUCKETS,
+                                       STALL_BUCKETS, size_bucket)
+from alluxio_tpu.utils.tracing import annotate, current_span
 
 
 #: live StepStats instances backing the ONE process-level
@@ -81,6 +82,12 @@ class StepStats:
         self.wait_s = {b: 0.0 for b in STALL_BUCKETS}
         self.count = {b: 0 for b in STALL_BUCKETS}
         self.bytes = {b: 0 for b in STALL_BUCKETS}
+        # op-size attribution alongside the tier attribution: a stall
+        # profile dominated by le4k ops is per-op RPC overhead, not
+        # bandwidth — different fix, so it gets its own columns
+        self.size_wait_s = {b: 0.0 for b in SIZE_BUCKETS}
+        self.size_count = {b: 0 for b in SIZE_BUCKETS}
+        self.size_bytes = {b: 0 for b in SIZE_BUCKETS}
         #: rolling (wait_s, elapsed_s) per consumed block — the gauge's
         #: window, so the fraction tracks NOW, not the whole run
         self._window: deque = deque(maxlen=window)
@@ -110,12 +117,19 @@ class StepStats:
                elapsed_s: float) -> None:
         if bucket not in self.wait_s:
             bucket = "unknown"
+        sb = size_bucket(nbytes)
         with self._lock:
             self.wait_s[bucket] += wait_s
             self.count[bucket] += 1
             self.bytes[bucket] += nbytes
+            self.size_wait_s[sb] += wait_s
+            self.size_count[sb] += 1
+            self.size_bytes[sb] += nbytes
             self._window.append((wait_s, max(elapsed_s, wait_s)))
         self._m.timer(f"Client.InputStall.{bucket}").update(wait_s)
+        self._m.counter(f"Client.InputStallSizeUs.{sb}").inc(
+            int(wait_s * 1e6))
+        self._m.counter(f"Client.InputStallSizeCount.{sb}").inc()
         self._m.counter(f"Client.InputStallUs.{bucket}").inc(
             int(wait_s * 1e6))
         self._m.counter(f"Client.InputStallCount.{bucket}").inc()
@@ -133,6 +147,9 @@ class StepStats:
             wait = dict(self.wait_s)
             count = dict(self.count)
             nbytes = dict(self.bytes)
+            s_wait = dict(self.size_wait_s)
+            s_count = dict(self.size_count)
+            s_bytes = dict(self.size_bytes)
         total = sum(wait.values())
         buckets = {}
         for b in STALL_BUCKETS:
@@ -154,9 +171,19 @@ class StepStats:
                        f"top bottleneck: {top} "
                        f"({buckets[top]['share']:.0%} of "
                        f"{total:.3f}s stall) — {BUCKET_ADVICE[top]}")
+        size_buckets = {}
+        for b in SIZE_BUCKETS:
+            if not s_count[b]:
+                continue
+            size_buckets[b] = {
+                "wait_s": round(s_wait[b], 6), "count": s_count[b],
+                "bytes": s_bytes[b],
+                "share": round(s_wait[b] / total, 4) if total else 0.0,
+            }
         return {"total_wait_s": round(total, 6),
                 "input_bound_fraction": round(frac, 4),
                 "buckets": buckets, "ranked": ranked,
+                "size_buckets": size_buckets,
                 "verdict": verdict}
 
 
@@ -494,11 +521,24 @@ class DeviceBlockLoader:
                 self.step_stats.record(bucket, now - wait_t0, nbytes,
                                        now - last_item_t)
                 last_item_t = now
+                outer = current_span()
+                if outer is not None:
+                    # consumer-side pipeline wait: the time this step
+                    # spent blocked on the producer queue
+                    outer.phase("drain", (now - wait_t0) * 1000.0)
                 if on_device:
                     arr = data
                 else:
                     with annotate("atpu.loader.h2d"):
-                        arr = self._jax.device_put(data, self._device)
+                        sp = current_span()
+                        if sp is None:
+                            arr = self._jax.device_put(data, self._device)
+                        else:
+                            t_put = _time.perf_counter()
+                            arr = self._jax.device_put(data, self._device)
+                            sp.phase("device_put",
+                                     (_time.perf_counter() - t_put)
+                                     * 1000.0)
                     if self._hbm is not None:
                         self._hbm.adopt(pid, arr)  # no second transfer
                 inflight.append(arr)

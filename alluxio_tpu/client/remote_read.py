@@ -299,6 +299,12 @@ class StripedRead:
         self.reroutes = 0
         self._m = metrics()
         self._span = self._open_span()
+        #: phase accumulators (only written when this read is traced):
+        #: executor queue wait and transfer ("wire") time of winning
+        #: attempts, summed across stripes
+        self._queue_ms = 0.0
+        self._wire_ms = 0.0
+        self._latency_recorded = False
 
     # -- tracing -------------------------------------------------------------
     def _open_span(self):
@@ -317,9 +323,31 @@ class StripedRead:
                      "sources": str(len(self._sources))}
         return span
 
+    def _record_latency(self) -> None:
+        """Size-bucketed end-to-end latency with a trace exemplar: the
+        ``Client.ReadLatency.{le4k,le64k,le1m,gt1m}`` timers are what
+        ``fsadmin report history`` watches for p99 regressions, and the
+        exemplar (this read's trace id, when sampled) links an outlier
+        bucket straight to an attributable trace."""
+        if self._n <= 0 or self._latency_recorded:
+            return
+        self._latency_recorded = True
+        from alluxio_tpu.metrics.stall import size_bucket
+
+        exemplar = self._span.trace_id \
+            if self._span is not None and self._span.sampled else None
+        self._m.timer(
+            f"Client.ReadLatency.{size_bucket(self._n)}").update(
+            time.perf_counter() - self._t0, exemplar=exemplar)
+
     def _close_span(self) -> None:
+        self._record_latency()
         if self._span is None:
             return
+        if self._queue_ms > 0.0:
+            self._span.phase("queue_wait", self._queue_ms)
+        if self._wire_ms > 0.0:
+            self._span.phase("wire", self._wire_ms)
         self._span.duration_ms = (time.perf_counter() - self._t0) * 1000.0
         self._span.tags["hedges"] = str(self.hedges)
         self._span.tags["hedge_wins"] = str(self.hedge_wins)
@@ -531,7 +559,11 @@ class StripedRead:
         # queued behind other attempts in the shared executor is not
         # the worker's latency — counting it would hedge queued stripes
         # into the same saturated queue and corrupt the EWMA
-        a.started = time.perf_counter()
+        now = time.perf_counter()
+        if self._span is not None:
+            with self._cond:
+                self._queue_ms += (now - a.started) * 1000.0
+        a.started = now
         try:
             handle = a.source.open(self._offset + rel_off, ln, self._chunk)
             with self._cond:
@@ -616,6 +648,9 @@ class StripedRead:
         self._m.counter("Client.RemoteReadStripes").inc()
         self._m.counter("Client.RemoteReadBytes").inc(ln)
         with self._cond:
+            if self._span is not None:
+                # winning transfers only: the read was blocked on these
+                self._wire_ms += latency * 1000.0
             self._attempt_gone_locked(a)
             self._landed[i] = True
             if src_tag is not None:

@@ -871,6 +871,39 @@ class Keys:
         description="Completed spans retained per process (oldest "
                     "evicted first). Workers/clients drain the ring to "
                     "the master on the metrics heartbeat.")
+    PROFILE_ENABLED = _k(
+        "atpu.profile.enabled", KeyType.BOOL, default=False,
+        scope=Scope.ALL,
+        description="Run the sampling thread-stack profiler "
+                    "(utils/profiler.py): a daemon thread periodically "
+                    "snapshots every thread's Python stack and merges "
+                    "them into flame-graph counts, shipped to the "
+                    "master on the metrics heartbeat. Off by default — "
+                    "the read path must stay byte-identical when "
+                    "profiling is disabled.")
+    PROFILE_SAMPLE_INTERVAL_MS = _k(
+        "atpu.profile.sample.interval.ms", KeyType.INT, default=97,
+        scope=Scope.ALL,
+        description="Milliseconds between stack samples. A prime-ish "
+                    "default avoids beating against periodic work. "
+                    "Each wake forces a GIL handoff against whatever "
+                    "thread is running (~1ms observed), so the cost is "
+                    "per-wake, not per-stack: ~10Hz keeps the tax "
+                    "under the 2% obs-profile-overhead gate while "
+                    "still resolving hot paths over a heartbeat "
+                    "window.")
+    PROFILE_MAX_STACKS = _k(
+        "atpu.profile.max.stacks", KeyType.INT, default=2048,
+        scope=Scope.ALL,
+        description="Distinct merged stacks retained per process; "
+                    "when full, new stacks are dropped (the hot paths "
+                    "are by definition already in the table).")
+    PROFILE_STACK_DEPTH = _k(
+        "atpu.profile.stack.depth", KeyType.INT, default=24,
+        scope=Scope.ALL,
+        description="Frames kept per sampled stack, innermost first — "
+                    "deeper frames are truncated to bound sample cost "
+                    "and wire size.")
     MASTER_METRICS_MAX_SOURCES = _k(
         "atpu.master.metrics.max.sources", KeyType.INT, default=4096,
         scope=Scope.MASTER,

@@ -4,6 +4,9 @@ The walk classifies the string literals the registries care about:
 
 - metric EMITS      first arg of ``.counter/.meter/.timer/.register_gauge``
 - span EMITS        first arg of ``.span(`` / ``annotate(`` / ``Span(``
+- phase EMITS       first arg of ``.phase(`` (typed phase events inside
+                    spans; the catalog is the ``PHASES`` tuple in
+                    utils/tracing.py)
 - metric CONSUMES   any other full-string instance-prefixed literal
                     (health rules, benches, fsadmin, snapshot keys)
 - conf literals     any other full-string ``atpu.*`` literal
@@ -33,6 +36,10 @@ CONF_RE = re.compile(r"^atpu\.[a-z][a-z0-9_.{}*<>-]*$")
 
 _METRIC_EMIT_METHODS = {"counter", "meter", "timer", "register_gauge"}
 _SPAN_EMIT_CALLEES = {"span", "annotate", "Span", "start_span"}
+_PHASE_EMIT_CALLEES = {"phase"}
+
+#: the typed-phase catalog lives here as ``PHASES = (...)``
+_PHASE_CATALOG_PATH = "alluxio_tpu/utils/tracing.py"
 
 
 @dataclass(frozen=True)
@@ -53,6 +60,9 @@ class RepoFacts:
     metric_emits: List[StrSite] = field(default_factory=list)
     metric_consumes: List[StrSite] = field(default_factory=list)
     span_emits: List[StrSite] = field(default_factory=list)
+    phase_emits: List[StrSite] = field(default_factory=list)
+    #: phase name -> (path, line) of its PHASES-tuple catalog entry
+    phase_catalog: Dict[str, Tuple[str, int]] = field(default_factory=dict)
     conf_literals: List[StrSite] = field(default_factory=list)
     #: Keys.<ATTR> reads per file (attribute name, path, line)
     keys_attr_reads: List[Tuple[str, str, int]] = field(default_factory=list)
@@ -67,6 +77,9 @@ class RepoFacts:
 
     def span_names(self) -> Set[str]:
         return {s.value for s in self.span_emits}
+
+    def phase_names(self) -> Set[str]:
+        return {s.value for s in self.phase_emits if not s.pattern}
 
 
 def _joinedstr_glob(node: ast.JoinedStr) -> Optional[str]:
@@ -107,6 +120,20 @@ def collect_file(pf: PyFile, facts: RepoFacts) -> None:
     doc_lines = pf.docstring_lines()
     emit_nodes: Set[int] = set()  # id() of first-arg nodes already classified
 
+    if pf.path == _PHASE_CATALOG_PATH:
+        # the PHASES tuple IS the phase registry
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Assign) and \
+                    any(isinstance(t, ast.Name) and t.id == "PHASES"
+                        for t in node.targets) and \
+                    isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        facts.phase_catalog[elt.value] = \
+                            (pf.path, elt.lineno)
+                        emit_nodes.add(id(elt))
+
     if pf.path == _HEARTBEAT_CATALOG_PATH:
         # class-level string constants there ARE the heartbeat registry
         for node in ast.walk(pf.tree):
@@ -135,6 +162,14 @@ def collect_file(pf: PyFile, facts: RepoFacts) -> None:
             elif callee in _SPAN_EMIT_CALLEES and arg is not None:
                 value, pattern, line = arg
                 facts.span_emits.append(
+                    StrSite(value, pf.path, line, pattern))
+                emit_nodes.add(id(node.args[0]))
+            elif callee in _PHASE_EMIT_CALLEES and arg is not None and \
+                    isinstance(node.func, ast.Attribute):
+                # attribute form only (sp.phase(...)): a bare phase()
+                # is some other function, not a Span phase event
+                value, pattern, line = arg
+                facts.phase_emits.append(
                     StrSite(value, pf.path, line, pattern))
                 emit_nodes.add(id(node.args[0]))
         elif isinstance(node, ast.Attribute) and \

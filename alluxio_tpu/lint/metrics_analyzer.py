@@ -264,6 +264,24 @@ def write_metrics_doc(path: str, facts: RepoFacts) -> None:
     ]
     for name in spans:
         lines.append(f"| `{name}` |")
+    phase_sites: Dict[str, List[StrSite]] = {}
+    for site in facts.phase_emits:
+        phase_sites.setdefault(site.value, []).append(site)
+    lines += [
+        "",
+        "## Read-path phases",
+        "",
+        "Typed phase events recorded inside spans (`Span.phase`);",
+        "the catalog is `PHASES` in `alluxio_tpu/utils/tracing.py` and",
+        "the critical-path analyzer ranks read-path time by these names",
+        "(`fsadmin report readpath`, docs/observability.md).",
+        "",
+        "| phase | emitted by |",
+        "|---|---|",
+    ]
+    for name in sorted(facts.phase_catalog):
+        paths = sorted({s.path for s in phase_sites.get(name, ())})
+        lines.append(f"| `{name}` | {', '.join(paths) or '-'} |")
     lines.append("")
     with open(path, "w", encoding="utf-8") as f:
         f.write("\n".join(lines))

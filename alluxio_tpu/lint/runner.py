@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from alluxio_tpu.lint import (
     conf_analyzer, exceptions_analyzer, locks_analyzer, metrics_analyzer,
+    phases_analyzer,
 )
 from alluxio_tpu.lint.collect import RepoFacts, collect
 from alluxio_tpu.lint.findings import (
@@ -26,6 +27,7 @@ from alluxio_tpu.lint.model import RepoModel, build_model, changed_paths
 ANALYZERS: Dict[str, Callable[[RepoModel, RepoFacts], List[Finding]]] = {
     "conf-keys": conf_analyzer.analyze,
     "metric-names": metrics_analyzer.analyze,
+    "phase-names": phases_analyzer.analyze,
     "lock-discipline": locks_analyzer.analyze,
     "exceptions": exceptions_analyzer.analyze,
 }

@@ -230,6 +230,10 @@ class MetricsMaster:
         self.store = store or MetricsStore()
         self.traces = traces or TraceStore()
         self.history = history
+        #: source -> accumulated flame data shipped by that node's
+        #: stack sampler (utils/profiler.py) on the metrics heartbeat
+        self.flames: dict = {}
+        self._flames_lock = threading.Lock()
         self._last_cluster_sample = 0.0
         #: serializes drain_history: the health heartbeat and the
         #: query surfaces (web/RPC) all drain, and an unsynchronized
@@ -254,7 +258,38 @@ class MetricsMaster:
             # lost worker) must not keep washing the bounded trace
             # ring with live-looking spans either
             self.traces.ingest(source, spans)
+        flame = request.get("profile")
+        if isinstance(flame, dict) and accepted:
+            from alluxio_tpu.utils.profiler import merge_flames
+
+            with self._flames_lock:
+                # same source cap as the metric store: `accepted`
+                # already bounds who gets a flame slot
+                self.flames[source] = merge_flames(
+                    self.flames.get(source), flame)
         return {}
+
+    def flame_report(self, source: str = "") -> dict:
+        """Accumulated flame data (``/api/v1/master/profile``): one
+        source's merged stacks, or the per-source sample totals."""
+        from alluxio_tpu.utils.profiler import merge_flames, profiler
+
+        # the master is its own source: nothing heartbeats its sampler
+        # to itself, so fold the local delta in at query time
+        local = profiler().drain() if profiler().running else None
+        if local is not None:
+            with self._flames_lock:
+                self.flames["master"] = merge_flames(
+                    self.flames.get("master"), local)
+        with self._flames_lock:
+            if source:
+                return {"source": source,
+                        "flame": self.flames.get(source)}
+            return {"sources": {
+                s: {"samples": f.get("samples", 0),
+                    "dropped": f.get("dropped", 0),
+                    "stacks": len(f.get("stacks") or ())}
+                for s, f in self.flames.items()}}
 
     def drain_history(self, now: Optional[float] = None) -> int:
         """Fold pending heartbeat snapshots into the history rings and

@@ -433,6 +433,9 @@ class MasterProcess:
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
         apply_trace_conf(self._conf)
+        from alluxio_tpu.utils.profiler import apply_profile_conf
+
+        apply_profile_conf(self._conf)
         # stall detector (reference: JvmPauseMonitor started at
         # AlluxioMasterProcess.java:265-273): a paused master misses
         # heartbeats and trips elections — make it visible. ONE per
@@ -1024,6 +1027,9 @@ class FaultTolerantMasterProcess(MasterProcess):
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
         apply_trace_conf(self._conf)
+        from alluxio_tpu.utils.profiler import apply_profile_conf
+
+        apply_profile_conf(self._conf)
         # the HA master is the one whose elections stall detection
         # protects — it must not be the one path without it
         ensure_process_monitor()

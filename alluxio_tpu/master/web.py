@@ -350,7 +350,48 @@ class MasterWebServer:
                         prefix=self.query.get("prefix", ""),
                         trace_id=self.query.get("trace_id", ""),
                         local_source="master")
+                    if self.query.get("fanout"):
+                        from alluxio_tpu.utils.trace_fanout import (
+                            merge_stitched, peer_traces)
+                        stitched = merge_stitched(
+                            stitched, peer_traces(
+                                mp._conf,
+                                limit=int(self.query.get("limit", "500")
+                                          or 500),
+                                prefix=self.query.get("prefix", ""),
+                                trace_id=self.query.get("trace_id", "")))
                     return {"enabled": tracer().enabled, **stitched}
+                if route == "/api/v1/master/profile":
+                    mm = getattr(mp, "metrics_master", None)
+                    if mm is None:
+                        return {"sources": {}}
+                    return mm.flame_report(
+                        self.query.get("source", ""))
+                if route == "/api/v1/master/trace/profile":
+                    from alluxio_tpu.utils.critical_path import (
+                        analyze_trace, profile)
+                    from alluxio_tpu.utils.tracing import (
+                        stitch_spans, tracer,
+                    )
+
+                    mm = getattr(mp, "metrics_master", None)
+                    trace_id = self.query.get("trace_id", "")
+                    stitched = stitch_spans(
+                        mm.traces if mm is not None else None,
+                        limit=int(self.query.get("limit", "4000")
+                                  or 4000),
+                        prefix=self.query.get("prefix", ""),
+                        trace_id=trace_id,
+                        local_source="master")
+                    if trace_id:
+                        return {"enabled": tracer().enabled,
+                                "critical_path":
+                                    analyze_trace(stitched["spans"])}
+                    return {"enabled": tracer().enabled,
+                            "profile": profile(
+                                stitched["spans"],
+                                root_prefix=self.query.get(
+                                    "root_prefix", ""))}
                 if route == "/api/v1/master/browse":
                     path = self.query.get("path", "/") or "/"
                     entries = mp.fs_master.list_status(path, wire=True)

@@ -90,16 +90,24 @@ class Timer:
         self._count = 0
         self._total_s = 0.0
         self._bucket_counts = [0] * len(self.HISTOGRAM_BUCKETS)
+        # bucket index -> (trace_id, observed seconds, unix ts): the most
+        # recent sampled trace that landed in that bucket, so the
+        # exposition can link slow buckets straight to a trace
+        self._exemplars: Dict[int, "tuple[str, float, float]"] = {}
         self._lock = threading.Lock()
 
-    def update(self, seconds: float) -> None:
+    def update(self, seconds: float, exemplar: Optional[str] = None) -> None:
         with self._lock:
             self._count += 1
             self._total_s += seconds
             self._samples.append(seconds)
+            idx = len(self.HISTOGRAM_BUCKETS)  # +Inf
             for i, le in enumerate(self.HISTOGRAM_BUCKETS):
                 if seconds <= le:
                     self._bucket_counts[i] += 1
+                    idx = min(idx, i)
+            if exemplar is not None:
+                self._exemplars[idx] = (exemplar, seconds, time.time())
 
     class _Ctx:
         def __init__(self, timer: "Timer") -> None:
@@ -155,6 +163,12 @@ class Timer:
             counts = list(self._bucket_counts)
             counts.append(self._count)  # +Inf
             return counts, self._total_s, self._count
+
+    def exemplars(self) -> "Dict[int, tuple[str, float, float]]":
+        """Bucket index -> (trace_id, seconds, unix_ts); index
+        ``len(HISTOGRAM_BUCKETS)`` is the +Inf bucket."""
+        with self._lock:
+            return dict(self._exemplars)
 
 
 class MetricsRegistry:
@@ -259,11 +273,26 @@ class MetricsRegistry:
             lines.append(f"{metric} {value}")
         for name, t in sorted(timers.items()):
             counts, total, n = t.histogram()
+            ex = t.exemplars()
             metric = emit(name + "_seconds", "histogram",
                           f"latency histogram of {name}")
-            for le, cum in zip(t.HISTOGRAM_BUCKETS, counts):
-                lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
-            lines.append(f'{metric}_bucket{{le="+Inf"}} {counts[-1]}')
+
+            def bucket_line(le: str, cum: int, idx: int) -> str:
+                line = f'{metric}_bucket{{le="{le}"}} {cum}'
+                e = ex.get(idx)
+                if e is not None:
+                    # OpenMetrics exemplar: links the bucket to a
+                    # representative trace id for drill-down
+                    tid, val, ts = e
+                    line += (f' # {{trace_id="{tid}"}}'
+                             f" {val:.6f} {ts:.3f}")
+                return line
+
+            for i, (le, cum) in enumerate(
+                    zip(t.HISTOGRAM_BUCKETS, counts)):
+                lines.append(bucket_line(str(le), cum, i))
+            lines.append(bucket_line("+Inf", counts[-1],
+                                     len(t.HISTOGRAM_BUCKETS)))
             lines.append(f"{metric}_sum {total}")
             lines.append(f"{metric}_count {n}")
         return "\n".join(lines) + "\n"

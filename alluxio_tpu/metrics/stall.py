@@ -13,6 +13,24 @@ from __future__ import annotations
 #: remote (cached on a remote worker), ufs (cold read-through)
 STALL_BUCKETS = ("hbm", "shm", "remote", "ufs", "unknown")
 
+#: op-size buckets shared by the read-latency histograms
+#: (``Client.ReadLatency.*``) and the per-size stall columns of the
+#: input doctor — small-read stalls (per-op RPC overhead) must be
+#: distinguishable from stripe-sized ones (bandwidth)
+SIZE_BUCKETS = ("le4k", "le64k", "le1m", "gt1m")
+
+
+def size_bucket(nbytes: int) -> str:
+    """The op-size bucket a read of ``nbytes`` falls in."""
+    if nbytes <= 4 << 10:
+        return "le4k"
+    if nbytes <= 64 << 10:
+        return "le64k"
+    if nbytes <= 1 << 20:
+        return "le1m"
+    return "gt1m"
+
+
 #: per-bucket operator hint, ranked bottleneck -> what to turn
 BUCKET_ADVICE = {
     "ufs": "cold UFS reads dominate — warm the cache or enable "

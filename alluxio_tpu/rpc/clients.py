@@ -549,6 +549,17 @@ class MetaMasterClient(_BaseClient):
         return self._call("get_trace", {"limit": limit, "prefix": prefix,
                                         "trace_id": trace_id})
 
+    def get_trace_profile(self, *, trace_id: str = "", prefix: str = "",
+                          root_prefix: str = "", limit: int = 4000,
+                          max_traces: int = 256) -> dict:
+        """Critical-path analysis over the master's stitched traces:
+        with ``trace_id`` the blocking chain of that one trace, without
+        it the aggregate per-phase read-path profile."""
+        return self._call("get_trace_profile", {
+            "trace_id": trace_id, "prefix": prefix,
+            "root_prefix": root_prefix, "limit": limit,
+            "max_traces": max_traces})
+
     def get_quorum_info(self) -> dict:
         return self._call("get_quorum_info", {})
 
@@ -584,7 +595,8 @@ class MetaMasterClient(_BaseClient):
                           metrics: Dict[str, float],
                           spans: Optional[List[dict]] = None,
                           md_cache_version: Optional[int] = None,
-                          want_md_invalidations: bool = False) -> dict:
+                          want_md_invalidations: bool = False,
+                          profile: Optional[dict] = None) -> dict:
         """Ship a node's metric snapshot — and any completed trace spans
         drained from its ring — for cluster aggregation / trace
         stitching (reference: ``metric_master.proto`` ClientMasterSync).
@@ -595,6 +607,10 @@ class MetaMasterClient(_BaseClient):
         invalidation batch since ``md_cache_version``
         (``md_invalidations`` — docs/metadata.md)."""
         req = {"source": source, "metrics": metrics, "spans": spans or []}
+        if profile is not None:
+            # merged flame data from the node's stack sampler
+            # (utils/profiler.py) rides the same heartbeat
+            req["profile"] = profile
         if want_md_invalidations:
             req["want_md_invalidations"] = True
             req["md_cache_version"] = md_cache_version

@@ -30,8 +30,8 @@ from alluxio_tpu.utils.exceptions import (
     AlluxioTpuError, ResourceExhaustedError, UnavailableError,
 )
 from alluxio_tpu.utils.tracing import (
-    TRACEPARENT_KEY, bind_remote_parent, current_traceparent,
-    reset_remote_parent, tracer,
+    TRACEPARENT_KEY, bind_remote_parent, current_span,
+    current_traceparent, reset_remote_parent, tracer,
 )
 
 LOG = logging.getLogger(__name__)
@@ -140,15 +140,28 @@ def check_admission(admission, context, method_key: str,
     admission.check(principal, method_key.rsplit(".", 1)[-1])
 
 
+def _timed_admission(sp, admission, context, span_name: str) -> None:
+    """check_admission, recording its cost as the server span's
+    ``admission`` phase when the dispatch is traced."""
+    if sp is None:
+        check_admission(admission, context, span_name)
+        return
+    import time as _time
+
+    t0 = _time.perf_counter()
+    check_admission(admission, context, span_name)
+    sp.phase("admission", (_time.perf_counter() - t0) * 1000.0)
+
+
 def _wrap_unary(fn: Callable[[dict], Any], authenticator=None,
                 span_name: str = "", admission=None) -> Callable:
     def handler(request: dict, context: grpc.ServicerContext):
         token = None
         trace_token = _bind_trace(context)
         try:
-            with tracer().span(span_name or "rpc.unary"):
+            with tracer().span(span_name or "rpc.unary") as sp:
                 token = _bind_user(context, authenticator)
-                check_admission(admission, context, span_name)
+                _timed_admission(sp, admission, context, span_name)
                 return fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -171,9 +184,9 @@ def _wrap_stream_out(fn: Callable[[dict], Iterator[Any]],
         token = None
         trace_token = _bind_trace(context)
         try:
-            with tracer().span(span_name or "rpc.stream_out"):
+            with tracer().span(span_name or "rpc.stream_out") as sp:
                 token = _bind_user(context, authenticator)
-                check_admission(admission, context, span_name)
+                _timed_admission(sp, admission, context, span_name)
                 yield from fn(request or {})
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -196,9 +209,9 @@ def _wrap_stream_in(fn: Callable[[Iterator[Any]], Any],
         token = None
         trace_token = _bind_trace(context)
         try:
-            with tracer().span(span_name or "rpc.stream_in"):
+            with tracer().span(span_name or "rpc.stream_in") as sp:
                 token = _bind_user(context, authenticator)
-                check_admission(admission, context, span_name)
+                _timed_admission(sp, admission, context, span_name)
                 return fn(request_iterator)
         except AlluxioTpuError as e:
             context.set_trailing_metadata(((_ERROR_KEY, pack(e.to_wire())),))
@@ -348,13 +361,20 @@ class StreamCall:
     :meth:`cancel` to abort the underlying HTTP/2 stream mid-flight
     (hedged reads cancel the losing transfer instead of draining it).
     A self-cancelled stream ends iteration quietly; every other gRPC
-    error is re-raised typed like the plain ``call_stream`` path."""
+    error is re-raised typed like the plain ``call_stream`` path.
 
-    __slots__ = ("_call", "cancelled")
+    When the stream was opened under a live span, per-chunk msgpack
+    decode time accumulates in ``decode_cell`` and lands on that span
+    as ONE ``serialize`` phase when iteration ends (per-chunk phase
+    events would bloat a large read's span)."""
 
-    def __init__(self, call) -> None:
+    __slots__ = ("_call", "cancelled", "_span", "_decode_cell")
+
+    def __init__(self, call, span=None, decode_cell=None) -> None:
         self._call = call
         self.cancelled = False
+        self._span = span
+        self._decode_cell = decode_cell
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -367,6 +387,11 @@ class StreamCall:
             if self.cancelled and e.code() == grpc.StatusCode.CANCELLED:
                 return
             _raise_typed(e)
+        finally:
+            if self._span is not None and self._decode_cell is not None \
+                    and self._decode_cell[0] > 0.0:
+                self._span.phase("serialize", self._decode_cell[0])
+                self._decode_cell[0] = 0.0
 
 
 class RpcChannel:
@@ -441,12 +466,41 @@ class RpcChannel:
         """Like :meth:`call_stream` but returns the live call wrapped as
         a :class:`StreamCall`, so the caller can ``cancel()`` it — the
         parallel read path races stripe transfers and must be able to
-        abort the losers without draining them."""
+        abort the losers without draining them.
+
+        Under a live span the request pack and the per-chunk decodes
+        are timed into the span's ``serialize`` phase: the pack happens
+        eagerly here (grpc gets the pre-packed blob via an identity
+        serializer, so nothing is encoded twice) and decode time is
+        accumulated by the deserializer closure until the stream ends."""
+        sp = current_span()
+        if sp is None:
+            fn = self._channel.unary_stream(
+                f"/{service}/{method}", request_serializer=pack,
+                response_deserializer=unpack)
+            return StreamCall(fn(request, timeout=timeout,
+                                 metadata=self._call_metadata()))
+        import time as _time
+
+        clock = _time.perf_counter
+        t0 = clock()
+        blob = pack(request)
+        sp.phase("serialize", (clock() - t0) * 1000.0)
+        cell = [0.0]
+
+        def _timed_unpack(data: bytes):
+            t = clock()
+            obj = unpack(data)
+            cell[0] += (clock() - t) * 1000.0
+            return obj
+
         fn = self._channel.unary_stream(
-            f"/{service}/{method}", request_serializer=pack,
-            response_deserializer=unpack)
+            f"/{service}/{method}",
+            request_serializer=lambda _r: blob,
+            response_deserializer=_timed_unpack)
         return StreamCall(fn(request, timeout=timeout,
-                             metadata=self._call_metadata()))
+                             metadata=self._call_metadata()),
+                          span=sp, decode_cell=cell)
 
     def call_stream_in(self, service: str, method: str,
                        requests: Iterator[dict],

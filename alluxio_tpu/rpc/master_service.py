@@ -386,8 +386,32 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
             local_source="master")
         return {"enabled": tracer().enabled, **stitched}
 
+    def _get_trace_profile(r):
+        """Critical-path analysis over stitched traces: one trace id ->
+        its blocking chain; no id -> the aggregate per-phase read-path
+        profile (what ``fsadmin report readpath`` renders)."""
+        from alluxio_tpu.utils.critical_path import analyze_trace, profile
+        from alluxio_tpu.utils.tracing import stitch_spans, tracer
+
+        trace_id = r.get("trace_id") or ""
+        stitched = stitch_spans(
+            metrics_master.traces if metrics_master is not None else None,
+            limit=int(r.get("limit") or 4000),
+            prefix=r.get("prefix") or "",
+            trace_id=trace_id,
+            local_source="master")
+        if trace_id:
+            return {"enabled": tracer().enabled,
+                    "critical_path": analyze_trace(stitched["spans"])}
+        return {"enabled": tracer().enabled,
+                "profile": profile(
+                    stitched["spans"],
+                    root_prefix=r.get("root_prefix") or "",
+                    max_traces=int(r.get("max_traces") or 256))}
+
     svc.unary("set_trace_enabled", _set_trace_enabled)
     svc.unary("get_trace", _get_trace)
+    svc.unary("get_trace_profile", _get_trace_profile)
     def _get_metrics(r):
         snap = metrics().snapshot()
         if metrics_master is not None:

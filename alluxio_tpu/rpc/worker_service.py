@@ -106,6 +106,7 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
 
         from alluxio_tpu.metrics import metrics
         from alluxio_tpu.utils import faults
+        from alluxio_tpu.utils.tracing import current_span
 
         clock = _time.monotonic
         fault_host = worker.address.tiered_identity.value("host") \
@@ -117,10 +118,17 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
         # cached-tier loop forever without advancing pos
         chunk = max(1, req.get("chunk_size", DEFAULT_CHUNK))
         m = metrics()
+        # the server span (opened by the RPC wrapper) stays live across
+        # the generator's resumptions on this thread; phase timings are
+        # accumulated locally and emitted ONCE at stream end
+        sp = current_span()
         if worker.store.has_block(block_id):
             produce_s = 0.0
             produced_b = 0
+            wire_s = 0.0
             try:
+                # open_reader emits the ``lock_wait`` phase itself
+                # (tiered_store.get_reader times the block-lock acquire)
                 with worker.open_reader(block_id) as r:
                     tier = r.tier_alias or "MEM"
                     m.counter(f"Worker.BlocksServed.{tier}").inc()
@@ -141,11 +149,23 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
                                 fault_host)
                         produce_s += clock() - t0
                         produced_b += len(data)
-                        yield {"data": data, "offset": pos,
-                               "source": tier}
+                        if sp is None:
+                            yield {"data": data, "offset": pos,
+                                   "source": tier}
+                        else:
+                            # yield suspension = grpc serialize + send
+                            # + HTTP/2 flow control: the per-op RPC
+                            # overhead the microscope exists to expose
+                            t_y = clock()
+                            yield {"data": data, "offset": pos,
+                                   "source": tier}
+                            wire_s += clock() - t_y
                         served.inc(n)
                         pos += n
             finally:
+                if sp is not None:
+                    sp.phase("tier_read", produce_s * 1000.0)
+                    sp.phase("wire", wire_s * 1000.0)
                 # sample only reads whose per-MiB figure the fixed
                 # per-read-call overhead cannot skew: a client-chosen
                 # tiny chunk size multiplies that fixed cost into
@@ -179,11 +199,19 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
         end = desc.length if length < 0 else min(desc.length,
                                                  offset + length)
         pos = offset
+        wire_s = 0.0
         for data in fetch.iter_range(offset, max(0, end - offset),
                                      chunk_size=chunk):
-            yield {"data": data, "offset": pos, "source": "UFS"}
+            if sp is None:
+                yield {"data": data, "offset": pos, "source": "UFS"}
+            else:
+                t_y = clock()
+                yield {"data": data, "offset": pos, "source": "UFS"}
+                wire_s += clock() - t_y
             served.inc(len(data))
             pos += len(data)
+        if sp is not None:
+            sp.phase("wire", wire_s * 1000.0)
         # the cache-fill commit trails the last stripe; close the
         # stream only once it lands so "read completed" keeps implying
         # "block cached" for clients and heartbeats (seed semantics).

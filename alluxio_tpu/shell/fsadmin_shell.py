@@ -22,13 +22,14 @@ ADMIN_SHELL = Shell("fsadmin", "Administer the alluxio-tpu cluster.")
 class ReportCommand(Command):
     name = "report"
     description = ("Report cluster summary|capacity|ufs|metrics|"
-                   "jobservice|stall|history|health|qos|masters.")
+                   "jobservice|stall|readpath|history|health|qos|"
+                   "masters.")
 
     def configure(self, p):
         p.add_argument("category", nargs="?", default="summary",
                        choices=["summary", "capacity", "ufs", "metrics",
-                                "jobservice", "stall", "history",
-                                "health", "qos", "masters"])
+                                "jobservice", "stall", "readpath",
+                                "history", "health", "qos", "masters"])
         p.add_argument("metric", nargs="?", default="",
                        help="history: metric name (omit to list "
                             "recorded names)")
@@ -445,6 +446,25 @@ class ReportCommand(Command):
         attributed = (100.0 * named_s / total_s) if total_s else 100.0
         ctx.print(f"    attributed to a named tier: {attributed:.1f}% "
                   f"of {total_s:.3f}s total wait")
+        # op-size columns: the same stalls re-cut by read size — a
+        # le4k-dominated profile is per-op RPC overhead (see
+        # `report readpath`), not bandwidth
+        size_us = bucket_stats("SizeUs")
+        size_counts = bucket_stats("SizeCount")
+        if size_us:
+            from alluxio_tpu.metrics.stall import SIZE_BUCKETS
+
+            ctx.print(f"    {'op size':<10s} {'waits':>8s} "
+                      f"{'stalled':>12s} {'share':>7s}")
+            for b in SIZE_BUCKETS:
+                us = size_us.get(b)
+                if not us:
+                    continue
+                s = us / 1e6
+                share = (100.0 * s / total_s) if total_s else 0.0
+                ctx.print(f"    {b:<10s} "
+                          f"{int(size_counts.get(b, 0)):>8d} "
+                          f"{s:>11.3f}s {share:>6.1f}%")
         # cluster mean first (the fleet view, averaged across reporting
         # clients); the master's own gauge only exists when a loader
         # ran in-process and would shadow the fleet with a stale 0.0
@@ -459,6 +479,42 @@ class ReportCommand(Command):
         ctx.print(f"Verdict: top bottleneck is '{top}' ({share:.0f}% of "
                   f"stall) — "
                   f"{BUCKET_ADVICE.get(top, 'no advice for this tier')}")
+        return 0
+
+    def _readpath(self, ctx):
+        """Read-path microscope: ranked per-phase critical-path profile
+        over the master's sampled traces (``get_trace_profile``). Run
+        with tracing on (``fsadmin trace --on``) while a workload
+        reads — the table names what each read was actually blocked
+        on, phase by phase (docs/observability.md)."""
+        resp = ctx.meta_client().get_trace_profile(root_prefix="atpu.")
+        if not resp.get("enabled"):
+            ctx.eprint("tracing is off — enable with "
+                       "`fsadmin trace --on`, run the workload, then "
+                       "re-run this report")
+        prof = resp.get("profile") or {}
+        n = prof.get("traces_analyzed", 0)
+        ctx.print(f"Read-path critical-path profile "
+                  f"({n} traces analyzed):")
+        if not n:
+            ctx.print("    no complete traces stitched yet — spans "
+                      "arrive on the metrics heartbeat; wait one "
+                      "interval and retry")
+            return 0
+        ctx.print(f"    wall: total {prof['wall_ms_total']:.1f} ms, "
+                  f"p50 {prof['wall_ms_p50']:.2f} ms, "
+                  f"p99 {prof['wall_ms_p99']:.2f} ms; "
+                  f"{prof['attributed_pct']:.1f}% attributed to "
+                  f"named phases")
+        ctx.print(f"    {'span/phase':<48s} {'count':>6s} "
+                  f"{'total':>10s} {'p50':>8s} {'p99':>8s} "
+                  f"{'share':>7s}")
+        for row in prof.get("phases", ()):
+            ctx.print(f"    {row['key']:<48s} {row['count']:>6d} "
+                      f"{row['total_ms']:>8.1f}ms "
+                      f"{row['p50_ms']:>6.2f}ms "
+                      f"{row['p99_ms']:>6.2f}ms "
+                      f"{row['pct']:>6.1f}%")
         return 0
 
     def _jobservice(self, ctx):
@@ -821,6 +877,12 @@ class TraceCommand(Command):
                        help="spans to print (most recent first)")
         p.add_argument("--prefix", default="",
                        help="only spans whose name starts with this")
+        p.add_argument("--critical-path", default="", metavar="TRACE_ID",
+                       help="print one trace's blocking chain with "
+                            "per-phase attribution")
+        p.add_argument("--no-fanout", action="store_true",
+                       help="query only one master instead of every "
+                            "configured HA member")
 
     def run(self, args, ctx):
         mc = ctx.meta_client()
@@ -832,10 +894,25 @@ class TraceCommand(Command):
             mc.set_trace_enabled(False)
             ctx.print("tracing disabled")
             return 0
+        from alluxio_tpu.utils.trace_fanout import (
+            master_endpoints, merge_stitched, peer_traces)
+
+        # spans land on whichever master each node heartbeats to (PR-11
+        # standby metrics reads): on an HA list, ask every member
+        fanout = (not args.no_fanout
+                  and len(master_endpoints(ctx.conf)) > 1)
+        if args.critical_path:
+            return self._critical_path(ctx, mc, args.critical_path,
+                                       fanout)
         resp = mc.get_trace(limit=args.limit, prefix=args.prefix)
+        if fanout:
+            resp = {"enabled": resp["enabled"],
+                    **merge_stitched(resp, peer_traces(
+                        ctx.conf, limit=args.limit,
+                        prefix=args.prefix))}
         ctx.print(f"tracing: {'on' if resp['enabled'] else 'off'} "
                   f"({len(resp['spans'])} spans)")
-        for s in resp["spans"]:
+        for s in resp["spans"][:args.limit]:
             dur = s["duration_ms"]
             shown = "-" if dur is None else f"{round(dur, 2)}"
             tid = (s.get("trace_id") or "")[:8]
@@ -849,6 +926,50 @@ class TraceCommand(Command):
                       f"across {','.join(t['sources'])} "
                       f"root={t.get('root') or '?'} "
                       f"({'-' if dur is None else round(dur, 2)} ms)")
+        return 0
+
+    def _critical_path(self, ctx, mc, trace_id, fanout):
+        """Blocking-chain view of one trace. With fan-out the spans are
+        merged from every HA member first and analyzed locally —
+        otherwise the master runs the analysis server-side."""
+        if fanout:
+            from alluxio_tpu.utils.critical_path import analyze_trace
+            from alluxio_tpu.utils.trace_fanout import (
+                merge_stitched, peer_traces)
+
+            base = mc.get_trace(limit=4000, trace_id=trace_id)
+            merged = merge_stitched(base, peer_traces(
+                ctx.conf, limit=4000, trace_id=trace_id))
+            cp = analyze_trace(merged["spans"])
+        else:
+            cp = mc.get_trace_profile(
+                trace_id=trace_id).get("critical_path")
+        if not cp:
+            ctx.eprint(f"no spans recorded for trace {trace_id} — is "
+                       f"tracing on, and has a metrics heartbeat "
+                       f"shipped the spans yet?")
+            return 1
+        ctx.print(f"trace {cp['trace_id'][:16]}: root {cp['root']} "
+                  f"({cp['wall_ms']:.2f} ms wall, "
+                  f"{cp['attributed_pct']:.1f}% attributed to named "
+                  f"phases)")
+        ctx.print("  blocking chain (critical path):")
+        for row in cp.get("spans_on_path", ()):
+            phases = row.get("phases") or {}
+            detail = ", ".join(f"{k}={v:.2f}ms" for k, v in
+                               sorted(phases.items(),
+                                      key=lambda kv: -kv[1]))
+            ctx.print(f"    +{row['start_off_ms']:>8.2f}ms "
+                      f"{row['span']:<40s} "
+                      f"src={row.get('source') or '?':<10s} "
+                      f"self={row['self_ms']:.2f}ms"
+                      + (f"  [{detail}]" if detail else ""))
+        ctx.print("  top segments:")
+        segs = sorted(cp.get("segments", {}).items(),
+                      key=lambda kv: -kv[1])
+        for key, ms in segs[:15]:
+            share = (100.0 * ms / cp["wall_ms"]) if cp["wall_ms"] else 0.0
+            ctx.print(f"    {key:<48s} {ms:>8.2f}ms {share:>5.1f}%")
         return 0
 
 

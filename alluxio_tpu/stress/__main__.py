@@ -85,15 +85,31 @@ def build_parser() -> argparse.ArgumentParser:
     wr.add_argument("--file-mb", type=int, default=8)
     wr.add_argument("--mem-mb", type=int, default=64)
 
-    ob = sub.add_parser("obs", help="tracing overhead: spans/sec + "
-                                    "enabled-vs-disabled read latency")
+    ob = sub.add_parser("obs", help="observability rows: tracing/"
+                                    "profiler overhead + critical-path "
+                                    "attribution fidelity")
+    ob.add_argument("--row", choices=("tracing", "profile",
+                                      "critical-path"),
+                    default="tracing",
+                    help="which obs row: tracing overhead (default), "
+                         "stack-sampler overhead, or critical-path "
+                         "attribution")
     ob.add_argument("--file-mb", type=int, default=4)
     ob.add_argument("--reads", type=int, default=60,
-                    help="reads per alternating batch")
+                    help="reads per alternating batch (tracing/profile) "
+                         "or total random preads (critical-path)")
     ob.add_argument("--batches", type=int, default=5)
     ob.add_argument("--span-iterations", type=int, default=100_000)
+    ob.add_argument("--sample-interval-ms", type=int, default=0,
+                    help="profiler row: stack-sampling interval under "
+                         "test (0 = the shipped conf default)")
+    ob.add_argument("--read-bytes", type=int, default=4096,
+                    help="critical-path row: bytes per random pread")
     ob.add_argument("--max-overhead-pct", type=float, default=2.0,
-                    help="fail the bench above this tracing overhead")
+                    help="fail the overhead rows above this delta")
+    ob.add_argument("--min-attributed-pct", type=float, default=90.0,
+                    help="fail the critical-path row when named phases "
+                         "explain less of root wall time than this")
 
     he = sub.add_parser("health", help="metrics-history ingestion "
                                        "overhead on the heartbeat hot "
@@ -266,6 +282,9 @@ SUITE = (
     ("table-projection", ["table"]),
     ("write-eviction", ["write"]),
     ("obs-tracing-overhead", ["obs"]),
+    ("obs-profile-overhead", ["obs", "--row", "profile"]),
+    ("obs-critical-path", ["obs", "--row", "critical-path",
+                           "--file-mb", "2", "--reads", "80"]),
     ("health-ingest-overhead", ["health"]),
     ("selfheal-remediation", ["selfheal"]),
     ("ufs-cold-read", ["ufscold"]),
@@ -431,12 +450,28 @@ def main(argv=None) -> int:
                 file_bytes=args.file_mb << 20,
                 mem_bytes=args.mem_mb << 20)
     elif args.bench == "obs":
-        from alluxio_tpu.stress.obs_bench import run
+        if args.row == "profile":
+            from alluxio_tpu.stress.obs_bench import run_profile_overhead
 
-        r = run(file_mb=args.file_mb, reads=args.reads,
+            r = run_profile_overhead(
+                file_mb=args.file_mb, reads=args.reads,
                 batches=args.batches,
-                span_iterations=args.span_iterations,
+                sample_interval_ms=args.sample_interval_ms,
                 max_overhead_pct=args.max_overhead_pct)
+        elif args.row == "critical-path":
+            from alluxio_tpu.stress.obs_bench import run_critical_path
+
+            r = run_critical_path(
+                file_mb=args.file_mb, reads=args.reads,
+                read_bytes=args.read_bytes,
+                min_attributed_pct=args.min_attributed_pct)
+        else:
+            from alluxio_tpu.stress.obs_bench import run
+
+            r = run(file_mb=args.file_mb, reads=args.reads,
+                    batches=args.batches,
+                    span_iterations=args.span_iterations,
+                    max_overhead_pct=args.max_overhead_pct)
     elif args.bench == "health":
         from alluxio_tpu.stress.health_bench import run
 

@@ -50,6 +50,27 @@ TRACEPARENT_KEY = "atpu-traceparent"
 _TRACEPARENT_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
 
+#: The phase-name registry. Every ``Span.phase()`` emit site must use
+#: one of these names — atpu-lint's phase analyzer resolves emit sites
+#: against this catalog (near-miss typos flagged), and the critical-path
+#: analyzer (utils/critical_path.py) attributes span self-time to them.
+#: A phase is a *typed slice of wall time inside one span*; it may
+#: overlap a child span's interval (e.g. the client's ``wire`` wait
+#: covers the server's whole span) — the critical-path analyzer scales
+#: phases down to the span's own self-time so nothing double-counts.
+PHASES = (
+    "queue_wait",   # waiting in an executor/dispatch queue before work ran
+    "lock_wait",    # blocked acquiring a block/metadata lock
+    "admission",    # QoS admission-control decision on the server
+    "serialize",    # msgpack pack/unpack of RPC payloads
+    "wire",         # client-observed RPC wait (network + remote service)
+    "ufs_fetch",    # reading bytes out of the under-store
+    "cache_fill",   # writing fetched bytes into the tiered store
+    "tier_read",    # reading bytes out of a local tier
+    "device_put",   # host->device transfer (shm staging / jax device_put)
+    "drain",        # consumer draining/assembling delivered chunks
+)
+
 
 class TraceContext(NamedTuple):
     """The propagated slice of a span: W3C trace-context fields."""
@@ -130,7 +151,8 @@ def reset_remote_parent(token) -> None:
 
 class Span:
     __slots__ = ("name", "start_ms", "duration_ms", "parent", "span_id",
-                 "trace_id", "sampled", "tags", "thread", "error")
+                 "trace_id", "sampled", "tags", "thread", "error",
+                 "phases")
 
     def __init__(self, name: str, span_id: str, parent: Optional[str],
                  trace_id: str, sampled: bool = True) -> None:
@@ -144,9 +166,23 @@ class Span:
         self.tags: Dict[str, str] = {}
         self.thread = threading.current_thread().name
         self.error: Optional[str] = None
+        #: typed phase events: [name, duration_ms] in emit order; lazily
+        #: allocated so spans that never record a phase pay nothing
+        self.phases: Optional[list] = None
+
+    def phase(self, name: str, duration_ms: float) -> None:
+        """Record a typed phase event (one of ``PHASES``) inside this
+        span. O(1) list append; call sites hold the span object (from
+        ``with tracer().span(...) as sp`` or ``current_span()``) and
+        guard on ``sp is not None``, so the tracing-disabled path never
+        reaches here — that guard IS the zero-cost-when-off contract."""
+        p = self.phases
+        if p is None:
+            p = self.phases = []
+        p.append((name, duration_ms))
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "name": self.name, "span_id": self.span_id,
             "parent": self.parent, "trace_id": self.trace_id,
             "start_ms": round(self.start_ms, 3),
@@ -155,6 +191,9 @@ class Span:
             "thread": self.thread, "tags": self.tags,
             "error": self.error,
         }
+        if self.phases:
+            d["phases"] = [[n, round(ms, 3)] for n, ms in self.phases]
+        return d
 
 
 class Tracer:
@@ -271,6 +310,15 @@ def tracer() -> Tracer:
     return _TRACER
 
 
+def current_span() -> Optional[Span]:
+    """The live local span on this thread of execution, if any — the
+    handle phase emit sites use when the span was opened further up the
+    stack (e.g. the RPC server wrapper owns the span, the service
+    handler records the phases). One contextvar read; None whenever
+    tracing is off or the caller is outside any span."""
+    return _current_span.get()
+
+
 def set_tracing_enabled(on: bool) -> None:
     _TRACER.enabled = bool(on)
 
@@ -368,6 +416,13 @@ def stitch_spans(store: Optional[TraceStore], *, limit: int = 500,
             spans.append(s)
     spans.sort(key=lambda s: s.get("start_ms") or 0.0, reverse=True)
     del spans[limit:]
+    return {"spans": spans, "traces": summarize_traces(spans)}
+
+
+def summarize_traces(spans: List[dict]) -> List[dict]:
+    """Per-trace rollup of a most-recent-first span list (span count,
+    contributing sources, root name, wall duration). Shared by
+    :func:`stitch_spans` and the HA fan-out merge."""
     traces: "OrderedDict[str, dict]" = OrderedDict()
     for s in spans:
         tid = s.get("trace_id")
@@ -395,7 +450,7 @@ def stitch_spans(store: Optional[TraceStore], *, limit: int = 500,
         t["duration_ms"] = None if t["start_ms"] is None \
             else round(t["end_ms"] - t["start_ms"], 3)
         t.pop("end_ms", None)
-    return {"spans": spans, "traces": list(traces.values())}
+    return list(traces.values())
 
 
 # -- device-side (TPU) bridge ------------------------------------------------

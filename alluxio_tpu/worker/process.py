@@ -115,10 +115,13 @@ class _MetricsReporter:
             # the quarantine remediation exist to catch
             return
         spans = tracer().drain(500) if tracer().enabled else []
+        from alluxio_tpu.utils.profiler import profiler
+
+        flame = profiler().drain() if profiler().running else None
         try:
             self._client.metrics_heartbeat(self._source,
                                            metrics().snapshot(),
-                                           spans=spans)
+                                           spans=spans, profile=flame)
         except Exception:  # noqa: BLE001 master transition: retry next tick
             # spans riding this tick are dropped — tracing is telemetry,
             # re-queueing could double-ship on a late-delivered RPC
@@ -209,6 +212,9 @@ class BlockWorker:
 
         set_tracing_enabled(self._conf.get_bool(Keys.TRACE_ENABLED))
         apply_trace_conf(self._conf)
+        from alluxio_tpu.utils.profiler import apply_profile_conf
+
+        apply_profile_conf(self._conf)
         ensure_process_monitor()
         self._master_sync.register_with_master()
         if self._meta_client is not None:

@@ -286,7 +286,17 @@ class TieredBlockStore:
 
     # -- read path ----------------------------------------------------------
     def get_reader(self, block_id: int) -> BlockReader:
-        lock = self._locks.lock_read(block_id)
+        from alluxio_tpu.utils.tracing import current_span
+
+        sp = current_span()
+        if sp is None:
+            lock = self._locks.lock_read(block_id)
+        else:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            lock = self._locks.lock_read(block_id)
+            sp.phase("lock_wait", (_time.perf_counter() - t0) * 1000.0)
         try:
             meta = self.meta.get_block(block_id)
             if meta is None:

@@ -168,6 +168,13 @@ class BlockFetch:
         self._cache_tier_alias = ""
         self._on_done = on_done
         self._span = self._open_span()
+        #: phase accumulators (only written when the fetch is traced):
+        #: UFS read time summed across stripe workers, cache-fill write
+        #: time, and when the first stripe task actually started — the
+        #: created->first-claim gap is the executor queue wait
+        self._ufs_ms = 0.0
+        self._fill_ms = 0.0
+        self._first_claim_at: Optional[float] = None
 
     # -- tracing ------------------------------------------------------------
     def _open_span(self):
@@ -191,6 +198,14 @@ class BlockFetch:
     def _close_span(self) -> None:
         if self._span is None:
             return
+        if self._first_claim_at is not None:
+            self._span.phase(
+                "queue_wait",
+                (self._first_claim_at - self.created_at) * 1000.0)
+        if self._ufs_ms > 0.0:
+            self._span.phase("ufs_fetch", self._ufs_ms)
+        if self._fill_ms > 0.0:
+            self._span.phase("cache_fill", self._fill_ms)
         self._span.duration_ms = \
             (time.perf_counter() - self.created_at) * 1000.0
         self._span.tags["fallback"] = str(self.fallback)
@@ -204,6 +219,8 @@ class BlockFetch:
     # -- stripe-worker side -------------------------------------------------
     def _claim_stripe(self) -> Optional[int]:
         with self._cond:
+            if self._span is not None and self._first_claim_at is None:
+                self._first_claim_at = time.perf_counter()
             if self._striping_aborted or self._error is not None:
                 return None
             if self._next >= len(self.stripes):
@@ -211,6 +228,13 @@ class BlockFetch:
             i = self._next
             self._next += 1
             return i
+
+    def _note_ufs_ms(self, elapsed_ms: float) -> None:
+        """Accumulate one stripe's UFS read time (workers run
+        concurrently, so the sum can exceed the span's wall — the
+        critical-path analyzer scales phases into self-time)."""
+        with self._cond:
+            self._ufs_ms += elapsed_ms
 
     def _complete_stripe(self, i: int, data: bytes) -> None:
         off, ln = self.stripes[i]
@@ -267,7 +291,14 @@ class BlockFetch:
                     if fill is None or not self._fill_pending:
                         return
                     off, ln = self._fill_pending.pop(0)
-                if not fill.append(self._buf[off:off + ln]):
+                t_fill = time.perf_counter() if self._span is not None \
+                    else 0.0
+                ok = fill.append(self._buf[off:off + ln])
+                if self._span is not None:
+                    # under _fill_lock: drains are serialized
+                    self._fill_ms += \
+                        (time.perf_counter() - t_fill) * 1000.0
+                if not ok:
                     with self._cond:  # fill failed: serve-only
                         self._cache_fill = None
                         self._fill_pending.clear()
@@ -291,8 +322,11 @@ class BlockFetch:
         self.fallback = True
         metrics().counter("Worker.UfsFetchFallbacks").inc()
         try:
+            t_ufs = time.perf_counter() if self._span is not None else 0.0
             data = ufs.read_range(self.desc.ufs_path, self.desc.offset,
                                   self.desc.length)
+            if self._span is not None:
+                self._note_ufs_ms((time.perf_counter() - t_ufs) * 1000.0)
         except BaseException as e2:  # noqa: BLE001
             self._fail(e2)
             return
@@ -347,7 +381,10 @@ class BlockFetch:
         with self._cond:
             fill, wanted = self._cache_fill, self._cache_wanted
         if fill is not None:
+            t_fill = time.perf_counter() if self._span is not None else 0.0
             fill.commit()
+            if self._span is not None:
+                self._fill_ms += (time.perf_counter() - t_fill) * 1000.0
         elif wanted and self._store is not None:
             # a caching reader attached after the frontier moved (or
             # the fetch truncated): the block is resident now, fill in
@@ -706,8 +743,13 @@ class UfsBlockFetcher:
                             raise faults.InjectedFaultError(
                                 f"injected UFS fault for stripe {i} of "
                                 f"block {fetch.desc.block_id}")
+                        t_ufs = time.perf_counter() \
+                            if fetch._span is not None else 0.0
                         data = ufs.read_range(fetch.desc.ufs_path,
                                               fetch.desc.offset + off, ln)
+                        if fetch._span is not None:
+                            fetch._note_ufs_ms(
+                                (time.perf_counter() - t_ufs) * 1000.0)
                         if len(data) != ln:
                             raise FetchError(
                                 f"short stripe read: {len(data)}B of "

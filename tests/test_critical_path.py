@@ -1,0 +1,240 @@
+"""Critical-path analyzer invariants (utils/critical_path.py).
+
+The blocking-chain model must hold structurally — not just on one
+golden trace — so the core here is a seeded property sweep over random
+span trees (sequential fan-out, overlapping hedges, coalesced
+children, cross-process skew) asserting the partition/attribution
+invariants the readpath report relies on:
+
+- the chain's self-segments plus recursed child windows partition the
+  root's wall-clock exactly;
+- attributed time never exceeds wall-clock (phase scaling);
+- a hedge's cancelled loser never rides the chain past the winner.
+"""
+
+import random
+
+import pytest
+
+from alluxio_tpu.utils.critical_path import analyze_trace, profile
+
+
+def _span(sid, name, start, dur, *, parent=None, trace="t1",
+          phases=None, source="local"):
+    s = {"span_id": sid, "name": name, "parent": parent,
+         "trace_id": trace, "start_ms": float(start),
+         "duration_ms": float(dur), "source": source}
+    if phases:
+        s["phases"] = [[n, float(ms)] for n, ms in phases]
+    return s
+
+
+def _chain_sum(res):
+    return sum(seg["ms"] for seg in res["chain"])
+
+
+def _seg_sum(res):
+    return sum(res["segments"].values())
+
+
+class TestSingleTrace:
+    def test_no_usable_spans(self):
+        assert analyze_trace([]) is None
+        assert analyze_trace([{"span_id": "a"}]) is None
+
+    def test_leaf_self_time_is_wall(self):
+        res = analyze_trace([_span("a", "atpu.op", 0, 50)])
+        assert res["wall_ms"] == 50.0
+        assert res["attributed_pct"] == 0.0  # no phases -> all /self
+        assert res["segments"] == {"atpu.op/self": 50.0}
+        assert _chain_sum(res) == pytest.approx(50.0, abs=0.01)
+
+    def test_sequential_children_partition_wall(self):
+        spans = [
+            _span("r", "root", 0, 100),
+            _span("c1", "child", 10, 30, parent="r"),
+            _span("c2", "child", 50, 40, parent="r"),
+        ]
+        res = analyze_trace(spans)
+        # parent self: [0,10) + [40,50) + [90,100) = 30
+        assert res["segments"]["root/self"] == pytest.approx(30.0)
+        assert res["segments"]["child/self"] == pytest.approx(70.0)
+        assert _seg_sum(res) == pytest.approx(res["wall_ms"], abs=0.01)
+
+    def test_hedge_loser_not_on_chain(self):
+        # winner covers [10,90]; the cancelled hedge [50,70] sits
+        # entirely inside the winner's window -> never blocks the root
+        spans = [
+            _span("r", "atpu.client.remote_read", 0, 100),
+            _span("w", "stripe.win", 10, 80, parent="r"),
+            _span("l", "stripe.lose", 50, 20, parent="r"),
+        ]
+        res = analyze_trace(spans)
+        names = {row["span"] for row in res["spans_on_path"]}
+        assert "stripe.win" in names
+        assert "stripe.lose" not in names
+        assert res["segments"]["atpu.client.remote_read/self"] == \
+            pytest.approx(20.0)
+
+    def test_clock_skew_child_clipped_to_parent(self):
+        # remote child claims to end after the parent (skewed clock):
+        # the chain must not exceed the parent's wall
+        spans = [
+            _span("r", "root", 0, 50),
+            _span("c", "remote", 20, 100, parent="r", source="worker"),
+        ]
+        res = analyze_trace(spans)
+        assert res["wall_ms"] == 50.0
+        assert _seg_sum(res) == pytest.approx(50.0, abs=0.01)
+
+    def test_orphan_parent_longest_root_anchors(self):
+        spans = [
+            _span("a", "short.orphan", 0, 10),
+            _span("b", "atpu.client.remote_read", 0, 40,
+                  parent="never-shipped"),
+        ]
+        res = analyze_trace(spans)
+        assert res["root"] == "atpu.client.remote_read"
+        assert res["wall_ms"] == 40.0
+
+    def test_phases_scaled_down_to_self_time(self):
+        # phases sum to 20ms but critical self-time is 10ms (a child
+        # covers the rest): scaled so nothing double-counts
+        spans = [
+            _span("r", "root", 0, 50,
+                  phases=[("queue_wait", 5), ("wire", 15)]),
+            _span("c", "child", 10, 40, parent="r"),
+        ]
+        res = analyze_trace(spans)
+        assert res["attributed_ms"] == pytest.approx(10.0, abs=0.01)
+        # 1:3 proportion preserved under scaling
+        assert res["segments"]["root/queue_wait"] == \
+            pytest.approx(2.5, abs=0.01)
+        assert res["segments"]["root/wire"] == \
+            pytest.approx(7.5, abs=0.01)
+        assert "root/self" not in res["segments"]
+
+    def test_phases_under_self_time_leave_rest_unattributed(self):
+        spans = [_span("r", "root", 0, 50, phases=[("wire", 20)])]
+        res = analyze_trace(spans)
+        assert res["segments"]["root/wire"] == pytest.approx(20.0)
+        assert res["segments"]["root/self"] == pytest.approx(30.0)
+        assert res["attributed_pct"] == pytest.approx(40.0, abs=0.1)
+
+
+def _random_tree(rng, *, max_depth=3, max_kids=3, hedge_p=0.3):
+    """Random span tree: children nested inside the parent window,
+    sometimes overlapping (hedges), phases on random spans."""
+    spans = []
+    counter = [0]
+
+    def build(parent_id, start, end, depth):
+        counter[0] += 1
+        sid = f"s{counter[0]}"
+        phases = []
+        for pname in ("queue_wait", "wire", "tier_read"):
+            if rng.random() < 0.5:
+                phases.append((pname, rng.uniform(0, (end - start))))
+        spans.append(_span(sid, f"op.d{depth}", start, end - start,
+                           parent=parent_id, phases=phases or None))
+        if depth >= max_depth:
+            return
+        n = rng.randint(0, max_kids)
+        for _ in range(n):
+            a = rng.uniform(start, end)
+            b = rng.uniform(a, end)
+            if b - a < 0.5:
+                continue
+            if rng.random() < hedge_p:
+                # hedge: a second overlapping child in the same window
+                ha = rng.uniform(a, b)
+                hb = rng.uniform(ha, b)
+                if hb - ha > 0.5:
+                    build(sid, ha, hb, depth + 1)
+            build(sid, a, b, depth + 1)
+
+    build(None, 0.0, rng.uniform(50.0, 200.0), 0)
+    return spans
+
+
+class TestPropertySweep:
+    @pytest.mark.parametrize("seed", range(30))
+    def test_partition_and_attribution_bounds(self, seed):
+        rng = random.Random(seed)
+        spans = _random_tree(rng)
+        res = analyze_trace(spans)
+        assert res is not None
+        wall = res["wall_ms"]
+        assert wall > 0
+        # segments partition the root's wall-clock exactly
+        assert _seg_sum(res) == pytest.approx(wall, abs=0.05)
+        # the chain is a walk over [root.start, root.end]: contiguous,
+        # inside the window, summing to wall
+        assert _chain_sum(res) == pytest.approx(wall, abs=0.05)
+        offs = [seg["start_off_ms"] for seg in res["chain"]]
+        assert offs == sorted(offs)
+        for seg in res["chain"]:
+            assert seg["start_off_ms"] >= -0.01
+            assert seg["start_off_ms"] + seg["ms"] <= wall + 0.05
+        # named-phase attribution never exceeds wall-clock
+        assert 0.0 <= res["attributed_ms"] <= wall + 0.05
+        assert 0.0 <= res["attributed_pct"] <= 100.01
+        # every on-path span's scaled phases fit its self-time
+        for row in res["spans_on_path"]:
+            assert sum(row["phases"].values()) <= row["self_ms"] + 0.05
+
+    @pytest.mark.parametrize("seed", range(30, 40))
+    def test_shuffle_invariance(self, seed):
+        rng = random.Random(seed)
+        spans = _random_tree(rng)
+        res_a = analyze_trace(spans)
+        shuffled = list(spans)
+        rng.shuffle(shuffled)
+        res_b = analyze_trace(shuffled)
+        assert res_a["wall_ms"] == res_b["wall_ms"]
+        assert res_a["segments"] == res_b["segments"]
+        assert res_a["attributed_ms"] == res_b["attributed_ms"]
+
+
+class TestProfile:
+    def _traces(self):
+        spans = []
+        for i in range(4):
+            t = f"tr{i}"
+            spans.append(_span(f"r{i}", "atpu.client.remote_read", 0,
+                               100, trace=t,
+                               phases=[("queue_wait", 10)]))
+            spans.append(_span(f"c{i}", "atpu.BlockWorker.read_block",
+                               10, 80, parent=f"r{i}", trace=t,
+                               source="worker",
+                               phases=[("tier_read", 60),
+                                       ("serialize", 20)]))
+        # an unrelated server-rooted trace the prefix must exclude
+        spans.append(_span("x", "atpu.FileSystemMaster.get_status", 0,
+                           500, trace="other"))
+        return spans
+
+    def test_root_prefix_filters_and_ranks(self):
+        prof = profile(self._traces(),
+                       root_prefix="atpu.client.remote_read")
+        assert prof["traces_analyzed"] == 4
+        assert prof["wall_ms_total"] == pytest.approx(400.0)
+        keys = [r["key"] for r in prof["phases"]]
+        assert keys[0] == "atpu.BlockWorker.read_block/tier_read"
+        row = prof["phases"][0]
+        assert row["count"] == 4
+        assert row["total_ms"] == pytest.approx(240.0)
+        assert row["p50_ms"] == pytest.approx(60.0)
+        # 10 + 60 + 20 attributed of 100 wall, per trace
+        assert prof["attributed_pct"] == pytest.approx(90.0, abs=0.1)
+
+    def test_max_traces_caps_work(self):
+        prof = profile(self._traces(), max_traces=2,
+                       root_prefix="atpu.client.remote_read")
+        assert prof["traces_analyzed"] <= 2
+
+    def test_empty(self):
+        prof = profile([])
+        assert prof["traces_analyzed"] == 0
+        assert prof["phases"] == []
+        assert prof["attributed_pct"] == 0.0

@@ -788,6 +788,99 @@ class TestPrometheusExposition:
         assert not hasattr(reg, "ClusterAggregator")
 
 
+class TestLatencyExemplars:
+    def _timer_lines(self, reg, metric_base):
+        return [ln for ln in reg.to_prometheus().splitlines()
+                if ln.startswith(metric_base + '_bucket{')]
+
+    def test_exemplar_round_trip(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry, Timer
+
+        reg = MetricsRegistry("Client")
+        t = reg.timer("Client.ReadLatency.le4k")
+        t.update(0.003, exemplar="aabbccdd00112233")
+        # stored on the first bucket whose le >= 0.003 (le=0.005 -> 0)
+        ex = t.exemplars()
+        assert list(ex) == [0]
+        tid, val, ts = ex[0]
+        assert tid == "aabbccdd00112233"
+        assert val == pytest.approx(0.003)
+        assert ts > 0
+        lines = self._timer_lines(reg, "Client_ReadLatency_le4k_seconds")
+        tagged = [ln for ln in lines if "#" in ln]
+        assert len(tagged) == 1
+        # OpenMetrics exemplar syntax on the owning bucket line
+        assert re.search(
+            r'le="0\.005"\} \d+ # \{trace_id="aabbccdd00112233"\} '
+            r'0\.003000 \d+\.\d{3}$', tagged[0]), tagged[0]
+
+    def test_no_exemplar_no_tag(self):
+        from alluxio_tpu.metrics.registry import MetricsRegistry
+
+        reg = MetricsRegistry("Client")
+        reg.timer("Client.ReadLatency.le4k").update(0.003)
+        assert "#" not in "\n".join(
+            self._timer_lines(reg, "Client_ReadLatency_le4k_seconds"))
+
+    def test_latest_exemplar_per_bucket_wins(self):
+        from alluxio_tpu.metrics.registry import Timer
+
+        t = Timer()
+        t.update(0.003, exemplar="old")
+        t.update(0.004, exemplar="new")
+        t.update(0.2, exemplar="slow")  # different bucket
+        ex = t.exemplars()
+        assert ex[0][0] == "new"
+        assert len(ex) == 2
+
+    def test_overflow_bucket_exemplar(self):
+        from alluxio_tpu.metrics.registry import Timer
+
+        t = Timer()
+        t.update(1e9, exemplar="inf-read")
+        assert t.exemplars()[len(Timer.HISTOGRAM_BUCKETS)][0] == \
+            "inf-read"
+
+    def test_size_bucket_edges(self):
+        from alluxio_tpu.metrics.stall import SIZE_BUCKETS, size_bucket
+
+        assert SIZE_BUCKETS == ("le4k", "le64k", "le1m", "gt1m")
+        assert size_bucket(0) == "le4k"
+        assert size_bucket(4 << 10) == "le4k"
+        assert size_bucket((4 << 10) + 1) == "le64k"
+        assert size_bucket(64 << 10) == "le64k"
+        assert size_bucket(1 << 20) == "le1m"
+        assert size_bucket((1 << 20) + 1) == "gt1m"
+
+    def test_remote_read_records_bucketed_latency_with_exemplar(self):
+        """A traced striped read lands one observation in the right
+        size bucket with its trace id attached."""
+        from alluxio_tpu.metrics.registry import metrics
+        from alluxio_tpu.utils.tracing import (
+            set_tracing_enabled, tracer,
+        )
+
+        from tests.test_remote_read import FakeSource, runtime
+
+        timer = metrics().timer("Client.ReadLatency.le64k")
+        before = timer.histogram()[2]
+        data = bytes(32 << 10)
+        set_tracing_enabled(True)
+        tracer().configure(sample_rate=1.0)
+        rt = runtime(stripe_size=8 << 10)
+        try:
+            view = rt.read(block_id=1,
+                           sources=[FakeSource("a", data)],
+                           offset=0, length=len(data)).read_view()
+            assert len(view) == 32 << 10
+        finally:
+            rt.close()
+            set_tracing_enabled(False)
+            tracer().clear()
+        assert timer.histogram()[2] == before + 1
+        assert timer.exemplars(), "sampled read left no exemplar"
+
+
 class TestGraphiteOffHeartbeat:
     def test_report_never_blocks_on_dead_host(self, monkeypatch,
                                               registry):

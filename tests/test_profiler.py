@@ -1,0 +1,214 @@
+"""Thread-stack sampler units (utils/profiler.py): deterministic
+sampling via the public ``sample_once`` (no timing thread), drain
+semantics the heartbeat relies on, flame merging, and conf gating."""
+
+import threading
+import time
+
+import pytest
+
+from alluxio_tpu.conf import Configuration, Keys
+from alluxio_tpu.utils.profiler import (
+    StackSampler, apply_profile_conf, merge_flames, profiler,
+)
+
+
+class _Parked:
+    """A helper thread parked in a recognizably-named function."""
+
+    def __init__(self, name="parked_here"):
+        self._go = threading.Event()
+        self._ready = threading.Event()
+        fn = {"parked_here": self._parked_here,
+              "parked_other": self._parked_other}[name]
+        self.thread = threading.Thread(target=fn, daemon=True)
+        self.thread.start()
+        assert self._ready.wait(5.0)
+
+    def _parked_here(self):
+        self._ready.set()
+        self._go.wait(30.0)
+
+    def _parked_other(self):
+        self._ready.set()
+        self._go.wait(30.0)
+
+    def release(self):
+        self._go.set()
+        self.thread.join(timeout=5.0)
+
+
+class TestSampleOnce:
+    def test_captures_parked_thread_frame(self):
+        parked = _Parked()
+        s = StackSampler()
+        try:
+            s.sample_once()
+            snap = s.snapshot()
+            assert snap["samples"] == 1
+            hits = [k for k in snap["stacks"]
+                    if "test_profiler.py:_parked_here" in k]
+            assert hits, f"parked frame missing from {snap['stacks']}"
+        finally:
+            parked.release()
+
+    def test_folded_stack_is_root_first(self):
+        parked = _Parked()
+        s = StackSampler()
+        try:
+            s.sample_once()
+            stack = next(k for k in s.snapshot()["stacks"]
+                         if "_parked_here" in k)
+            frames = stack.split(";")
+            # innermost frame (Event.wait) last, thread entry earlier
+            assert frames.index(
+                "test_profiler.py:_parked_here") < len(frames) - 1
+        finally:
+            parked.release()
+
+    def test_depth_cap(self):
+        s = StackSampler(depth=2)
+        s.sample_once()
+        assert all(len(k.split(";")) <= 2
+                   for k in s.snapshot()["stacks"])
+
+    def test_skip_ident_excludes_thread(self):
+        parked = _Parked()
+        s = StackSampler()
+        try:
+            s.sample_once(skip_ident=parked.thread.ident)
+            assert not any("_parked_here" in k
+                           for k in s.snapshot()["stacks"])
+        finally:
+            parked.release()
+
+    def test_max_stacks_drops_and_counts(self):
+        a, b = _Parked("parked_here"), _Parked("parked_other")
+        s = StackSampler(max_stacks=1)
+        try:
+            s.sample_once()
+            snap = s.snapshot()
+            assert len(snap["stacks"]) == 1
+            assert snap["dropped"] >= 1
+        finally:
+            a.release()
+            b.release()
+
+    def test_repeat_samples_merge_counts(self):
+        parked = _Parked()
+        s = StackSampler()
+        try:
+            for _ in range(3):
+                s.sample_once()
+            snap = s.snapshot()
+            assert snap["samples"] == 3
+            key = next(k for k in snap["stacks"] if "_parked_here" in k)
+            assert snap["stacks"][key] == 3
+        finally:
+            parked.release()
+
+
+class TestDrain:
+    def test_drain_returns_none_when_empty(self):
+        assert StackSampler().drain() is None
+
+    def test_drain_resets_for_delta_shipping(self):
+        s = StackSampler()
+        s.sample_once()
+        flame = s.drain()
+        assert flame is not None and flame["samples"] == 1
+        assert flame["stacks"]
+        # second drain: nothing accumulated since
+        assert s.drain() is None
+        assert s.snapshot()["samples"] == 0
+
+
+class TestLifecycle:
+    def test_start_stop_idempotent(self):
+        s = StackSampler(interval_ms=5)
+        assert not s.running
+        s.start()
+        s.start()  # no second thread
+        assert s.running
+        threads = [t for t in threading.enumerate()
+                   if t.name == "atpu-stack-sampler"]
+        try:
+            assert len(threads) == 1
+        finally:
+            s.stop()
+        assert not s.running
+        s.stop()  # harmless
+
+    def test_sampler_thread_actually_samples(self):
+        s = StackSampler(interval_ms=2)
+        s.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if s.snapshot()["samples"] >= 2:
+                    break
+                time.sleep(0.01)
+            assert s.snapshot()["samples"] >= 2
+        finally:
+            s.stop()
+
+    def test_sampler_never_profiles_itself(self):
+        s = StackSampler(interval_ms=2)
+        s.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and \
+                    not s.snapshot()["samples"]:
+                time.sleep(0.01)
+        finally:
+            s.stop()
+        assert not any("profiler.py:_loop" in k
+                       for k in s.snapshot()["stacks"])
+
+
+class TestConfGating:
+    def test_apply_profile_conf_round_trip(self):
+        conf = Configuration(load_env=False)
+        p = profiler()
+        assert not p.running  # disabled is the shipped default
+        try:
+            conf.set(Keys.PROFILE_ENABLED, True)
+            conf.set(Keys.PROFILE_SAMPLE_INTERVAL_MS, 7)
+            conf.set(Keys.PROFILE_MAX_STACKS, 99)
+            conf.set(Keys.PROFILE_STACK_DEPTH, 11)
+            apply_profile_conf(conf)
+            assert p.running
+            assert (p.interval_ms, p.max_stacks, p.depth) == (7, 99, 11)
+            conf.set(Keys.PROFILE_ENABLED, False)
+            apply_profile_conf(conf)
+            assert not p.running
+        finally:
+            p.stop()
+            p.drain()
+
+    def test_disabled_conf_starts_nothing(self):
+        conf = Configuration(load_env=False)
+        apply_profile_conf(conf)
+        assert not profiler().running
+        assert not any(t.name == "atpu-stack-sampler"
+                       for t in threading.enumerate())
+
+
+class TestMergeFlames:
+    def test_accumulates(self):
+        base = {"samples": 2, "dropped": 1,
+                "stacks": {"a;b": 2, "c": 1}}
+        delta = {"samples": 3, "dropped": 0, "interval_ms": 97,
+                 "stacks": {"a;b": 1, "d": 5}}
+        out = merge_flames(base, delta)
+        assert out["samples"] == 5
+        assert out["dropped"] == 1
+        assert out["interval_ms"] == 97
+        assert out["stacks"] == {"a;b": 3, "c": 1, "d": 5}
+        # inputs untouched
+        assert base["stacks"]["a;b"] == 2
+
+    def test_empty_base(self):
+        out = merge_flames({}, {"samples": 1, "stacks": {"x": 1}})
+        assert out["samples"] == 1
+        assert out["stacks"] == {"x": 1}

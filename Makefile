@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata bench-ha sdist clean lint lint-changed lint-docs
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-smallread bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata bench-ha sdist clean lint lint-changed lint-docs
 
 lint:  ## atpu-lint: conf-key/metric-name/lock/exception discipline (<30s budget)
 	$(PY) -m alluxio_tpu.lint --budget-s 30
@@ -35,6 +35,10 @@ bench-obs:  ## observability gates: tracing + profiler overhead (<2% budget), cr
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs --row profile
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs --row critical-path --file-mb 2 --reads 80
+
+bench-smallread:  ## small-read plane: read_many coalescing (>=3x per-op ops/s), SHM zero-copy fidelity (buffer identity, no wire phase)
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row batch
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row shm
 
 bench-health:  ## metrics-history ingestion: heartbeat hot-path overhead (<5% gate, fake clock)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress health

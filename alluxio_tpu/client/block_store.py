@@ -14,11 +14,13 @@ import threading
 from typing import Dict, List, Optional, Set
 
 from alluxio_tpu.client.block_streams import (
-    BlockInStream, BlockOutStream, GrpcBlockInStream, GrpcBlockOutStream,
-    LocalBlockInStream, LocalBlockOutStream, is_local_worker,
+    BatchReadConf, BlockInStream, BlockOutStream, GrpcBlockInStream,
+    GrpcBlockOutStream, LocalBlockInStream, LocalBlockOutStream,
+    is_local_worker,
 )
 from alluxio_tpu.client.policy import BlockLocationPolicy
 from alluxio_tpu.client.remote_read import RemoteReadConf, RemoteReadRuntime
+from alluxio_tpu.client.shm_transport import ShmTransport
 from alluxio_tpu.rpc.clients import BlockMasterClient, WorkerClient
 from alluxio_tpu.utils import ids as id_utils
 from alluxio_tpu.utils.exceptions import UnavailableError
@@ -40,14 +42,23 @@ class BlockStoreClient:
                  write_unavailable_window_s: float = 15.0,
                  streaming_chunk_size: int = 1 << 20,
                  streaming_writer_chunk_size: int = 1 << 20,
-                 remote_read: Optional[RemoteReadConf] = None) -> None:
+                 remote_read: Optional[RemoteReadConf] = None,
+                 shm_enabled: bool = True,
+                 shm_cache_max: int = 64,
+                 shm_renew_fraction: float = 0.5,
+                 batch_read: Optional[BatchReadConf] = None) -> None:
         """``streaming_chunk_size``: per-message chunk of the gRPC read
         streams (``atpu.user.streaming.reader.chunk.size.bytes``);
         ``streaming_writer_chunk_size``: per-message chunk of the write
         stream (``atpu.user.streaming.writer.chunk.size.bytes``);
         ``remote_read``: striped-read tuning — the default conf stripes
         large remote reads, ``RemoteReadConf(stripe_size=0)`` pins the
-        legacy single-stream path."""
+        legacy single-stream path; ``shm_enabled`` /``shm_cache_max`` /
+        ``shm_renew_fraction`` (``atpu.user.shm.*``): the same-host
+        zero-copy SHM plane — disabled, step 1 of the ladder is the
+        byte-identical short-circuit path; ``batch_read``
+        (``atpu.user.batch.read.*``): scatter/gather coalescing for
+        ``pread_many`` on remote streams."""
         self._bm = block_master
         self._identity = identity or TieredIdentity.from_spec(
             None, hostname=socket.gethostname())
@@ -67,6 +78,15 @@ class BlockStoreClient:
         #: (hedging learns across reads, so it lives here, not per-stream)
         self.remote_read = RemoteReadRuntime(remote_read)
         self.session_id = id_utils.create_session_id()
+        #: same-host zero-copy plane (``atpu.user.shm.enabled``); None
+        #: pins the legacy short-circuit path byte-for-byte
+        self.shm: Optional[ShmTransport] = ShmTransport(
+            self.session_id, cache_max=shm_cache_max,
+            renew_fraction=shm_renew_fraction,
+            host=socket.gethostname()) if shm_enabled else None
+        #: scatter/gather coalescing conf shared by every remote stream
+        self.batch_read = batch_read if batch_read is not None \
+            else BatchReadConf()
         #: worker that served the most recent write (sync-persist targets it;
         #: LOCAL_FIRST keeps one file's blocks on one worker)
         self.last_write_worker: Optional[WorkerClient] = None
@@ -132,12 +152,29 @@ class BlockStoreClient:
         info = fbi.block_info
         exclude = exclude or set()
         local_hostname = socket.gethostname()
-        # 1) short-circuit a same-host cached copy
+        # 1) same-host cached copy: SHM zero-copy map first (one lease
+        # RPC, then every read is a memoryview slice), then the legacy
+        # path-lease short-circuit — each falls one rung on failure
         if self._short_circuit:
             for loc in info.locations:
                 if loc.address.key() in exclude:
                     continue
                 if is_local_worker(loc.address, local_hostname):
+                    if self.shm is not None:
+                        try:
+                            stream = self.shm.open_stream(
+                                self.worker_client(loc.address),
+                                info.block_id)
+                            stream.address = loc.address
+                            metrics().counter(
+                                "Client.BlockOpens.shm").inc()
+                            return stream
+                        except Exception:  # noqa: BLE001 - fall through ladder
+                            # lease denied / block not in the top tier /
+                            # map failed / worker dead (UnavailableError):
+                            # the short-circuit and remote rungs still
+                            # serve it
+                            pass
                     try:
                         stream = LocalBlockInStream(
                             self.worker_client(loc.address), self.session_id,
@@ -170,7 +207,7 @@ class BlockStoreClient:
                     chunk_size=self._chunk_size,
                     remote_read=self.remote_read, replicas=replicas,
                     client_factory=self.worker_client,
-                    on_failed=self.mark_failed)
+                    on_failed=self.mark_failed, batch=self.batch_read)
                 stream.address = address
                 metrics().counter("Client.BlockOpens.remote").inc()
                 self._maybe_passive_cache(info, ufs_info)
@@ -194,7 +231,8 @@ class BlockStoreClient:
                                    chunk_size=self._chunk_size,
                                    remote_read=self.remote_read,
                                    client_factory=self.worker_client,
-                                   on_failed=self.mark_failed)
+                                   on_failed=self.mark_failed,
+                                   batch=self.batch_read)
         stream.address = address
         metrics().counter("Client.BlockOpens.ufs").inc()
         return stream
@@ -273,6 +311,11 @@ class BlockStoreClient:
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:
         self.remote_read.close()
+        if self.shm is not None:
+            # unmap everything client-side; the cleanup_session calls
+            # below release the leases gracefully on each worker
+            # (worker-side close_session), TTL expiry backstops the rest
+            self.shm.close()
         for c in self._workers.values():
             try:
                 c.cleanup_session(self.session_id)

@@ -25,10 +25,11 @@ import queue
 import socket
 import threading
 from concurrent import futures
-from typing import Iterator, List, Optional
+from typing import Iterator, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from alluxio_tpu.client.remote_read import choose_route
 from alluxio_tpu.rpc.clients import WorkerClient
 from alluxio_tpu.utils.exceptions import UnavailableError
 from alluxio_tpu.utils.wire import BlockInfo, WorkerNetAddress
@@ -62,6 +63,23 @@ def _record_read(bucket: str, nbytes: int) -> None:
     m.counter(f"Client.BlocksRead.{bucket}").inc()
 
 
+class BatchReadConf(NamedTuple):
+    """Scatter/gather coalescing knobs (``atpu.user.batch.read.*``)."""
+
+    enabled: bool = True
+    max_op_bytes: int = 64 << 10
+    max_ops: int = 256
+
+    @classmethod
+    def from_conf(cls, conf) -> "BatchReadConf":
+        from alluxio_tpu.conf import Keys
+
+        return cls(
+            enabled=conf.get_bool(Keys.USER_BATCH_READ_ENABLED),
+            max_op_bytes=conf.get_bytes(Keys.USER_BATCH_READ_MAX_OP_BYTES),
+            max_ops=max(1, conf.get_int(Keys.USER_BATCH_READ_MAX_OPS)))
+
+
 def is_local_worker(address: WorkerNetAddress, local_hostname: str) -> bool:
     """Same-host check gate for the short-circuit path: the worker's shm
     dir must be a real local directory."""
@@ -89,6 +107,15 @@ class BlockInStream:
 
     def read_all(self) -> bytes:
         return self.pread(0, self.length)
+
+    def pread_many(self, offsets: Sequence[int],
+                   sizes: Sequence[int]) -> List[bytes]:
+        """Scatter/gather: N positioned reads, results in request
+        order. The base implementation is the per-op loop —
+        byte-identical to calling :meth:`pread` N times; transports
+        that can coalesce (``GrpcBlockInStream`` -> ``read_many`` RPC)
+        override it."""
+        return [self.pread(off, n) for off, n in zip(offsets, sizes)]
 
     def memoryview(self) -> Optional[memoryview]:
         """Zero-copy view when the source is local; None otherwise."""
@@ -192,12 +219,14 @@ class GrpcBlockInStream(BlockInStream):
                  *, ufs: Optional[dict] = None, cache: bool = True,
                  chunk_size: int = 1 << 20, remote_read=None,
                  replicas: Optional[list] = None, client_factory=None,
-                 on_failed=None) -> None:
+                 on_failed=None,
+                 batch: Optional[BatchReadConf] = None) -> None:
         """``remote_read``: a ``RemoteReadRuntime`` (None = legacy only);
         ``replicas``: the block's location addresses, nearest first;
         ``client_factory``: address -> WorkerClient for replica fan-out;
         ``on_failed``: callback(address) when a worker dies mid-stripe
-        (``BlockStoreClient.mark_failed`` plumbing)."""
+        (``BlockStoreClient.mark_failed`` plumbing);
+        ``batch``: scatter/gather coalescing (None = per-op only)."""
         super().__init__(block_id, length)
         self._worker = worker
         self._ufs = ufs
@@ -207,6 +236,7 @@ class GrpcBlockInStream(BlockInStream):
         self._replicas = replicas or []
         self._client_factory = client_factory
         self._on_failed = on_failed
+        self._batch = batch
 
     # -- parallel data plane -------------------------------------------------
     def _striped_sources(self, conf):
@@ -253,7 +283,8 @@ class GrpcBlockInStream(BlockInStream):
 
     def _use_striped(self, n: int) -> bool:
         rt = self._remote_read
-        return rt is not None and rt.enabled and n > rt.conf.stripe_size
+        return rt is not None and rt.enabled and \
+            choose_route(n, striped=rt.conf) == "striped"
 
     def pread(self, offset: int, n: int) -> bytes:
         n = max(0, min(n, self.length - offset))
@@ -272,6 +303,60 @@ class GrpcBlockInStream(BlockInStream):
         self.last_source = source or "REMOTE"
         _record_read(self.source_bucket(), len(out))
         return bytes(out)
+
+    def pread_many(self, offsets: Sequence[int],
+                   sizes: Sequence[int]) -> List[bytes]:
+        """Small-op batches coalesce into ``read_many`` RPCs: one wire
+        round trip and ONE response buffer per ``max_ops`` ops instead
+        of an RPC per op — the random-4k fix (docs/small_reads.md).
+        Ineligible ops (too large, cold block needing a UFS descriptor,
+        batching off) and any RPC failure take the per-op path, which
+        is byte-identical by construction."""
+        b = self._batch
+        # choose_route decides per the routing matrix; the stream adds
+        # its own constraint: cold blocks (UFS descriptor present) need
+        # the read-through stream, so they stay per-op
+        eligible = (self._ufs is None and len(sizes) > 0 and choose_route(
+            max(sizes), batch=b, batch_ops=len(offsets)) == "batch")
+        if not eligible:
+            return super().pread_many(offsets, sizes)
+        try:
+            return self._batched_pread_many(offsets, sizes, b.max_ops)
+        except Exception:  # noqa: BLE001 - transparent per-op fallback
+            _metrics().counter("Client.BatchReadFallbacks").inc()
+            return super().pread_many(offsets, sizes)
+
+    def _batched_pread_many(self, offsets: Sequence[int],
+                            sizes: Sequence[int],
+                            max_ops: int) -> List[bytes]:
+        import time as _time
+
+        from alluxio_tpu.utils.tracing import current_span
+
+        m = _metrics()
+        sp = current_span()
+        out: List[bytes] = []
+        total = 0
+        for i in range(0, len(offsets), max_ops):
+            offs = list(offsets[i:i + max_ops])
+            szs = [max(0, min(s, self.length - off))
+                   for off, s in zip(offs, sizes[i:i + max_ops])]
+            t0 = _time.perf_counter()
+            resp = self._worker.read_many(self.block_id, offs, szs)
+            if sp is not None:
+                sp.phase("wire", (_time.perf_counter() - t0) * 1000.0)
+            buf = memoryview(resp["data"])
+            pos = 0
+            for n in resp["lengths"]:
+                out.append(bytes(buf[pos:pos + n]))
+                pos += n
+                total += n
+            self.last_source = resp.get("source") or "REMOTE"
+            m.counter("Client.BatchReadBatches").inc()
+            m.counter("Client.BatchReadOps").inc(len(offs))
+        m.counter("Client.BatchReadBytes").inc(total)
+        _record_read(self.source_bucket(), total)
+        return out
 
     def read_all_view(self) -> memoryview:
         """The whole block as a buffer view: striped reads hand back

@@ -16,6 +16,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from alluxio_tpu.client.block_store import BlockStoreClient
+from alluxio_tpu.client.block_streams import BatchReadConf
 from alluxio_tpu.client.policy import BlockLocationPolicy
 from alluxio_tpu.client.remote_read import RemoteReadConf
 from alluxio_tpu.client.streams import FileInStream, FileOutStream, WriteType
@@ -216,7 +217,13 @@ class FileSystem:
                 Keys.USER_STREAMING_READER_CHUNK_SIZE),
             streaming_writer_chunk_size=self._conf.get_bytes(
                 Keys.USER_STREAMING_WRITER_CHUNK_SIZE),
-            remote_read=RemoteReadConf.from_conf(self._conf))
+            remote_read=RemoteReadConf.from_conf(self._conf),
+            shm_enabled=self._conf.get_bool(Keys.USER_SHM_ENABLED),
+            shm_cache_max=self._conf.get_int(
+                Keys.USER_SHM_SEGMENT_CACHE_MAX),
+            shm_renew_fraction=self._conf.get_float(
+                Keys.USER_SHM_LEASE_RENEW_FRACTION),
+            batch_read=BatchReadConf.from_conf(self._conf))
         # pull cluster defaults once at start (reference: clients load
         # cluster-default config via the meta master on first connect)
         self._path_conf: Dict[str, Dict[str, str]] = {}

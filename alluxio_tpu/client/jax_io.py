@@ -88,6 +88,14 @@ class StepStats:
         self.size_wait_s = {b: 0.0 for b in SIZE_BUCKETS}
         self.size_count = {b: 0 for b in SIZE_BUCKETS}
         self.size_bytes = {b: 0 for b in SIZE_BUCKETS}
+        # the tier x size cross: "le4k stalls" alone doesn't say whether
+        # the small reads were already on the SHM plane (compute-bound,
+        # nothing to turn) or still paying remote RPCs (enable batching
+        # / co-locate) — fsadmin report stall renders this split
+        self.cross_wait_s = {(t, s): 0.0 for t in STALL_BUCKETS
+                             for s in SIZE_BUCKETS}
+        self.cross_count = {(t, s): 0 for t in STALL_BUCKETS
+                            for s in SIZE_BUCKETS}
         #: rolling (wait_s, elapsed_s) per consumed block — the gauge's
         #: window, so the fraction tracks NOW, not the whole run
         self._window: deque = deque(maxlen=window)
@@ -125,6 +133,8 @@ class StepStats:
             self.size_wait_s[sb] += wait_s
             self.size_count[sb] += 1
             self.size_bytes[sb] += nbytes
+            self.cross_wait_s[(bucket, sb)] += wait_s
+            self.cross_count[(bucket, sb)] += 1
             self._window.append((wait_s, max(elapsed_s, wait_s)))
         self._m.timer(f"Client.InputStall.{bucket}").update(wait_s)
         self._m.counter(f"Client.InputStallSizeUs.{sb}").inc(
@@ -134,6 +144,13 @@ class StepStats:
             int(wait_s * 1e6))
         self._m.counter(f"Client.InputStallCount.{bucket}").inc()
         self._m.counter(f"Client.InputStallBytes.{bucket}").inc(nbytes)
+        # the tier x size cross (additive, rolls up to Cluster.*):
+        # fsadmin report stall cuts the le4k row by these to show
+        # whether small reads ride shm / remote / ufs
+        self._m.counter(f"Client.InputStallCrossUs.{bucket}.{sb}").inc(
+            int(wait_s * 1e6))
+        self._m.counter(
+            f"Client.InputStallCrossCount.{bucket}.{sb}").inc()
 
     def input_bound_fraction(self) -> float:
         """Share of recent wall time the consumer spent waiting for
@@ -150,6 +167,8 @@ class StepStats:
             s_wait = dict(self.size_wait_s)
             s_count = dict(self.size_count)
             s_bytes = dict(self.size_bytes)
+            x_wait = dict(self.cross_wait_s)
+            x_count = dict(self.cross_count)
         total = sum(wait.values())
         buckets = {}
         for b in STALL_BUCKETS:
@@ -175,10 +194,23 @@ class StepStats:
         for b in SIZE_BUCKETS:
             if not s_count[b]:
                 continue
+            # per-size tier split: which plane the ops of this size rode
+            # (the le4k row is how you read "did batching/SHM land?")
+            by_source = {}
+            for t in STALL_BUCKETS:
+                if not x_count[(t, b)]:
+                    continue
+                by_source[t] = {
+                    "wait_s": round(x_wait[(t, b)], 6),
+                    "count": x_count[(t, b)],
+                    "share": round(x_wait[(t, b)] / s_wait[b], 4)
+                    if s_wait[b] else 0.0,
+                }
             size_buckets[b] = {
                 "wait_s": round(s_wait[b], 6), "count": s_count[b],
                 "bytes": s_bytes[b],
                 "share": round(s_wait[b] / total, 4) if total else 0.0,
+                "by_source": by_source,
             }
         return {"total_wait_s": round(total, 6),
                 "input_bound_fraction": round(frac, 4),

@@ -110,6 +110,38 @@ class RemoteReadConf:
         return self.stripe_size > 0
 
 
+def choose_route(length: int, *, same_host_shm: bool = False,
+                 batch=None, batch_ops: int = 1,
+                 striped: Optional[RemoteReadConf] = None) -> str:
+    """The read-plane routing decision, in one place (docs/small_reads.md
+    has the full matrix):
+
+    - ``"shm"``     — same-host + SHM transport live: mmap the segment,
+                      zero RPC/serialize/copy per read
+    - ``"batch"``   — a multi-op batch of small reads: coalesce into
+                      ``read_many`` RPCs (one wire round trip per batch)
+    - ``"striped"`` — a read larger than one stripe: the parallel
+                      multi-stream plane below
+    - ``"stream"``  — everything else: the legacy single ``read_block``
+                      stream (and the byte-identical disabled path)
+
+    Precedence is top-down: same-host beats everything (no wire at
+    all), batching beats striping only because it is checked for small
+    ops striping would never take. Every fast route falls back one row
+    down on failure — the router can only make reads faster, never fail
+    them. ``batch`` is a ``BatchReadConf`` (duck-typed to avoid a
+    module cycle with ``block_streams``)."""
+    if same_host_shm:
+        return "shm"
+    if batch is not None and batch.enabled and batch_ops > 1 and \
+            length <= batch.max_op_bytes:
+        return "batch"
+    if striped is not None and striped.enabled and \
+            length > striped.stripe_size:
+        return "striped"
+    return "stream"
+
+
 @_functools.lru_cache(maxsize=64)
 def _z_score(quantile: float) -> float:
     """Normal z-score of a quantile — cached: the hedger evaluates it

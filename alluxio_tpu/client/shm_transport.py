@@ -1,0 +1,275 @@
+"""Client side of the same-host zero-copy plane: SHM segment transport.
+
+A co-located client leases a block's MEM-tier file from the worker
+(``shm_open``), mmaps it ONCE, and serves every read of that block as a
+``memoryview`` slice over the shared pages — zero RPCs, zero
+serialization, zero copies per read. ``numpy_view`` hands the same pages
+to ``np.frombuffer`` for a single ``jax.device_put`` (the only copy a
+same-host read ever pays is host->device). See ``alluxio_tpu/shm/`` for
+the lease protocol and docs/small_reads.md for the design.
+
+The transport keeps an LRU **segment cache**
+(``atpu.user.shm.segment.cache.max``): repeated opens of a hot block —
+the shuffled-small-read pattern the subsystem exists for — cost a dict
+hit, not an RPC. Leases renew *lazily*: a read touching a segment past
+``atpu.user.shm.lease.renew.fraction`` of its TTL fires one
+``shm_renew``, amortized over every read in between.
+
+Failure contract (the fallback matrix in docs/small_reads.md): every
+exit from this plane is a typed error the routing layer catches —
+``ShmLeaseDeniedError`` / ``ShmSegmentUnavailableError`` from the
+worker, ``OSError`` from a failed map (or the injected
+``atpu.debug.fault.shm.map.error.rate``). A *renewal* failure on an
+already-mapped segment is NOT an error: Linux keeps mmapped pages valid
+across an unlink, so in-flight readers finish safely and only the next
+cold open re-routes.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from alluxio_tpu.client.block_streams import BlockInStream, _record_read
+from alluxio_tpu.rpc.clients import WorkerClient
+
+
+class ShmSegment:
+    """One mapped segment: mmap + lease bookkeeping."""
+
+    __slots__ = ("block_id", "path", "length", "lease_id", "ttl_s",
+                 "renew_at", "mm", "dead")
+
+    def __init__(self, block_id: int, path: str, length: int,
+                 lease_id: int, ttl_s: float, renew_fraction: float,
+                 mm: Optional[mmap.mmap]) -> None:
+        self.block_id = block_id
+        self.path = path
+        self.length = length
+        self.lease_id = lease_id
+        self.ttl_s = ttl_s
+        self.renew_at = time.monotonic() + ttl_s * renew_fraction
+        self.mm = mm
+        #: lease lost (renewal refused / released): serve existing maps,
+        #: stop cache hits
+        self.dead = False
+
+    def view(self, offset: int = 0, length: int = -1) -> memoryview:
+        if self.mm is None:
+            return memoryview(b"")
+        end = self.length if length < 0 else min(self.length,
+                                                 offset + length)
+        return memoryview(self.mm)[offset:max(offset, end)]
+
+    def close_map(self) -> None:
+        mm, self.mm = self.mm, None
+        if mm is not None:
+            try:
+                mm.close()
+            except BufferError:
+                # a numpy view is still live (in-flight device_put);
+                # leave the mapping to GC — pages stay valid on Linux
+                pass
+
+
+class ShmTransport:
+    """Per-process segment cache + lease manager."""
+
+    def __init__(self, session_id: int, *, cache_max: int = 64,
+                 renew_fraction: float = 0.5, host: str = "") -> None:
+        self._session = session_id
+        self._cache_max = max(1, int(cache_max))
+        self._renew_fraction = min(0.95, max(0.05, float(renew_fraction)))
+        self._host = host
+        self._lock = threading.Lock()
+        self._segments: "OrderedDict[int, ShmSegment]" = OrderedDict()
+
+    # -------------------------------------------------------------- open
+    def open_stream(self, worker: WorkerClient, block_id: int
+                    ) -> "ShmBlockInStream":
+        """The same-host read stream; raises the typed fallback errors
+        (lease denied / segment unavailable / map OSError) the routing
+        ladder in ``BlockStoreClient.open_block`` catches."""
+        return ShmBlockInStream(self, worker, self.segment(worker,
+                                                           block_id))
+
+    def segment(self, worker: WorkerClient, block_id: int) -> ShmSegment:
+        with self._lock:
+            seg = self._segments.get(block_id)
+            if seg is not None and not seg.dead:
+                self._segments.move_to_end(block_id)
+            else:
+                seg = None
+        if seg is not None:
+            self._maybe_renew(worker, seg)
+            if not seg.dead:
+                return seg
+            self.invalidate(block_id)
+        return self._map(worker, block_id)
+
+    def _map(self, worker: WorkerClient, block_id: int) -> ShmSegment:
+        from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils import faults
+        from alluxio_tpu.utils.tracing import current_span
+
+        sp = current_span()
+        t0 = time.perf_counter()
+        # lease grant: the worker pins the block against eviction before
+        # we touch the file — typed denials propagate to the router
+        lease = worker.shm_open(self._session, block_id)
+        if sp is not None:
+            sp.phase("lease_wait", (time.perf_counter() - t0) * 1000.0)
+        t1 = time.perf_counter()
+        try:
+            if faults.armed() and \
+                    faults.injector().take_shm_map_error(self._host):
+                raise OSError(
+                    f"injected shm map fault for block {block_id}")
+            if lease["length"] > 0:
+                f = open(lease["path"], "rb")
+                try:
+                    mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+                finally:
+                    f.close()
+            else:
+                mm = None
+        except OSError:
+            metrics().counter("Client.ShmMapFailures").inc()
+            # we hold a lease we cannot use; give it back now rather
+            # than waiting out the TTL
+            try:
+                worker.shm_release(self._session, lease["lease_id"])
+            except Exception:  # noqa: BLE001 - TTL reclaims it anyway
+                pass
+            raise
+        if sp is not None:
+            sp.phase("shm_map", (time.perf_counter() - t1) * 1000.0)
+        seg = ShmSegment(block_id, lease["path"], lease["length"],
+                         lease["lease_id"], lease["ttl_s"],
+                         self._renew_fraction, mm)
+        victims = []
+        with self._lock:
+            self._segments[block_id] = seg
+            self._segments.move_to_end(block_id)
+            while len(self._segments) > self._cache_max:
+                victims.append(self._segments.popitem(last=False)[1])
+        for v in victims:
+            self._release(worker, v)
+        return seg
+
+    # ------------------------------------------------------------- leases
+    def _maybe_renew(self, worker: WorkerClient, seg: ShmSegment) -> None:
+        """Lazy renewal: one RPC past the renew point, amortized over
+        the zero-copy reads in between. A refused renewal (worker
+        restarted, lease reclaimed) marks the segment dead — existing
+        views stay valid (mmap semantics), the next open re-leases."""
+        if seg.dead or time.monotonic() < seg.renew_at:
+            return
+        try:
+            resp = worker.shm_renew(self._session, seg.lease_id)
+        except Exception:  # noqa: BLE001 - worker gone: segment is stale
+            seg.dead = True
+            return
+        if resp.get("ok"):
+            seg.renew_at = time.monotonic() + \
+                float(resp.get("ttl_s", seg.ttl_s)) * self._renew_fraction
+        else:
+            seg.dead = True
+
+    def touch(self, worker: WorkerClient, seg: ShmSegment) -> None:
+        """Read-path hook: keep the lease fresh while a stream serves."""
+        self._maybe_renew(worker, seg)
+
+    def _release(self, worker: Optional[WorkerClient],
+                 seg: ShmSegment) -> None:
+        seg.dead = True
+        seg.close_map()
+        if worker is not None:
+            try:
+                worker.shm_release(self._session, seg.lease_id)
+            except Exception:  # noqa: BLE001 - TTL reclaims it anyway
+                pass
+
+    def invalidate(self, block_id: int) -> None:
+        with self._lock:
+            seg = self._segments.pop(block_id, None)
+        if seg is not None:
+            seg.dead = True
+            seg.close_map()
+
+    def close(self, worker_for=None) -> None:
+        """Unmap everything; ``worker_for(block_id) -> WorkerClient``
+        enables graceful lease release (else TTL expiry reclaims)."""
+        with self._lock:
+            segs = list(self._segments.values())
+            self._segments.clear()
+        for seg in segs:
+            w = worker_for(seg.block_id) if worker_for is not None else None
+            self._release(w, seg)
+
+    def cached_blocks(self) -> int:
+        with self._lock:
+            return len(self._segments)
+
+
+class ShmBlockInStream(BlockInStream):
+    """Same-host zero-copy stream over a cached SHM segment.
+
+    Reads are ``memoryview`` slices of shared pages: no RPC, no
+    serialization — the read-path microscope shows zero ``serialize`` /
+    ``wire`` phase time here, which `make bench-smallread` asserts."""
+
+    source = "LOCAL"
+
+    def __init__(self, transport: ShmTransport, worker: WorkerClient,
+                 seg: ShmSegment) -> None:
+        super().__init__(seg.block_id, seg.length)
+        self.last_source = "SHM"
+        self._transport = transport
+        self._worker = worker
+        self._seg = seg
+
+    def pread(self, offset: int, n: int) -> bytes:
+        self._transport.touch(self._worker, self._seg)
+        out = bytes(self._seg.view(offset, n))
+        from alluxio_tpu.metrics import metrics
+
+        metrics().counter("Client.ShmReads").inc()
+        _record_read("shm", len(out))
+        return out
+
+    def pread_view(self, offset: int, n: int) -> memoryview:
+        """The zero-copy form of :meth:`pread`: a live view of the
+        shared pages, no intermediate ``bytes``."""
+        self._transport.touch(self._worker, self._seg)
+        out = self._seg.view(offset, n)
+        from alluxio_tpu.metrics import metrics
+
+        metrics().counter("Client.ShmReads").inc()
+        _record_read("shm", len(out))
+        return out
+
+    def memoryview(self) -> Optional[memoryview]:
+        return self._seg.view()
+
+    def numpy_view(self, dtype=np.uint8) -> np.ndarray:
+        """Zero-copy ndarray over the shared pages — feed straight to
+        ``jax.device_put`` (the DLPack/``np.frombuffer`` handoff)."""
+        if self._seg.mm is None:
+            return np.empty(0, dtype=dtype)
+        from alluxio_tpu.metrics import metrics
+
+        metrics().counter("Client.ShmReads").inc()
+        _record_read("shm", self._seg.length)
+        return np.frombuffer(self._seg.mm, dtype=dtype)
+
+    def close(self) -> None:
+        # the segment stays cached (and leased) for the next open — the
+        # whole point of the transport; BlockStoreClient.close releases
+        pass

@@ -617,6 +617,22 @@ class Keys:
                         description="Backing dir for the MEM tier; files here are "
                                     "mmap-able by same-host clients for the "
                                     "short-circuit zero-copy read path.")
+    WORKER_SHM_LEASE_TTL = _k(
+        "atpu.worker.shm.lease.ttl", KeyType.DURATION, default="30s",
+        scope=Scope.WORKER,
+        description="TTL of a client's SHM segment lease. The lease pins "
+                    "the block against eviction; clients renew lazily "
+                    "(shm_renew) while a segment stays mapped, and a "
+                    "crashed client's pins self-expire after one TTL — "
+                    "the crash-safe reclamation path needs no death "
+                    "detection.")
+    WORKER_SHM_MAX_LEASES = _k(
+        "atpu.worker.shm.max.leases", KeyType.INT, default=1024,
+        scope=Scope.WORKER,
+        description="Concurrent SHM leases the worker grants before "
+                    "denying shm_open (clients fall back to the remote "
+                    "path) — bounds how much of the MEM tier client pins "
+                    "can hold unevictable.")
     WORKER_UFS_FETCH_STRIPE_SIZE = _k(
         "atpu.worker.ufs.fetch.stripe.size", KeyType.BYTES, default="4MB",
         scope=Scope.WORKER,
@@ -746,6 +762,53 @@ class Keys:
                     "worker's rolling EWMA is re-issued to another "
                     "replica/channel; first answer wins, the loser is "
                     "cancelled. 0 disables hedging.")
+    USER_SHM_ENABLED = _k(
+        "atpu.user.shm.enabled", KeyType.BOOL, default=True,
+        scope=Scope.CLIENT,
+        description="Same-host zero-copy SHM transport: when the serving "
+                    "worker is co-located, the client leases the block's "
+                    "MEM-tier segment (shm_open RPC), mmaps it, and reads "
+                    "through a memoryview with no RPC, serialization, or "
+                    "copy per read. Fallback to the remote path is "
+                    "transparent (segment unavailable, lease denied, "
+                    "worker restart). Off: reads are byte-identical to a "
+                    "build without the subsystem.")
+    USER_SHM_SEGMENT_CACHE_MAX = _k(
+        "atpu.user.shm.segment.cache.max", KeyType.INT, default=64,
+        scope=Scope.CLIENT,
+        description="Mapped SHM segments held per client process (LRU); "
+                    "evicting a segment unmaps it and releases its worker "
+                    "lease. Bounds client address-space use, not "
+                    "correctness — a miss re-leases on next read.")
+    USER_SHM_LEASE_RENEW_FRACTION = _k(
+        "atpu.user.shm.lease.renew.fraction", KeyType.FLOAT, default=0.5,
+        scope=Scope.CLIENT,
+        description="A cached segment whose lease has consumed this "
+                    "fraction of its TTL is renewed lazily on the next "
+                    "read touching it (one shm_renew RPC amortized over "
+                    "many zero-copy reads).")
+    USER_BATCH_READ_ENABLED = _k(
+        "atpu.user.batch.read.enabled", KeyType.BOOL, default=True,
+        scope=Scope.CLIENT,
+        description="Scatter/gather batch reads: read_many coalesces a "
+                    "batch of small same-block reads into ONE read_many "
+                    "RPC landing in one preallocated buffer (one "
+                    "serialize + one wire round-trip instead of N). Off: "
+                    "each read is an individual RPC, byte-identical to "
+                    "today's per-op path.")
+    USER_BATCH_READ_MAX_OP_BYTES = _k(
+        "atpu.user.batch.read.max.op.bytes", KeyType.BYTES, default="64KB",
+        scope=Scope.CLIENT,
+        description="Reads at or below this size are eligible for "
+                    "read_many coalescing; larger ops route to the "
+                    "striped remote-read path where per-op RPC cost is "
+                    "already amortized.")
+    USER_BATCH_READ_MAX_OPS = _k(
+        "atpu.user.batch.read.max.ops", KeyType.INT, default=256,
+        scope=Scope.CLIENT,
+        description="Ops coalesced into one read_many RPC; a larger "
+                    "batch is split into ceil(n/max) RPCs so one "
+                    "response message stays bounded.")
     USER_QOS_STRIPE_LIMIT = _k(
         "atpu.user.qos.stripe.limit", KeyType.INT, default=0,
         scope=Scope.CLIENT,
@@ -1207,6 +1270,21 @@ class Keys:
                     "client retry-after honoring without a real "
                     "flood. The fault scope matches the RPC's "
                     "service.method key.")
+    DEBUG_FAULT_SHM_MAP_ERROR_RATE = _k(
+        "atpu.debug.fault.shm.map.error.rate", KeyType.FLOAT, default=0.0,
+        scope=Scope.CLIENT,
+        description="FAULT INJECTION (tests/chaos only): deterministic "
+                    "fraction (0..1) of client SHM segment maps that "
+                    "fail with an injected OSError — drills the "
+                    "SHM->remote transparent-fallback path.")
+    DEBUG_FAULT_SHM_LEASE_DENY_RATE = _k(
+        "atpu.debug.fault.shm.lease.deny.rate", KeyType.FLOAT, default=0.0,
+        scope=Scope.WORKER,
+        description="FAULT INJECTION (tests/chaos only): deterministic "
+                    "fraction (0..1) of worker shm_open lease grants "
+                    "denied as if the lease table were full — drills "
+                    "lease-denied fallback without filling "
+                    "atpu.worker.shm.max.leases.")
     DEBUG_FAULT_SCOPE = _k(
         "atpu.debug.fault.scope", KeyType.STRING, default="",
         scope=Scope.WORKER,

@@ -705,6 +705,34 @@ class WorkerClient(_BaseClient):
         return b"".join(msg["data"] for msg in
                         self.read_block(block_id, **kwargs))
 
+    def read_many(self, block_id: int, offsets, sizes) -> dict:
+        """Scatter/gather batch read: N small reads of one block in ONE
+        RPC — ``{data: <concatenated bytes>, lengths: [..], source}``.
+        The caller slices per-op views out of ``data`` (the response
+        lands in one buffer; no per-op payloads to reassemble)."""
+        return self._call("read_many", {
+            "block_id": block_id, "offsets": list(offsets),
+            "sizes": list(sizes)})
+
+    def shm_open(self, session_id: int, block_id: int) -> dict:
+        """Lease the block's same-host SHM segment:
+        ``{lease_id, path, length, ttl_s}``. Raises typed
+        ShmLeaseDeniedError / ShmSegmentUnavailableError — the caller's
+        cue to fall back to the remote path (shm/)."""
+        return self._call("shm_open", {"session_id": session_id,
+                                       "block_id": block_id})
+
+    def shm_renew(self, session_id: int, lease_id: int) -> dict:
+        return self._call("shm_renew", {"session_id": session_id,
+                                        "lease_id": lease_id})
+
+    def shm_release(self, session_id: int, lease_id: int) -> None:
+        # advisory like close_local_block: the worker's TTL reclaims it
+        # anyway — short deadline, no retry against a dead worker
+        self._channel.call(self.service, "shm_release",
+                           {"session_id": session_id,
+                            "lease_id": lease_id}, timeout=2.0)
+
     def write_block(self, block_id: int, session_id: int, data: bytes, *,
                     tier: str = "", chunk_size: int = 1 << 20,
                     pinned: bool = False) -> int:

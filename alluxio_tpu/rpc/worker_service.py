@@ -224,6 +224,67 @@ def worker_service(worker: BlockWorker) -> ServiceDefinition:
 
     svc.stream_out("read_block", read_block)
 
+    # -------------------------------------------------- scatter/gather read
+    def read_many(req: dict) -> dict:
+        """Batch of small reads against ONE block, served in one RPC:
+        ``{block_id, offsets: [..], sizes: [..]}`` -> one concatenated
+        payload + per-op lengths. One reader open, one block lock, one
+        serialization — the per-op RPC cost the random-4k drill showed
+        dominating (``wire`` ~85% of self-time) is paid once per batch
+        instead of once per read. Ops are served in request order; a
+        short read (op past EOF) yields a short slice, matching what
+        the same per-op ``read_block`` calls would return."""
+        import time as _time
+
+        from alluxio_tpu.metrics import metrics
+        from alluxio_tpu.utils.tracing import current_span
+
+        block_id = req["block_id"]
+        offsets = req["offsets"]
+        sizes = req["sizes"]
+        if len(offsets) != len(sizes):
+            raise InvalidArgumentError(
+                f"read_many: {len(offsets)} offsets vs {len(sizes)} sizes")
+        m = metrics()
+        sp = current_span()
+        t0 = _time.perf_counter()
+        lengths = []
+        parts = []
+        with worker.open_reader(block_id) as r:
+            tier = r.tier_alias or "MEM"
+            served = m.counter(f"Worker.BytesServed.{tier}")
+            for off, size in zip(offsets, sizes):
+                data = r.read(off, max(0, size))
+                parts.append(data)
+                lengths.append(len(data))
+                served.inc(len(data))
+        m.counter(f"Worker.BlocksServed.{tier}").inc()
+        m.counter("Worker.BatchReadOps").inc(len(offsets))
+        if sp is not None:
+            # the whole gather is one tier_read burst; batch_read is the
+            # assembly slice the critical-path analyzer attributes to
+            # this subsystem
+            sp.phase("batch_read", (_time.perf_counter() - t0) * 1000.0)
+        return {"data": b"".join(parts), "lengths": lengths,
+                "source": tier}
+
+    svc.unary("read_many", read_many)
+
+    # ------------------------------------------------------ shm lease plane
+    def shm_open(req: dict) -> dict:
+        return worker.shm_store.open(req["session_id"], req["block_id"])
+
+    def shm_renew(req: dict) -> dict:
+        return worker.shm_store.renew(req["session_id"], req["lease_id"])
+
+    def shm_release(req: dict) -> dict:
+        return {"released": worker.shm_store.release(
+            req["session_id"], req["lease_id"])}
+
+    svc.unary("shm_open", shm_open)
+    svc.unary("shm_renew", shm_renew)
+    svc.unary("shm_release", shm_release)
+
     # ---------------------------------------------------------- write stream
     def write_block(requests: Iterator[dict]) -> dict:
         header = next(requests)

@@ -465,6 +465,23 @@ class ReportCommand(Command):
                 ctx.print(f"    {b:<10s} "
                           f"{int(size_counts.get(b, 0)):>8d} "
                           f"{s:>11.3f}s {share:>6.1f}%")
+        # the small-read plane check: the le4k row re-cut by serving
+        # tier. All-shm means the zero-copy plane landed; remote-heavy
+        # means batching/co-location is the lever (docs/small_reads.md)
+        cross_us = bucket_stats("CrossUs")
+        cross_counts = bucket_stats("CrossCount")
+        le4k = {k.split(".")[0]: v for k, v in cross_us.items()
+                if k.endswith(".le4k") and v}
+        if le4k:
+            le4k_s = sum(le4k.values()) / 1e6
+            ctx.print(f"    le4k by tier (shm vs remote vs ufs, "
+                      f"{le4k_s:.3f}s):")
+            for t, us in sorted(le4k.items(), key=lambda kv: -kv[1]):
+                s = us / 1e6
+                share = (100.0 * us / sum(le4k.values()))
+                n = int(cross_counts.get(f"{t}.le4k", 0))
+                ctx.print(f"      {t:<8s} {n:>8d} {s:>11.3f}s "
+                          f"{share:>6.1f}%")
         # cluster mean first (the fleet view, averaged across reporting
         # clients); the master's own gauge only exists when a loader
         # ran in-process and would shadow the fleet with a stale 0.0

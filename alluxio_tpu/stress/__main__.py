@@ -111,6 +111,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fail the critical-path row when named phases "
                          "explain less of root wall time than this")
 
+    sr = sub.add_parser("smallread",
+                        help="small-read data plane: batched random-4k "
+                             "over real gRPC vs per-op RPCs, and "
+                             "same-host SHM zero-copy fidelity "
+                             "(buffer identity, no wire phase)")
+    sr.add_argument("--row", choices=("batch", "shm"), default="batch",
+                    help="which row: read_many coalescing speedup "
+                         "(default) or SHM zero-copy fidelity")
+    sr.add_argument("--file-mb", type=int, default=2)
+    sr.add_argument("--ops", type=int, default=None,
+                    help="random preads measured (default: 400 batch "
+                         "row, 200 shm row)")
+    sr.add_argument("--read-bytes", type=int, default=4096)
+    sr.add_argument("--min-speedup", type=float, default=3.0,
+                    help="batch row: fail below this batched/per-op "
+                         "ops/s ratio")
+
     he = sub.add_parser("health", help="metrics-history ingestion "
                                        "overhead on the heartbeat hot "
                                        "path (fake-clock harness)")
@@ -285,6 +302,8 @@ SUITE = (
     ("obs-profile-overhead", ["obs", "--row", "profile"]),
     ("obs-critical-path", ["obs", "--row", "critical-path",
                            "--file-mb", "2", "--reads", "80"]),
+    ("smallread-batch", ["smallread", "--row", "batch"]),
+    ("smallread-shm-zerocopy", ["smallread", "--row", "shm"]),
     ("health-ingest-overhead", ["health"]),
     ("selfheal-remediation", ["selfheal"]),
     ("ufs-cold-read", ["ufscold"]),
@@ -472,6 +491,20 @@ def main(argv=None) -> int:
                     batches=args.batches,
                     span_iterations=args.span_iterations,
                     max_overhead_pct=args.max_overhead_pct)
+    elif args.bench == "smallread":
+        if args.row == "shm":
+            from alluxio_tpu.stress.smallread_bench import run_shm
+
+            r = run_shm(file_mb=args.file_mb,
+                        ops=args.ops if args.ops is not None else 200,
+                        read_bytes=args.read_bytes)
+        else:
+            from alluxio_tpu.stress.smallread_bench import run_batch
+
+            r = run_batch(file_mb=args.file_mb,
+                          ops=args.ops if args.ops is not None else 400,
+                          read_bytes=args.read_bytes,
+                          min_speedup=args.min_speedup)
     elif args.bench == "health":
         from alluxio_tpu.stress.health_bench import run
 

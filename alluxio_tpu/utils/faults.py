@@ -20,7 +20,14 @@ substring so a multi-worker cluster can break exactly one node:
   and client-side retry-after honoring can be chaos-tested end to end
   without a real flood.  The scope substring matches the RPC's
   ``service.method`` key (e.g. scope ``create_file`` rejects only
-  CreateFile).
+  CreateFile);
+- **SHM map error rate** — a deterministic fraction of client-side
+  SHM segment maps fail with an injected ``OSError``, drilling the
+  same-host zero-copy path's transparent fallback to remote reads;
+- **SHM lease deny rate** — a deterministic fraction of worker
+  ``shm_open`` grants is denied as if the lease table were full,
+  drilling lease-denied fallback without actually filling
+  ``atpu.worker.shm.max.leases``.
 
 The HA chaos drill (docs/ha.md) adds four programmatic faults — set by
 the minicluster / :class:`FaultPlan`, not by conf, since they only make
@@ -60,6 +67,8 @@ class FaultInjector:
         self.ufs_error_rate: float = 0.0
         self.rpc_reject_rate: float = 0.0
         self.rpc_reject_retry_after_s: float = 0.05
+        self.shm_map_error_rate: float = 0.0
+        self.shm_lease_deny_rate: float = 0.0
         self.scope: str = ""
         #: HA chaos faults (programmatic; see module docstring)
         self.tailer_freeze_scope: str = ""
@@ -69,12 +78,17 @@ class FaultInjector:
         #: injected-fault tallies, for tests and fsadmin spelunking
         self.injected = {"read_latency": 0, "heartbeat_freeze": 0,
                          "ufs_error": 0, "rpc_reject": 0,
+                         "shm_map_error": 0, "shm_lease_deny": 0,
                          "tailer_freeze": 0, "election_freeze": 0,
                          "partition_drop": 0, "fsync_error": 0}
         self._ufs_reads = 0
         self._ufs_failed = 0
         self._rpc_calls = 0
         self._rpc_rejected = 0
+        self._shm_maps = 0
+        self._shm_map_failed = 0
+        self._shm_grants = 0
+        self._shm_denied = 0
 
     # ----------------------------------------------------------- config
     def configure(self, conf) -> None:
@@ -89,12 +103,18 @@ class FaultInjector:
             ufs_error_rate=conf.get_float(Keys.DEBUG_FAULT_UFS_ERROR_RATE),
             rpc_reject_rate=conf.get_float(
                 Keys.DEBUG_FAULT_RPC_REJECT_RATE),
+            shm_map_error_rate=conf.get_float(
+                Keys.DEBUG_FAULT_SHM_MAP_ERROR_RATE),
+            shm_lease_deny_rate=conf.get_float(
+                Keys.DEBUG_FAULT_SHM_LEASE_DENY_RATE),
             scope=str(conf.get(Keys.DEBUG_FAULT_SCOPE) or ""))
 
     def set(self, *, read_latency_s: Optional[float] = None,
             heartbeat_freeze: Optional[bool] = None,
             ufs_error_rate: Optional[float] = None,
             rpc_reject_rate: Optional[float] = None,
+            shm_map_error_rate: Optional[float] = None,
+            shm_lease_deny_rate: Optional[float] = None,
             scope: Optional[str] = None,
             tailer_freeze_scope: Optional[str] = None,
             election_freeze_scope: Optional[str] = None,
@@ -112,6 +132,12 @@ class FaultInjector:
             if rpc_reject_rate is not None:
                 self.rpc_reject_rate = min(1.0, max(
                     0.0, float(rpc_reject_rate)))
+            if shm_map_error_rate is not None:
+                self.shm_map_error_rate = min(1.0, max(
+                    0.0, float(shm_map_error_rate)))
+            if shm_lease_deny_rate is not None:
+                self.shm_lease_deny_rate = min(1.0, max(
+                    0.0, float(shm_lease_deny_rate)))
             if scope is not None:
                 self.scope = str(scope)
             if tailer_freeze_scope is not None:
@@ -129,6 +155,8 @@ class FaultInjector:
         global _armed
         _armed = bool(self.read_latency_s or self.heartbeat_freeze
                       or self.ufs_error_rate or self.rpc_reject_rate
+                      or self.shm_map_error_rate
+                      or self.shm_lease_deny_rate
                       or self.tailer_freeze_scope
                       or self.election_freeze_scope
                       or self.partitioned or self.fsync_errors)
@@ -140,6 +168,8 @@ class FaultInjector:
             self.heartbeat_freeze = False
             self.ufs_error_rate = 0.0
             self.rpc_reject_rate = 0.0
+            self.shm_map_error_rate = 0.0
+            self.shm_lease_deny_rate = 0.0
             self.scope = ""
             self.tailer_freeze_scope = ""
             self.election_freeze_scope = ""
@@ -149,6 +179,10 @@ class FaultInjector:
             self._ufs_failed = 0
             self._rpc_calls = 0
             self._rpc_rejected = 0
+            self._shm_maps = 0
+            self._shm_map_failed = 0
+            self._shm_grants = 0
+            self._shm_denied = 0
             for k in self.injected:
                 self.injected[k] = 0
             _armed = False
@@ -231,6 +265,37 @@ class FaultInjector:
             self.injected["fsync_error"] += 1
             self._rearm_locked()
             return True
+
+    def take_shm_map_error(self, host: str) -> bool:
+        """True when this client SHM segment map should fail with an
+        injected ``OSError`` — same deterministic failed/total pacing
+        as the UFS hook, so the Nth map fails at the same read in
+        every run."""
+        rate = self.shm_map_error_rate
+        if rate <= 0 or not self._in_scope(host):
+            return False
+        with self._lock:
+            self._shm_maps += 1
+            if self._shm_map_failed < rate * self._shm_maps:
+                self._shm_map_failed += 1
+                self.injected["shm_map_error"] += 1
+                return True
+        return False
+
+    def take_shm_lease_deny(self, host: str) -> bool:
+        """True when this worker ``shm_open`` grant should be denied as
+        if the lease table were full (deterministic failed/total
+        pacing)."""
+        rate = self.shm_lease_deny_rate
+        if rate <= 0 or not self._in_scope(host):
+            return False
+        with self._lock:
+            self._shm_grants += 1
+            if self._shm_denied < rate * self._shm_grants:
+                self._shm_denied += 1
+                self.injected["shm_lease_deny"] += 1
+                return True
+        return False
 
     def take_rpc_reject(self, method_key: str) -> float:
         """Retry-after seconds when this RPC dispatch should be shed

@@ -170,6 +170,16 @@ class BlockWorker:
             self.store, FetchConf.from_conf(conf),
             host=self.address.tiered_identity.value("host")
             or self.address.host)
+        from alluxio_tpu.worker.shm_store import ShmStore
+
+        # same-host zero-copy plane: lease registry over the MEM tier's
+        # /dev/shm segments (shm/, docs/small_reads.md)
+        self.shm_store = ShmStore(
+            self.store,
+            lease_ttl_s=conf.get_duration_s(Keys.WORKER_SHM_LEASE_TTL),
+            max_leases=conf.get_int(Keys.WORKER_SHM_MAX_LEASES),
+            host=self.address.tiered_identity.value("host")
+            or self.address.host)
         self.web_server = None
         self.web_port: Optional[int] = None
         qos_enabled = conf.get_bool(Keys.WORKER_QOS_ENABLED)
@@ -379,4 +389,5 @@ class BlockWorker:
         return ufs.get_fingerprint(ufs_path).serialize()
 
     def cleanup_session(self, session_id: int) -> None:
+        self.shm_store.close_session(session_id)
         self.store.cleanup_session(session_id)

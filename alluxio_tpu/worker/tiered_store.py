@@ -164,6 +164,13 @@ class TieredBlockStore:
         #: would make the block unevictable forever — expiry is the
         #: worker-side reclamation path.
         self.prefetch_pinned_blocks: Dict[int, float] = {}
+        #: SHM-lease pins: block_id -> expiry (monotonic). A same-host
+        #: client holding an shm lease (shm/) has the MEM-tier file
+        #: mmapped; eviction must not demote/unlink it mid-read. Same
+        #: crash-safety shape as prefetch pins — TTL-bounded, NOT
+        #: session-bound: a SIGKILLed client's pins self-expire one
+        #: lease TTL later, no death detection needed.
+        self.shm_leased_blocks: Dict[int, float] = {}
         #: serialized allocation/eviction decisions (metadata lock; IO and
         #: reads proceed outside it — mirroring the reference's hierarchy)
         self._alloc_lock = threading.RLock()
@@ -345,6 +352,27 @@ class TieredBlockStore:
         with self._alloc_lock:
             self.prefetch_pinned_blocks.pop(block_id, None)
 
+    def pin_shm(self, block_id: int, ttl_s: float) -> bool:
+        """Shield a committed block from eviction while a same-host
+        client has its segment mmapped (shm lease). Renewal extends the
+        expiry; expiry never moves backwards, so a stale renewal racing
+        a fresh grant cannot shorten the pin. False when the block is
+        gone (the lease grant then fails)."""
+        import time
+
+        with self._alloc_lock:
+            if self.meta.get_block(block_id) is None:
+                return False
+            expiry = time.monotonic() + ttl_s
+            prev = self.shm_leased_blocks.get(block_id, 0.0)
+            self.shm_leased_blocks[block_id] = max(prev, expiry)
+        self.annotator.on_access(block_id)
+        return True
+
+    def unpin_shm(self, block_id: int) -> None:
+        with self._alloc_lock:
+            self.shm_leased_blocks.pop(block_id, None)
+
     def get_block_meta(self, block_id: int) -> Optional[BlockMeta]:
         return self.meta.get_block(block_id)
 
@@ -394,6 +422,7 @@ class TieredBlockStore:
                 self.pinned_blocks.discard(block_id)
                 self.master_pinned_blocks.discard(block_id)
                 self.prefetch_pinned_blocks.pop(block_id, None)
+                self.shm_leased_blocks.pop(block_id, None)
             if os.path.exists(meta.path):
                 os.remove(meta.path)
         finally:
@@ -472,6 +501,11 @@ class TieredBlockStore:
                 if expiry > now:
                     continue
                 del self.prefetch_pinned_blocks[bid]  # expired: reclaim
+            shm_expiry = self.shm_leased_blocks.get(bid)
+            if shm_expiry is not None:
+                if shm_expiry > now:
+                    continue
+                del self.shm_leased_blocks[bid]  # expired: reclaim
             lock = self._locks.try_lock_write(bid)
             if lock is None:
                 continue  # in use by a reader; skip (reference retries)

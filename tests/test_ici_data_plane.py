@@ -155,7 +155,9 @@ class TestMeshBlockCache:
         cache.load_global(fs, [f"/ici/b{i}" for i in range(N_FILES)])
         bm = cluster.master.block_master
         assert bm.device_block_map()
-        bm.device_report_ttl_ms = 0  # everything is instantly stale
+        # -1, not 0: staleness is strict (now - ts > ttl), so a report
+        # landed in the same millisecond as the prune survives ttl=0
+        bm.device_report_ttl_ms = -1  # everything is instantly stale
         assert bm.prune_device_reports() == ["doomed"]
         assert bm.device_block_map() == {}
         fs.close()

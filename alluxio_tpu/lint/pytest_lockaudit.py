@@ -144,7 +144,9 @@ def _install() -> None:
         # puts the whole stripe set into the cross-plane order graph:
         # InodeTree.lock -> InodeTree.inode_lock ->
         # LocalJournalSystem._lock -> BlockMaster._lock.
-        from alluxio_tpu.master.inode_tree import InodeLockManager
+        from alluxio_tpu.master.inode_tree import (
+            InodeLockManager, InodeTree,
+        )
 
         mgr_init = InodeLockManager.__init__
 
@@ -155,6 +157,22 @@ def _install() -> None:
                 lock, "InodeTree.inode_lock", _DELEGATE)
 
         InodeLockManager.__init__ = lock_mgr_init
+
+        # WRITE_EDGE locks are a second dynamically-pooled stripe set,
+        # keyed (parent_id, name).  They get their OWN audited name so
+        # the graph proves the canonical order inode locks -> edge
+        # locks (docs/metadata.md) — under one shared name an
+        # inode-then-edge acquisition would be invisible self-ordering.
+        tree_init = InodeTree.__init__
+
+        @functools.wraps(tree_init)
+        def inode_tree_init(self, *a, **kw):
+            tree_init(self, *a, **kw)
+            self.edge_lock_manager._proxy_factory = \
+                lambda lock: _LockProxy(
+                    lock, "InodeTree.edge_lock", _DELEGATE)
+
+        InodeTree.__init__ = inode_tree_init
         _installed = True
 
 

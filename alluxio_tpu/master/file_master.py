@@ -79,7 +79,8 @@ class FileSystemMaster:
                  permission_checker=None,
                  umask: int = 0o022,
                  ufs_path_cache_capacity: int = 10_000,
-                 coarse_locking: bool = False) -> None:
+                 coarse_locking: bool = False,
+                 edge_locking: bool = True) -> None:
         self._block_master = block_master
         self._journal = journal
         self._ufs = ufs_manager or UfsManager()
@@ -95,7 +96,8 @@ class FileSystemMaster:
         self._perm = permission_checker
         self._umask = umask
         self.inode_tree = InodeTree(inode_store,
-                                    coarse_locking=coarse_locking)
+                                    coarse_locking=coarse_locking,
+                                    edge_locking=edge_locking)
         self.mount_table = MountTable()
         from alluxio_tpu.master.invalidation import MetadataInvalidationLog
 
@@ -170,6 +172,9 @@ class FileSystemMaster:
 
     def stop(self) -> None:
         self._ufs.close()
+        # disk-backed metastores own background work (LSM compactor,
+        # sqlite connection) that must not outlive the master
+        self.inode_tree._store.close()
 
     # ------------------------------------------------------------ factories
     @property
@@ -385,6 +390,67 @@ class FileSystemMaster:
                 if columnar:
                     return cols
         return _transpose(out) if columnar else out
+
+    def list_status_page(self, path: "str | AlluxioURI", *,
+                         start_after: Optional[str] = None,
+                         limit: int = 500) -> dict:
+        """One PAGE of a directory listing: up to ``limit`` children in
+        name order strictly after ``start_after``, as wire dicts, plus
+        the resume cursor.  Each page takes (and drops) its own path
+        lock and streams straight off the store's ``iter_edges`` range
+        scan — a million-entry LSM directory is never materialized in
+        master memory, which is what the streamed-listing RPC rides for
+        big directories.  Pages compose a weakly-consistent listing
+        (entries created/deleted between pages may or may not appear —
+        same contract as the reference's partial ListStatus); each page
+        carries ``md_version`` so clients can detect drift."""
+        uri = AlluxioURI(path)
+        limit = max(1, limit)
+        with self.inode_tree.lock_path(uri) as lip:
+            lookup = lip.lookup
+            if not lookup.exists:
+                raise FileDoesNotExistError(f"path {uri} does not exist")
+            from alluxio_tpu.security.authorization import READ
+
+            self._check_access(lookup, READ)
+            inode = lookup.inode
+            if not inode.is_directory:
+                entry = [] if start_after else \
+                    [self._file_info_dict(inode, uri)]
+                return {"infos": entry, "next": None,
+                        "md_version": self.invalidations.version}
+            try:
+                dres = self.mount_table.resolve(uri)
+                d_ufs = dres.ufs_path.rstrip("/")
+                d_mount = dres.mount_id
+            except (NotFoundError, InvalidPathError):
+                d_ufs, d_mount = "", 0
+            d_path = uri.path if uri.path != "/" else ""
+            infos: List[dict] = []
+            last_name: Optional[str] = None
+            for child in self.inode_tree.children(inode,
+                                                  start_after=start_after):
+                child_path = f"{d_path}/{child.name}"
+                if self.mount_table.is_mount_path(child_path):
+                    infos.append(self._file_info_dict(
+                        child, uri.join(child.name)))
+                else:
+                    mount = (f"{d_ufs}/{child.name}" if d_ufs else "",
+                             d_mount)
+                    infos.append(self._file_info_dict(
+                        child, child_path, mount=mount))
+                last_name = child.name
+                if len(infos) >= limit:
+                    break
+            return {"infos": infos,
+                    "next": last_name if len(infos) >= limit else None,
+                    "md_version": self.invalidations.version}
+
+    def metastore_stats(self) -> dict:
+        """The inode store's own counters (kind, memtable/run/compaction
+        gauges, cache hit ratio) — fsadmin report, the status page and
+        the ``Master.Metastore*`` metrics all read this."""
+        return self.inode_tree._store.stats()
 
     def get_file_block_info_list(self, path: "str | AlluxioURI") -> List[FileBlockInfo]:
         uri = AlluxioURI(path)
@@ -719,8 +785,9 @@ class FileSystemMaster:
                 f"{uri} is a mount point; unmount it instead")
         victims: List[Inode] = []
         if inode.is_directory:
-            kids = self.inode_tree.child_names(inode)
-            if kids and not recursive:
+            # emptiness probe, not a materialized name list — a
+            # millions-wide directory answers from its first edge
+            if not recursive and self.inode_tree.has_children(inode):
                 raise DirectoryNotEmptyError(
                     f"{uri} is non-empty; need recursive")
             if self.mount_table.contains_mount_below(uri):
@@ -909,7 +976,7 @@ class FileSystemMaster:
             self._check_access(lookup, WRITE)
             targets: List[Inode] = []
             if inode.is_directory:
-                if not recursive and self.inode_tree.child_names(inode):
+                if not recursive and self.inode_tree.has_children(inode):
                     raise DirectoryNotEmptyError(
                         f"{uri} is non-empty; need recursive")
                 targets.extend(self.inode_tree.descendants(inode))

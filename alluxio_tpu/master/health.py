@@ -548,6 +548,41 @@ def quorum_degraded_rule(expected: int, *,
                     "the namespace down — see docs/ha.md")
 
 
+def metastore_compaction_debt_rule(max_runs: int = 24, *,
+                                   window_s: float = 60.0) -> HealthRule:
+    """Fires while the LSM metastore's sorted-run count stays above the
+    configured debt threshold (``Master.MetastoreRuns``, sampled on the
+    health tick).  Every point lookup probes each run's bloom filter and
+    every listing merges all runs, so an ever-growing run count means
+    compaction is losing the race with flushes — reads degrade first,
+    then disk fills with un-merged duplicates.  HEAP/SQLITE backends
+    report zero runs, keeping the rule inert there."""
+
+    def probe(ctx: HealthContext) -> List[Violation]:
+        runs = ctx.window_mean("Master.MetastoreRuns", "master", window_s)
+        if runs is None or runs <= float(max_runs):
+            return []
+        return [Violation(
+            "master-metastore", runs,
+            f"LSM metastore carries {runs:.0f} sorted runs (threshold "
+            f"{max_runs}) — compaction is not keeping up with flushes",
+            {"metric": "Master.MetastoreRuns", "window_s": window_s,
+             "threshold": max_runs})]
+
+    return HealthRule(
+        "metastore-compaction-debt", severity="warning",
+        window_s=window_s, threshold=float(max_runs), probe=probe,
+        needs_history=True,
+        description="the LSM metastore's sorted-run count is sustained "
+                    "above the compaction-debt threshold",
+        remediation="compaction is starved or wedged: check master CPU "
+                    "headroom and the metastore disk, lower "
+                    "atpu.master.metastore.lsm.memtable.bytes churn or "
+                    "raise atpu.master.metastore.compaction.debt.runs "
+                    "if the namespace genuinely grew; see "
+                    "`fsadmin report metastore` and docs/metadata.md")
+
+
 class _Tracked:
     __slots__ = ("alert", "clean_since", "clean_observed_s")
 

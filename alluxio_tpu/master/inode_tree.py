@@ -21,12 +21,22 @@ level**:
   write on the terminal/deepest-existing inode only), mirroring the
   reference's ``SimpleInodeLockList``.  Independent subtrees — the common
   case for per-host training shards — no longer serialize.
+- **WRITE_EDGE locking** (reference: ``InodeTree.LockPattern.WRITE_EDGE``):
+  with ``edge_locking`` on (the default), a create takes only a READ lock
+  on the deepest existing inode plus a WRITE lock on the *edge*
+  ``(parent_id, name)`` it is about to fill; deletes/renames write-lock
+  their terminal AND its parent edge.  Sibling creates/deletes under ONE
+  hot directory — the "many trainers materializing shards into one dir"
+  pattern — no longer serialize on the parent inode's write lock; only
+  same-NAME operations contend.  The parent read lock still excludes a
+  concurrent delete of the parent (which needs the parent's write lock).
 
 Acquisition order is canonical and audited (``lint/pytest_lockaudit``):
 ``InodeTree.lock`` (read) → ``InodeTree.inode_lock`` (root→leaf, write at
-the tail) → everything downstream (journal commit queue, BlockMaster).
-Multi-path operations (rename) acquire their two lock lists in
-lexicographic path order.
+the tail) → ``InodeTree.edge_lock`` (after ALL inode locks; pairs sort
+their ≤2 edges by ``(parent_id, name)``) → everything downstream (journal
+commit queue, BlockMaster).  Multi-path operations (rename) acquire their
+two lock lists as one merged plan in lexicographic path order.
 
 All mutations arrive as journal entries via ``process_entry`` — the tree is
 a ``Journaled`` component; the FileSystemMaster validates + emits entries,
@@ -85,12 +95,14 @@ def _lock_wait_timer():
 
 
 class InodeLockManager:
-    """Pool of per-inode RW locks, created on demand and swept when idle
+    """Pool of keyed RW locks, created on demand and swept when idle
     (reference: ``InodeLockManager.java:47`` — there a weak-value map).
+    Keys are inode ids for the inode pool and ``(parent_id, name)``
+    tuples for the edge pool — any hashable works.
 
     ``checkout``/``checkin`` refcount each lock so a sweep can never
     evict a lock some thread still holds: two paths locking the same
-    inode MUST share one RWLock object, and eviction-while-held would
+    key MUST share one RWLock object, and eviction-while-held would
     silently split them."""
 
     #: idle locks are swept once the pool outgrows this (a pool entry
@@ -98,35 +110,35 @@ class InodeLockManager:
     MAX_IDLE_POOL = 65536
 
     def __init__(self) -> None:
-        self._locks: Dict[int, list] = {}  # inode id -> [lock, refcount]
+        self._locks: Dict[object, list] = {}  # key -> [lock, refcount]
         self._pool_lock = threading.Lock()
         #: test-harness hook (lint/pytest_lockaudit): wraps every fresh
-        #: per-inode RWLock in an audited proxy named
-        #: ``InodeTree.inode_lock``
+        #: RWLock in an audited proxy (``InodeTree.inode_lock`` /
+        #: ``InodeTree.edge_lock``)
         self._proxy_factory = None
 
-    def checkout(self, inode_id: int):
+    def checkout(self, key):
         with self._pool_lock:
-            ent = self._locks.get(inode_id)
+            ent = self._locks.get(key)
             if ent is None:
                 lock = RWLock()
                 if self._proxy_factory is not None:
                     lock = self._proxy_factory(lock)
-                ent = self._locks[inode_id] = [lock, 0]
+                ent = self._locks[key] = [lock, 0]
             ent[1] += 1
             return ent[0]
 
-    def checkin(self, inode_id: int) -> None:
+    def checkin(self, key) -> None:
         with self._pool_lock:
-            ent = self._locks.get(inode_id)
+            ent = self._locks.get(key)
             if ent is None:
                 return
             ent[1] -= 1
             if ent[1] <= 0 and len(self._locks) > self.MAX_IDLE_POOL:
                 # amortized sweep of ALL idle entries (refcount 0 means
                 # no thread can be inside acquire/release on it)
-                for iid in [i for i, e in self._locks.items() if e[1] <= 0]:
-                    del self._locks[iid]
+                for k in [k for k, e in self._locks.items() if e[1] <= 0]:
+                    del self._locks[k]
 
     def pool_size(self) -> int:
         with self._pool_lock:
@@ -167,9 +179,11 @@ class LockedInodePath:
         comps = self.uri.path_components()
         try:
             while True:
-                chain, modes, full = _plan(tree, comps, self.write,
-                                           self._write_parent)
+                chain, modes, full, edge = _plan(tree, comps, self.write,
+                                                 self._write_parent)
                 _acquire_planned(tree, zip(chain, modes), self._held)
+                if edge is not None:
+                    _acquire_edges(tree, [edge], self._held)
                 if _validate_chain(tree, chain, comps, full):
                     self.lookup = PathLookup(uri=self.uri, inodes=chain)
                     return self
@@ -186,9 +200,12 @@ class LockedInodePath:
 
 
 def _plan(tree: "InodeTree", comps, write: bool, write_parent: bool):
-    """Walk (unlocked) and plan lock modes root→leaf: read on
-    ancestors, write on the terminal — or the deepest EXISTING inode
-    when the terminal is missing (create)."""
+    """Walk (unlocked) and plan lock modes root→leaf plus, under edge
+    locking, the write-mode edge ``(parent_id, name)`` the operation
+    mutates.  Read on ancestors; the terminal inode is write-locked when
+    it exists (its fields mutate), while a CREATE write-locks only the
+    missing edge and READ-locks the deepest existing inode — sibling
+    creates under one directory stop excluding each other."""
     root = tree.root
     if root is None:
         raise InvalidPathError("inode tree not initialized")
@@ -206,11 +223,27 @@ def _plan(tree: "InodeTree", comps, write: bool, write_parent: bool):
         cur = child
     full = len(chain) == len(comps) + 1
     modes = ["r"] * len(chain)
+    edge: Optional[Tuple[int, str]] = None
     if write:
-        modes[-1] = "w"
-        if write_parent and full and len(chain) >= 2:
-            modes[-2] = "w"
-    return chain, modes, full
+        if tree.edge_locking:
+            if full:
+                # existing terminal: write the inode (field mutations)
+                # AND its parent edge (delete/rename unlink it)
+                modes[-1] = "w"
+                if len(chain) >= 2:
+                    edge = (chain[-2].id, comps[len(chain) - 2])
+                if write_parent and len(chain) >= 2:
+                    modes[-2] = "w"
+            elif len(comps) > 0:
+                # create: the deepest existing inode stays read-held
+                # (keeping it alive — deleting it needs its write lock);
+                # the FIRST MISSING edge is the thing being filled in
+                edge = (chain[-1].id, comps[len(chain) - 1])
+        else:
+            modes[-1] = "w"
+            if write_parent and full and len(chain) >= 2:
+                modes[-2] = "w"
+    return chain, modes, full, edge
 
 
 def _acquire_planned(tree: "InodeTree", planned, held: List[Tuple]) -> None:
@@ -223,16 +256,30 @@ def _acquire_planned(tree: "InodeTree", planned, held: List[Tuple]) -> None:
             lock.acquire_write()
         else:
             lock.acquire_read()
-        held.append((inode.id, mode, lock))
+        held.append(("inode", inode.id, mode, lock))
+
+
+def _acquire_edges(tree: "InodeTree", edges, held: List[Tuple]) -> None:
+    """Write-acquire edge locks AFTER every inode lock (the canonical
+    order); multi-edge callers pass them sorted by ``(parent_id,
+    name)`` — the total order that keeps two renames from deadlocking."""
+    mgr = tree.edge_lock_manager
+    for edge in edges:
+        lock = mgr.checkout(edge)
+        lock.acquire_write()
+        held.append(("edge", edge, "w", lock))
 
 
 def _release_held(tree: "InodeTree", held: List[Tuple]) -> None:
-    for inode_id, mode, lock in reversed(held):
+    for kind, key, mode, lock in reversed(held):
         if mode == "w":
             lock.release_write()
         else:
             lock.release_read()
-        tree.lock_manager.checkin(inode_id)
+        if kind == "edge":
+            tree.edge_lock_manager.checkin(key)
+        else:
+            tree.lock_manager.checkin(key)
     held.clear()
 
 
@@ -280,8 +327,10 @@ class LockedInodePathPair:
         a_comps, b_comps = a_uri.path_components(), b_uri.path_components()
         try:
             while True:
-                a_chain, a_modes, a_full = _plan(tree, a_comps, True, False)
-                b_chain, b_modes, b_full = _plan(tree, b_comps, True, False)
+                a_chain, a_modes, a_full, a_edge = _plan(
+                    tree, a_comps, True, False)
+                b_chain, b_modes, b_full, b_edge = _plan(
+                    tree, b_comps, True, False)
                 # merged plan: strongest mode per inode; shared inodes are
                 # exactly the chains' common prefix (root-down paths)
                 want: Dict[int, str] = {}
@@ -296,6 +345,12 @@ class LockedInodePathPair:
                             want[inode.id] = "w"
                 _acquire_planned(tree, ((i, want[i.id]) for i in order),
                                  self._held)
+                # both edges AFTER the merged inode plan, in the global
+                # (parent_id, name) total order — concurrent pairs can
+                # never hold one edge while waiting on the other crosswise
+                edges = sorted({e for e in (a_edge, b_edge)
+                                if e is not None})
+                _acquire_edges(tree, edges, self._held)
                 if _validate_chain(tree, a_chain, a_comps, a_full) and \
                         _validate_chain(tree, b_chain, b_comps, b_full):
                     lookups = {
@@ -358,14 +413,21 @@ class InodeTree(Journaled):
     journal_name = "InodeTree"
 
     def __init__(self, store: Optional[InodeStore] = None, *,
-                 coarse_locking: bool = False) -> None:
+                 coarse_locking: bool = False,
+                 edge_locking: bool = True) -> None:
         self._store = store if store is not None else HeapInodeStore()
         self.lock = RWLock()
         self.lock_manager = InodeLockManager()
+        #: WRITE_EDGE lock pool, keyed ``(parent_id, name)`` — acquired
+        #: strictly AFTER every inode lock (audited order)
+        self.edge_lock_manager = InodeLockManager()
         #: True: ``lock_path`` degrades to the tree-level lock (the
         #: pre-striping single-lock master) — bench baseline + escape
         #: hatch; striped is the default
         self.coarse_locking = coarse_locking
+        #: False: creates fall back to write-locking the deepest existing
+        #: inode (the pre-WRITE_EDGE scheme) — bench baseline
+        self.edge_locking = edge_locking
         #: guards the id registries below (pinned/to-be-persisted/lost/
         #: replication-limited sets + inode_count + change_version):
         #: journal applies mutate them while snapshot readers copy them,
@@ -519,13 +581,19 @@ class InodeTree(Journaled):
             return None
         return self.get_path(inode)
 
-    def children(self, inode: Inode) -> Iterator[Inode]:
-        for name in self._store.child_names(inode.id):
-            cid = self._store.get_child_id(inode.id, name)
-            if cid is not None:
-                child = self._store.get(cid)
-                if child is not None:
-                    yield child
+    def children(self, inode: Inode,
+                 start_after: Optional[str] = None) -> Iterator[Inode]:
+        """Stream children in name order via the store's iterator
+        contract — one range scan on LSM (one lookup per child instead
+        of the old three), resumable at ``start_after`` for paged
+        listings."""
+        for _name, cid in self._store.iter_edges(inode.id, start_after):
+            child = self._store.get(cid)
+            if child is not None:
+                yield child
+
+    def has_children(self, inode: Inode) -> bool:
+        return self._store.has_children(inode.id)
 
     def descendants(self, inode: Inode) -> Iterator[Inode]:
         """Post-order descendants (children before parents) for deletes."""
@@ -768,15 +836,22 @@ class InodeTree(Journaled):
 
     # ---------------------------------------------------------- checkpoint
     def snapshot(self) -> dict:
-        inode_dicts = []
-        for iid in self._store.all_ids():
-            inode = self._store.get(iid)
-            if inode is not None:
-                inode_dicts.append(inode.to_wire_dict())
-        snap = {
-            "root_id": self._root_id,
-            "inodes": inode_dicts,
-        }
+        # a store with a native checkpoint (LSM: sealed runs + empty WAL)
+        # snapshots itself — no inode-by-inode materialization; HEAP /
+        # SQLITE keep the original inode-list format byte-for-byte
+        store_state = self._store.checkpoint_state()
+        if store_state is not None:
+            snap = {"root_id": self._root_id, "store_state": store_state}
+        else:
+            inode_dicts = []
+            for iid in self._store.all_ids():
+                inode = self._store.get(iid)
+                if inode is not None:
+                    inode_dicts.append(inode.to_wire_dict())
+            snap = {
+                "root_id": self._root_id,
+                "inodes": inode_dicts,
+            }
         if self.invalidation_log is not None:
             # restoring from this checkpoint skips the applied entries
             # it covers, so the version they advanced must ride along —
@@ -798,23 +873,63 @@ class InodeTree(Journaled):
             self._inode_count = 0
             self.change_version += 1
         self._root_id = snap.get("root_id")
+        if "store_state" in snap:
+            # native restore: adopt the run set wholesale, then rebuild
+            # the derived side state (ttl buckets, id registries, count)
+            # with ONE streaming pass — same bootstrap a replay would
+            # produce, minus re-journaling every inode
+            try:
+                self._store.restore_state(snap["store_state"])
+            except NotImplementedError:
+                self._restore_cross_kind(snap["store_state"])
+                return
+            for inode in self._store.iter_inodes():
+                self._index_restored(inode)
+            return
         for d in snap.get("inodes", []):
             inode = Inode.from_wire_dict(d)
             self._store.put(inode)
             if inode.parent_id != ROOT_ID_PARENT:
                 self._store.add_child(inode.parent_id, inode.name, inode.id)
-            if inode.ttl >= 0:
-                self.ttl_buckets.insert(inode.id, inode.creation_time_ms,
-                                        inode.ttl)
-            with self.registry_lock:
-                self._inode_count += 1
-                if inode.pinned:
-                    self.pinned_ids.add(inode.id)
-                if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
-                    self.to_be_persisted_ids.add(inode.id)
-                if inode.persistence_state == PersistenceState.LOST:
-                    self.lost_file_ids.add(inode.id)
-                self._track_replication(inode)
+            self._index_restored(inode)
+
+    def _restore_cross_kind(self, store_state: dict) -> None:
+        """An LSM-native checkpoint arriving at a master whose own store
+        has no native format (HEAP/SQLITE standby behind an LSM primary):
+        hydrate through a throwaway LSM reader instead of failing the
+        bootstrap."""
+        import shutil
+        import tempfile
+
+        from alluxio_tpu.master.metastore.lsm import LsmInodeStore
+
+        tmp = tempfile.mkdtemp(prefix="atpu_lsm_restore_")
+        try:
+            reader = LsmInodeStore(tmp, compaction=False)
+            reader.restore_state(store_state)
+            for inode in reader.iter_inodes():
+                self._store.put(inode)
+                if inode.parent_id != ROOT_ID_PARENT:
+                    self._store.add_child(inode.parent_id, inode.name,
+                                          inode.id)
+                self._index_restored(inode)
+            reader.close()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _index_restored(self, inode: Inode) -> None:
+        if inode.ttl >= 0:
+            self.ttl_buckets.insert(inode.id, inode.creation_time_ms,
+                                    inode.ttl)
+        with self.registry_lock:
+            self._inode_count += 1
+            if inode.pinned:
+                self.pinned_ids.add(inode.id)
+            if inode.persistence_state == PersistenceState.TO_BE_PERSISTED:
+                self.to_be_persisted_ids.add(inode.id)
+            if inode.persistence_state == PersistenceState.LOST:
+                self.lost_file_ids.add(inode.id)
+            self._track_replication(inode)
 
     def _empty_snapshot(self) -> dict:
         return {"root_id": None, "inodes": []}

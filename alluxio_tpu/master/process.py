@@ -84,12 +84,22 @@ class MasterProcess:
 
         # pluggable metastore backend (reference: HEAP/ROCKS/caching):
         # HEAP serves from dicts; SQLITE spills metadata > RAM to disk;
-        # CACHING fronts SQLITE with a bounded write-back LRU
+        # LSM is the capacity backend (WAL + memtable + sorted runs,
+        # caching-wrapped hot set); CACHING fronts SQLITE with a bounded
+        # write-back LRU
         inode_store = create_inode_store(
             str(conf.get(Keys.MASTER_METASTORE)),
             conf.get(Keys.MASTER_METASTORE_DIR),
             cache_size=conf.get_int(
-                Keys.MASTER_METASTORE_INODE_CACHE_MAX_SIZE))
+                Keys.MASTER_METASTORE_INODE_CACHE_MAX_SIZE),
+            lsm_options={
+                "memtable_bytes": conf.get_bytes(
+                    Keys.MASTER_METASTORE_LSM_MEMTABLE_BYTES),
+                "max_runs_per_tier": conf.get_int(
+                    Keys.MASTER_METASTORE_LSM_COMPACTION_TRIGGER),
+                "wal_sync": conf.get_bool(
+                    Keys.MASTER_METASTORE_LSM_WAL_SYNC),
+            })
         self.fs_master = FileSystemMaster(
             self.block_master, self.journal, clock=self._clock,
             inode_store=inode_store,
@@ -183,8 +193,21 @@ class MasterProcess:
         #: master list).  A plain single master must not grow a masters/
         #: dir it rewrites every second for nobody.
         self._ha_member = self._ha_expected > 1
+        #: last metastore_stats() pull (refreshed on the health tick) —
+        #: gauges must not take the store lock on every scrape
+        self._metastore_sample: dict = {}
+        reg = metrics()
+        reg.register_gauge("Master.MetastoreInodes", lambda: float(
+            self._metastore_sample.get("inodes", 0) or 0))
+        reg.register_gauge("Master.MetastoreMemtableBytes", lambda: float(
+            self._metastore_sample.get("memtable_bytes", 0) or 0))
+        reg.register_gauge("Master.MetastoreRuns", lambda: float(
+            self._metastore_sample.get("runs", 0) or 0))
+        reg.register_gauge("Master.MetastoreCompactionBytes", lambda: float(
+            self._metastore_sample.get("compaction_bytes", 0) or 0))
+        reg.register_gauge("Master.MetastoreCacheHitRatio", lambda: float(
+            self._metastore_sample.get("cache_hit_ratio", 0.0) or 0.0))
         if self._ha_expected > 1:
-            reg = metrics()
             reg.register_gauge("Master.HaQuorumExpected",
                                lambda: float(self._ha_expected))
             reg.register_gauge("Master.HaQuorumLive",
@@ -221,6 +244,26 @@ class MasterProcess:
                 .percentile(0.99),
             "Master.MetadataCacheInvalidations": float(
                 reg.counter("Master.MetadataCacheInvalidations").count),
+        })
+        # metastore shape: inode population, LSM memtable/run debt and
+        # hot-set hit ratio — what the metastore-compaction-debt rule
+        # watches.  HEAP/SQLITE backends report zeros for the LSM-only
+        # series, which keeps the rule inert on those backends.
+        try:
+            self._metastore_sample = dict(
+                self.fs_master.metastore_stats())
+        except Exception:
+            LOG.debug("metastore stats sample failed", exc_info=True)
+        stats = self._metastore_sample
+        history.ingest("master", {
+            "Master.MetastoreInodes": float(stats.get("inodes", 0) or 0),
+            "Master.MetastoreMemtableBytes":
+                float(stats.get("memtable_bytes", 0) or 0),
+            "Master.MetastoreRuns": float(stats.get("runs", 0) or 0),
+            "Master.MetastoreCompactionBytes":
+                float(stats.get("compaction_bytes", 0) or 0),
+            "Master.MetastoreCacheHitRatio":
+                float(stats.get("cache_hit_ratio", 0.0) or 0.0),
         })
 
     def in_safe_mode(self) -> bool:
@@ -314,6 +357,12 @@ class MasterProcess:
         ``leader_address`` so a deposed-but-not-demoted master never
         advertises PRIMARY."""
         if not self._ha_member:
+            return
+        # never publish an unreachable row: before a port is bound the
+        # address falls back to conf MASTER_RPC_PORT, which tests (and
+        # ephemeral-port deployments) set to 0 — a ":0" row would sit in
+        # the file-per-address registry forever, poisoning quorum views
+        if self.client_address.endswith(":0"):
             return
         role = "PRIMARY" if self.rpc_port and self.journal.is_primary() \
             else "STANDBY"
@@ -513,7 +562,8 @@ class MasterProcess:
             remediation_engine=self.remediation,
             admission=self.admission,
             invalidation_log=self.fs_master.invalidations,
-            masters_fn=self.masters_report))
+            masters_fn=self.masters_report,
+            metastore_stats_fn=self.fs_master.metastore_stats))
         self.rpc_port = self.rpc_server.start()
         # announce primacy to the quorum view the moment the port is
         # bound, then keep the row fresh on its own heartbeat
@@ -633,6 +683,15 @@ class MasterProcess:
                 # a lost standby costs nothing TODAY — which is exactly
                 # why it must alert: the next failure is the outage
                 rules.append(quorum_degraded_rule(self._ha_expected))
+            from alluxio_tpu.master.health import (
+                metastore_compaction_debt_rule,
+            )
+
+            # inert on HEAP/SQLITE (they report zero runs); on LSM it
+            # catches compaction losing the race with flushes before
+            # read amplification turns into an outage
+            rules.append(metastore_compaction_debt_rule(
+                conf.get_int(Keys.MASTER_METASTORE_COMPACTION_DEBT_RUNS)))
             if history is None:
                 # don't advertise rules that silently no-op without
                 # the history store: the report must only list rules
@@ -1045,8 +1104,12 @@ class FaultTolerantMasterProcess(MasterProcess):
                 self.serving = True
             return port
         self.journal.standby_start()
-        self._tailer.start()
+        # standby endpoint FIRST: the tailer's on_tick publishes this
+        # master's registry row, and publishing before the read port is
+        # bound advertises the configured (possibly ephemeral :0) port —
+        # a stale row the file-per-address registry then keeps forever
         self._start_standby_serving()
+        self._tailer.start()
         self._promote_thread = threading.Thread(
             target=self._wait_and_promote, name="primacy-waiter",
             daemon=True)

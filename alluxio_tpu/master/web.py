@@ -43,6 +43,7 @@ def _dashboard_html() -> bytes:
         "alluxio-tpu master", "/api/v1/master",
         sections=[("Cluster", "info"), ("Masters", "masters"),
                   ("Workers", "workers"),
+                  ("Metastore", "metastore"),
                   ("Mounts", "mounts"), ("Catalog", "catalog"),
                   ("Cluster health", "health"),
                   ("Self-healing", "remediation"),
@@ -50,6 +51,7 @@ def _dashboard_html() -> bytes:
         raw_routes=["/api/v1/master/info", "/masters", "/capacity",
                     "/metrics",
                     "/metrics/history", "/health", "/remediation",
+                    "/metastore",
                     "/mounts", "/catalog", "/trace", "/browse",
                     "/config", "/logs"],
         js_body="""
@@ -76,6 +78,24 @@ def _dashboard_html() -> bytes:
       row(w, [x.host, x.state,
               gb(Object.values(x.capacity).reduce((a,b)=>a+b,0)),
               gb(Object.values(x.used).reduce((a,b)=>a+b,0))]);
+    // inode metastore: backend kind, population, LSM write/read debt
+    const meta = (await j('/metastore')).stats;
+    const met2 = document.getElementById('metastore');
+    row(met2, ['kind', String(meta.kind ?? '?')]);
+    row(met2, ['inodes', String(meta.inodes ?? 0)]);
+    if (meta.cache_hit_ratio != null)
+      row(met2, ['cache hit ratio',
+                 (100 * meta.cache_hit_ratio).toFixed(1) + '% (' +
+                 (meta.cache_entries ?? 0) + ' entries)']);
+    if (meta.memtable_bytes != null) {
+      row(met2, ['memtable', gb(meta.memtable_bytes) + ' (' +
+                 (meta.memtable_entries ?? 0) + ' entries)']);
+      row(met2, ['sorted runs', (meta.runs ?? 0) + ' (' +
+                 gb(meta.run_bytes ?? 0) + ')']);
+      row(met2, ['flushes / compactions', (meta.flushes ?? 0) + ' / ' +
+                 (meta.compactions ?? 0) + ' (' +
+                 gb(meta.compaction_bytes ?? 0) + ' rewritten)']);
+    }
     const m = await j('/mounts');
     const mt = document.getElementById('mounts');
     row(mt, ['path','ufs','read-only'], true);
@@ -327,6 +347,9 @@ class MasterWebServer:
                     return engine.report()
                 if route == "/api/v1/master/masters":
                     return mp.masters_report()
+                if route == "/api/v1/master/metastore":
+                    return {"stats": dict(
+                        mp.fs_master.metastore_stats())}
                 if route == "/api/v1/master/mounts":
                     return {"mounts": [
                         {"path": m.alluxio_path, "ufs": m.ufs_uri,

@@ -529,6 +529,12 @@ class MetaMasterClient(_BaseClient):
     def get_master_info(self) -> dict:
         return self._call("get_master_info", {})
 
+    def get_metastore_info(self) -> dict:
+        """Metastore backend shape for ``fsadmin report metastore``:
+        {"stats": {kind, inodes, and on LSM memtable/run/compaction
+        counters + cache hit ratio}}."""
+        return self._call("get_metastore_info", {})
+
     def get_metrics(self) -> Dict[str, float]:
         return self._call("get_metrics", {})["metrics"]
 

@@ -140,9 +140,29 @@ def fs_master_service(fsm: FileSystemMaster,
         the unary columnar path); recursive listings fall back to row
         dicts. Timed + audited like the unary RPCs: the listing
         resolves (and is audited) before the first chunk goes out;
-        batching itself is transport work."""
-        res = _audited_resolve(r)
+        batching itself is transport work.
+
+        ``paged=True`` (non-recursive only) switches to cursor paging:
+        every batch is its own ``list_status_page`` call — own short
+        lock scope, straight off the store's range scan — so a
+        million-entry LSM directory streams without the master ever
+        materializing it (weakly consistent across pages, stamped with
+        ``md_version`` per page)."""
         batch = max(1, int(r.get("batch_size", 500)))
+        if r.get("paged") and not r.get("recursive"):
+            cursor = r.get("start_after")
+            offset = 0
+            while True:
+                page = fsm.list_status_page(r["path"], start_after=cursor,
+                                            limit=batch)
+                yield {"infos": page["infos"], "offset": offset,
+                       "md_version": page["md_version"],
+                       "next": page["next"]}
+                if page["next"] is None:
+                    return
+                offset += len(page["infos"])
+                cursor = page["next"]
+        res = _audited_resolve(r)
         if isinstance(res, dict):  # columnar {"n": N, "cols": {...}}
             cols, n = res["cols"], res.get("n", 0)
             keys = list(cols)
@@ -296,6 +316,7 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
                         admission=None,
                         invalidation_log=None,
                         masters_fn=None,
+                        metastore_stats_fn=None,
                         role_fn=lambda: "PRIMARY") -> ServiceDefinition:
     """Config distribution + cluster info + admin ops
     (reference: ``meta_master.proto:143-211`` — cluster-default config,
@@ -321,6 +342,12 @@ def meta_master_service(conf: Configuration, *, cluster_id: str = "",
     svc.unary("get_master_info", lambda r: {
         "cluster_id": cluster_id, "start_time_ms": start_time_ms,
         "safe_mode": bool(safe_mode_fn()), "role": str(role_fn())})
+    # metastore backend shape (`fsadmin report metastore`, statuspage):
+    # backend kind, inode population, and — on LSM — memtable/run/
+    # compaction debt plus the hot-set cache hit ratio
+    svc.unary("get_metastore_info", lambda r: {
+        "stats": dict(metastore_stats_fn())
+        if metastore_stats_fn is not None else {}})
 
     def _get_masters(r):
         """Quorum view behind ``fsadmin report masters`` (docs/ha.md):

@@ -29,7 +29,8 @@ class ReportCommand(Command):
         p.add_argument("category", nargs="?", default="summary",
                        choices=["summary", "capacity", "ufs", "metrics",
                                 "jobservice", "stall", "readpath",
-                                "history", "health", "qos", "masters"])
+                                "history", "health", "qos", "masters",
+                                "metastore"])
         p.add_argument("metric", nargs="?", default="",
                        help="history: metric name (omit to list "
                             "recorded names)")
@@ -252,6 +253,38 @@ class ReportCommand(Command):
                       "enable atpu.worker.qos.enabled / "
                       "atpu.user.qos.stripe.limit to activate "
                       "data-plane QoS")
+        return 0
+
+    def _metastore(self, ctx):
+        """Inode metastore posture (docs/metadata.md): backend kind and
+        population for every backend; on LSM additionally the write
+        path (memtable fill, WAL) and the read-amplification drivers
+        (sorted runs, compaction debt) the metastore-compaction-debt
+        health rule watches, plus the caching wrapper's hit ratio."""
+        stats = ctx.meta_client().get_metastore_info().get("stats", {})
+        if not stats:
+            ctx.print("No metastore stats reported by this master")
+            return 1
+        ctx.print(f"Inode metastore: {stats.get('kind', '?')}")
+        ctx.print(f"    Inodes: {int(stats.get('inodes', 0)):,}")
+        if "cache_hit_ratio" in stats:
+            ctx.print(f"    Hot-set cache: {int(stats.get('cache_entries', 0)):,} "
+                      f"entries, hit ratio "
+                      f"{float(stats.get('cache_hit_ratio', 0.0)):.2%} "
+                      f"({int(stats.get('cache_hits', 0)):,} hits / "
+                      f"{int(stats.get('cache_misses', 0)):,} misses)")
+        if "memtable_bytes" in stats:
+            ctx.print(f"    Memtable: {human_size(int(stats.get('memtable_bytes', 0)))} "
+                      f"({int(stats.get('memtable_entries', 0)):,} entries), "
+                      f"WAL {human_size(int(stats.get('wal_bytes', 0)))}")
+            ctx.print(f"    Sorted runs: {int(stats.get('runs', 0))} "
+                      f"({human_size(int(stats.get('run_bytes', 0)))} on disk)")
+            ctx.print(f"    Flushes: {int(stats.get('flushes', 0))}  "
+                      f"Compactions: {int(stats.get('compactions', 0))} "
+                      f"({human_size(int(stats.get('compaction_bytes', 0)))} "
+                      f"rewritten)")
+        if "edges" in stats:
+            ctx.print(f"    Edges: {int(stats.get('edges', 0)):,}")
         return 0
 
     def _history(self, ctx, args):

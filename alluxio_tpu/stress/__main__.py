@@ -226,7 +226,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "the single-lock master (modeled slow "
                              "fsync), and warm client-metadata-cache "
                              "GetStatus vs uncached RPCs")
-    md.add_argument("--row", choices=("striped", "journal", "cached"),
+    md.add_argument("--row", choices=("striped", "journal", "cached",
+                                      "hot-dir", "lsm-capacity"),
                     default="striped")
     md.add_argument("--threads", type=int, default=None,
                     help="driver threads (default 8; cached row 4)")
@@ -244,6 +245,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "1.5x, cached 10x)")
     md.add_argument("--master", default=None,
                     help="cached row only: attach to a live cluster")
+    md.add_argument("--inodes", type=int, default=10_000_000,
+                    help="lsm-capacity row: namespace size to build "
+                         "under the cap")
+    md.add_argument("--cap-mb", type=int, default=2048,
+                    help="lsm-capacity row: RLIMIT_AS cap per backend "
+                         "subprocess (HEAP must blow it, LSM must fit)")
 
     ha = sub.add_parser("ha", help="HA failover drill: kill the primary "
                                    "under live load; gates MTTR <= 2 "
@@ -312,6 +319,12 @@ SUITE = (
     ("metadata-striped", ["metadata", "--row", "striped"]),
     ("metadata-cached-getstatus", ["metadata", "--row", "cached"]),
     ("metadata-journal-batch", ["metadata", "--row", "journal"]),
+    ("metadata-hot-dir", ["metadata", "--row", "hot-dir"]),
+    # scaled down for the suite's per-bench timeout; `make
+    # bench-metadata` runs the full 10M-inode row
+    ("metadata-lsm-capacity", ["metadata", "--row", "lsm-capacity",
+                               "--inodes", "1000000",
+                               "--cap-mb", "1024"]),
     ("ha-failover", ["ha"]),
 )
 
@@ -561,6 +574,12 @@ def main(argv=None) -> int:
             kw["min_speedup"] = args.min_speedup
         if args.row == "cached":
             r = run(row="cached", master=args.master, **kw)
+        elif args.row == "lsm-capacity":
+            kw.pop("threads", None)
+            kw.pop("duration_s", None)
+            kw.pop("min_speedup", None)
+            r = run(row="lsm-capacity", inodes=args.inodes,
+                    cap_mb=args.cap_mb, **kw)
         else:
             r = run(row=args.row, fsync_ms=args.fsync_ms,
                     batch_time_ms=args.batch_time_ms, **kw)

@@ -1,6 +1,6 @@
 """bench-metadata: metadata control-plane scale-out gates.
 
-Three suite rows, each a ratio against the pre-PR configuration:
+Five suite rows, the ratios against the pre-PR configuration:
 
 - ``metadata-striped`` — mixed CreateFile/GetStatus/ListStatus/Delete
   across disjoint per-thread subtrees, striped inode locking + journal
@@ -11,6 +11,15 @@ Three suite rows, each a ratio against the pre-PR configuration:
 - ``metadata-cached-getstatus`` — warm client-metadata-cache GetStatus
   vs the uncached RPC round trip on a live in-process cluster.
   Gate: >= 10x.
+- ``metadata-hot-dir`` — CreateFile with EVERY thread targeting ONE
+  shared directory (the hot-directory worst case striping cannot
+  help): WRITE_EDGE locking vs write-locking the shared parent inode,
+  both sides striped + group commit.  Gate: >= 2x ops/s.
+- ``metadata-lsm-capacity`` — builds, walks and random-stats a large
+  namespace in a subprocess running under an enforced address-space
+  cap (``resource.setrlimit``): the HEAP backend must BLOW the cap
+  and the LSM backend must complete under it with every lookup
+  served.  Gate: LSM ok AND HEAP out-of-memory.
 
 The journal rides a **modeled slow fsync** (``--fsync-ms``, default
 3ms — local-disk/NFS class): on tmpfs-backed CI an fsync is nearly
@@ -56,7 +65,8 @@ class _Master:
     inline fsync) or post-PR (striped + group commit) flavor."""
 
     def __init__(self, base: str, *, coarse: bool, batched: bool,
-                 fsync_s: float, batch_time_s: float) -> None:
+                 fsync_s: float, batch_time_s: float,
+                 edge_locking: bool = True) -> None:
         from alluxio_tpu.master.block_master import BlockMaster
         from alluxio_tpu.master.file_master import FileSystemMaster
 
@@ -67,7 +77,8 @@ class _Master:
             self.journal.start_group_commit(batch_time_s)
         self.block_master = BlockMaster(self.journal)
         self.fsm = FileSystemMaster(self.block_master, self.journal,
-                                    coarse_locking=coarse)
+                                    coarse_locking=coarse,
+                                    edge_locking=edge_locking)
         self.fsm.start(None)
 
     def close(self) -> None:
@@ -112,10 +123,12 @@ def _create_body(fsm, threads: int):
 
 
 def _run_mode(make_body, *, coarse: bool, batched: bool, threads: int,
-              duration_s: float, fsync_s: float, batch_time_s: float):
+              duration_s: float, fsync_s: float, batch_time_s: float,
+              edge_locking: bool = True):
     base = tempfile.mkdtemp(prefix="atpu_mdbench_")
     master = _Master(base, coarse=coarse, batched=batched,
-                     fsync_s=fsync_s, batch_time_s=batch_time_s)
+                     fsync_s=fsync_s, batch_time_s=batch_time_s,
+                     edge_locking=edge_locking)
     try:
         body = make_body(master.fsm, threads)
         res = drive(threads, body, duration_s=duration_s)
@@ -247,6 +260,229 @@ def run_cached_getstatus(*, master: Optional[str] = None, threads: int = 4,
         duration_s=time.monotonic() - t_start)
 
 
+def _hot_dir_body(fsm, threads: int):
+    """Every thread creates in ONE shared directory — disjoint names,
+    shared parent.  Striping is useless here (all paths hash to the
+    parent's stripe); only WRITE_EDGE locking lets the siblings'
+    journal-fsync waits overlap."""
+    fsm.create_directory("/hot", recursive=True, allow_exists=True)
+    counters = [itertools.count() for _ in range(threads)]
+
+    def body(t: int, i: int) -> int:
+        fsm.create_file(f"/hot/t{t}-{next(counters[t]):09d}")
+        return 0
+
+    return body
+
+
+def run_hot_dir(*, threads: int = 8, duration_s: float = 2.0,
+                fsync_ms: float = 3.0, batch_time_ms: float = 2.0,
+                min_speedup: float = 2.0) -> BenchResult:
+    """WRITE_EDGE vs parent-inode write locking under a single hot
+    directory.  BOTH sides run striped + group commit — the ratio
+    isolates the edge-locking change, not the striping PR."""
+    t_start = time.monotonic()
+    fsync_s, batch_s = fsync_ms / 1e3, batch_time_ms / 1e3
+    base_res, base_fsyncs = _run_mode(
+        _hot_dir_body, coarse=False, batched=True, threads=threads,
+        duration_s=duration_s, fsync_s=fsync_s, batch_time_s=batch_s,
+        edge_locking=False)
+    new_res, new_fsyncs = _run_mode(
+        _hot_dir_body, coarse=False, batched=True, threads=threads,
+        duration_s=duration_s, fsync_s=fsync_s, batch_time_s=batch_s,
+        edge_locking=True)
+    speedup = new_res.ops_per_s / base_res.ops_per_s \
+        if base_res.ops_per_s > 0 else 0.0
+    ok = speedup >= min_speedup and base_res.errors == 0 and \
+        new_res.errors == 0
+    if not ok:
+        print(f"[metadata-hot-dir] speedup {speedup:.2f}x below the "
+              f"{min_speedup}x gate (parent-inode-lock "
+              f"{base_res.ops_per_s:.0f} ops/s, edge-lock "
+              f"{new_res.ops_per_s:.0f} ops/s, errors "
+              f"{base_res.errors}+{new_res.errors})", file=sys.stderr)
+    return BenchResult(
+        bench="metadata-hot-dir",
+        params={"threads": threads, "duration_s": duration_s,
+                "fsync_ms": fsync_ms, "batch_time_ms": batch_time_ms,
+                "min_speedup": min_speedup},
+        metrics={"inode_lock_ops_per_s": round(base_res.ops_per_s, 1),
+                 "edge_lock_ops_per_s": round(new_res.ops_per_s, 1),
+                 "speedup": round(speedup, 3),
+                 "inode_lock_fsyncs": base_fsyncs,
+                 "edge_lock_fsyncs": new_fsyncs,
+                 "inode_lock_p99_us":
+                     percentiles(base_res.latencies_s)["p99_us"],
+                 "edge_lock_p99_us":
+                     percentiles(new_res.latencies_s)["p99_us"],
+                 "gate_ok": ok},
+        errors=0 if ok else 1,
+        duration_s=time.monotonic() - t_start)
+
+
+def _capacity_child() -> None:
+    """Subprocess body for ``metadata-lsm-capacity``: build a
+    ``fanout``-wide directory namespace straight into one metastore
+    backend under an enforced ``RLIMIT_AS`` cap, then walk every edge
+    and random-stat a sample.  argv (after ``-c``): kind dir inodes
+    cap_bytes fanout sample seed.  Prints one JSON line; blowing the
+    cap is an expected outcome and reported as ``oom`` (or, when even
+    the handler cannot allocate, as a nonzero exit the parent treats
+    the same way)."""
+    import gc
+    import json
+    import random
+    import resource
+
+    kind, directory = sys.argv[1], sys.argv[2]
+    total, cap = int(sys.argv[3]), int(sys.argv[4])
+    fanout, sample, seed = (int(sys.argv[5]), int(sys.argv[6]),
+                            int(sys.argv[7]))
+    resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
+    from alluxio_tpu.master.inode import Inode
+    from alluxio_tpu.master.metastore import create_inode_store
+
+    out = {"kind": kind, "ok": False, "oom": False, "built": 0}
+    store = None
+    built, next_id = 0, 1
+    try:
+        store = create_inode_store(kind, directory)
+        t0 = time.monotonic()
+        dir_ids = []
+        while built < total:
+            did = next_id
+            next_id += 1
+            dname = f"d{len(dir_ids):07d}"
+            store.put(Inode(id=did, parent_id=0, name=dname,
+                            is_directory=True))
+            store.add_child(0, dname, did)
+            dir_ids.append(did)
+            built += 1
+            for f in range(fanout):
+                if built >= total:
+                    break
+                fid = next_id
+                next_id += 1
+                fname = f"f{f:05d}"
+                store.put(Inode(id=fid, parent_id=did, name=fname,
+                                length=4096, completed=True))
+                store.add_child(did, fname, fid)
+                built += 1
+        out["built"] = built
+        out["build_s"] = round(time.monotonic() - t0, 3)
+
+        t0 = time.monotonic()
+        edges = 0
+        for parent in [0] + dir_ids:
+            for _name, _cid in store.iter_edges(parent):
+                edges += 1
+        out["edges"] = edges
+        out["walk_s"] = round(time.monotonic() - t0, 3)
+
+        rng = random.Random(seed)
+        t0 = time.monotonic()
+        missing = 0
+        for _ in range(sample):
+            if store.get(rng.randrange(1, next_id)) is None:
+                missing += 1
+        out["missing"] = missing
+        out["stat_s"] = round(time.monotonic() - t0, 3)
+        out["store"] = {k: v for k, v in store.stats().items()
+                        if isinstance(v, (int, float, str))}
+        out["ok"] = edges == built and missing == 0
+    except MemoryError:
+        # free the namespace FIRST: json/print below must be able to
+        # allocate inside the same rlimit that just fired
+        store = None
+        gc.collect()
+        out["oom"] = True
+        out["built"] = built
+    out["maxrss_kb"] = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    print(json.dumps(out), flush=True)
+
+
+def run_lsm_capacity(*, inodes: int = 10_000_000, cap_mb: int = 2048,
+                     fanout: int = 1000, sample: int = 20_000,
+                     seed: int = 7,
+                     timeout_s: float = 5400.0) -> BenchResult:
+    """The memory-cap gate behind the LSM metastore: the SAME build +
+    full-walk + random-stat workload runs once per backend in a fresh
+    subprocess capped with ``RLIMIT_AS``.  HEAP must run out of memory
+    (proving the cap is real at this namespace size); LSM must finish
+    under it with every edge walked and every sampled stat served."""
+    import json
+    import subprocess
+
+    t_start = time.monotonic()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    child = ("import sys; "
+             "from alluxio_tpu.stress.metadata_bench import "
+             "_capacity_child; _capacity_child()")
+    results = {}
+    for kind in ("HEAP", "LSM"):
+        base = tempfile.mkdtemp(prefix="atpu_mdcap_")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", child, kind, base, str(inodes),
+                 str(cap_mb << 20), str(fanout), str(sample), str(seed)],
+                capture_output=True, text=True, timeout=timeout_s,
+                env=env)
+            lines = (proc.stdout or "").strip().splitlines()
+            try:
+                results[kind] = json.loads(lines[-1]) if lines else {}
+            except json.JSONDecodeError:
+                results[kind] = {}
+            # a crash before the JSON line (MemoryError inside the
+            # handler, rlimit-killed allocator) still means "blew the
+            # cap" — record it as such rather than losing the signal
+            if proc.returncode != 0 and not results[kind].get("ok"):
+                results[kind].setdefault("oom", True)
+                results[kind]["exit"] = proc.returncode
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    heap, lsm = results["HEAP"], results["LSM"]
+    ok = bool(lsm.get("ok")) and bool(heap.get("oom")) and \
+        not heap.get("ok")
+    if not ok:
+        print(f"[metadata-lsm-capacity] gate failed: LSM ok="
+              f"{lsm.get('ok')} (built {lsm.get('built')}, edges "
+              f"{lsm.get('edges')}, missing {lsm.get('missing')}), "
+              f"HEAP oom={heap.get('oom')} ok={heap.get('ok')} under "
+              f"{cap_mb} MB", file=sys.stderr)
+    metrics = {
+        "inodes": inodes, "cap_mb": cap_mb,
+        "lsm_ok": bool(lsm.get("ok")),
+        "heap_oom": bool(heap.get("oom")),
+        "heap_built_before_oom": int(heap.get("built", 0) or 0),
+        "lsm_build_s": float(lsm.get("build_s", 0.0) or 0.0),
+        "lsm_walk_s": float(lsm.get("walk_s", 0.0) or 0.0),
+        "lsm_stat_s": float(lsm.get("stat_s", 0.0) or 0.0),
+        "lsm_maxrss_mb": round(
+            float(lsm.get("maxrss_kb", 0) or 0) / 1024, 1),
+        "heap_maxrss_mb": round(
+            float(heap.get("maxrss_kb", 0) or 0) / 1024, 1),
+        "gate_ok": ok,
+    }
+    if lsm.get("build_s"):
+        metrics["lsm_build_ops_per_s"] = round(
+            int(lsm.get("built", 0)) / float(lsm["build_s"]), 1)
+    if lsm.get("stat_s") and sample:
+        metrics["lsm_stat_ops_per_s"] = round(
+            sample / float(lsm["stat_s"]), 1)
+    for k in ("runs", "run_bytes", "flushes", "compactions",
+              "compaction_bytes", "cache_hit_ratio"):
+        if k in (lsm.get("store") or {}):
+            metrics[f"lsm_{k}"] = lsm["store"][k]
+    return BenchResult(
+        bench="metadata-lsm-capacity",
+        params={"inodes": inodes, "cap_mb": cap_mb, "fanout": fanout,
+                "sample": sample, "seed": seed},
+        metrics=metrics,
+        errors=0 if ok else 1,
+        duration_s=time.monotonic() - t_start)
+
+
 def run(*, row: str = "striped", **kw) -> BenchResult:
     if row == "striped":
         return run_striped(**kw)
@@ -254,4 +490,8 @@ def run(*, row: str = "striped", **kw) -> BenchResult:
         return run_journal_batch(**kw)
     if row == "cached":
         return run_cached_getstatus(**kw)
+    if row == "hot-dir":
+        return run_hot_dir(**kw)
+    if row == "lsm-capacity":
+        return run_lsm_capacity(**kw)
     raise ValueError(f"unknown metadata bench row {row!r}")

@@ -462,7 +462,7 @@ class TestPushInvalidationE2E:
 
 @pytest.mark.slow
 class TestMetastoreWiring:
-    @pytest.mark.parametrize("kind", ["SQLITE", "CACHING"])
+    @pytest.mark.parametrize("kind", ["SQLITE", "CACHING", "LSM"])
     def test_non_heap_metastore_serves_namespace(self, tmp_path, kind):
         from alluxio_tpu.master.metastore import (
             CachingInodeStore, SqliteInodeStore, create_inode_store,

@@ -1,0 +1,396 @@
+"""LSM inode metastore + WRITE_EDGE locking tests (docs/metadata.md).
+
+The equivalence suite drives IDENTICAL seeded op sequences through the
+HEAP, SQLITE and LSM backends and asserts byte-identical tree walks and
+invalidation-version counts — the backends are interchangeable or they
+are broken.  The recovery suite kills the LSM store at random WAL byte
+positions and requires the reopened store to land on exactly some
+prefix of the applied ops (torn tails drop, intact records replay).
+Concurrency tests run under the always-on LockOrderAuditor plugin, so
+the canonical order inode locks -> edge locks is machine-checked here.
+"""
+
+import os
+import random
+import shutil
+import threading
+
+import pytest
+
+from alluxio_tpu.journal import LocalJournalSystem, NoopJournalSystem
+from alluxio_tpu.master import BlockMaster, FileSystemMaster
+from alluxio_tpu.master.inode import Inode
+from alluxio_tpu.master.metastore import (
+    CachingInodeStore, HeapInodeStore, LsmInodeStore, SqliteInodeStore,
+    create_inode_store,
+)
+from alluxio_tpu.utils.exceptions import (
+    FileAlreadyExistsError, FileDoesNotExistError, InvalidArgumentError,
+    InvalidPathError,
+)
+
+BLOCK_SIZE = 1024
+
+
+def _make_fsm(store=None, journal=None, **kw):
+    journal = journal or NoopJournalSystem()
+    bm = BlockMaster(journal)
+    m = FileSystemMaster(bm, journal, inode_store=store,
+                         default_block_size=BLOCK_SIZE, **kw)
+    m.start(None)
+    return m
+
+
+def _walk(fsm, path="/"):
+    """Deterministic full-tree walk: sorted (path, is_dir, length)."""
+    out = []
+    stack = [path]
+    while stack:
+        p = stack.pop()
+        for info in sorted(fsm.list_status(p), key=lambda i: i.path):
+            out.append((info.path, info.folder, info.length))
+            if info.folder:
+                stack.append(info.path)
+    return out
+
+
+def _apply_seeded_ops(fsm, seed: int, n_ops: int):
+    """One deterministic op stream: create/mkdir/delete/rename over a
+    small path alphabet — collisions and misses included on purpose
+    (every backend must fail identically too)."""
+    rng = random.Random(seed)
+    dirs = [f"/d{i}" for i in range(4)]
+    outcomes = []
+    for _ in range(n_ops):
+        op = rng.randrange(5)
+        d = rng.choice(dirs)
+        name = f"x{rng.randrange(12)}"
+        try:
+            if op == 0:
+                fsm.create_file(f"{d}/{name}", recursive=True)
+                outcomes.append(("create", d, name, "ok"))
+            elif op == 1:
+                fsm.create_directory(f"{d}/sub{rng.randrange(3)}",
+                                     recursive=True, allow_exists=True)
+                outcomes.append(("mkdir", d, name, "ok"))
+            elif op == 2:
+                fsm.delete(f"{d}/{name}")
+                outcomes.append(("delete", d, name, "ok"))
+            elif op == 3:
+                fsm.rename(f"{d}/{name}",
+                           f"{rng.choice(dirs)}/y{rng.randrange(12)}")
+                outcomes.append(("rename", d, name, "ok"))
+            else:
+                fsm.get_status(f"{d}/{name}")
+                outcomes.append(("stat", d, name, "ok"))
+        except (FileAlreadyExistsError, FileDoesNotExistError,
+                InvalidPathError) as e:
+            outcomes.append(("err", d, name, type(e).__name__))
+    return outcomes
+
+
+# --------------------------------------------------------------------------
+class TestBackendEquivalence:
+    """Identical seeded ops -> identical namespaces, across backends."""
+
+    @pytest.mark.parametrize("seed", [7, 41])
+    def test_seeded_ops_equivalent(self, tmp_path, seed):
+        stores = {
+            "HEAP": HeapInodeStore(),
+            "SQLITE": SqliteInodeStore(str(tmp_path / "sq")),
+            "LSM": create_inode_store("LSM", str(tmp_path / "lsm"),
+                                      cache_size=16,
+                                      lsm_options={"memtable_bytes": 4096}),
+        }
+        walks, versions, outcomes = {}, {}, {}
+        for kind, store in stores.items():
+            fsm = _make_fsm(store)
+            try:
+                outcomes[kind] = _apply_seeded_ops(fsm, seed, 200)
+                walks[kind] = _walk(fsm)
+                versions[kind] = fsm.invalidations.version
+            finally:
+                fsm.stop()
+        assert outcomes["HEAP"] == outcomes["SQLITE"] == outcomes["LSM"]
+        assert walks["HEAP"] == walks["SQLITE"] == walks["LSM"]
+        assert versions["HEAP"] == versions["SQLITE"] == versions["LSM"]
+
+    def test_lsm_journal_replay_restart(self, tmp_path):
+        """Kill the master, replay the journal into a FRESH LSM store:
+        the namespace must come back identical."""
+        def boot(journal_dir, store_dir):
+            journal = LocalJournalSystem(str(journal_dir))
+            journal.start()
+            store = create_inode_store("LSM", str(store_dir),
+                                       cache_size=16)
+            bm = BlockMaster(journal)
+            # registration precedes gain_primacy: replay of the
+            # existing log hydrates the FRESH store
+            fsm = FileSystemMaster(bm, journal, inode_store=store,
+                                   default_block_size=BLOCK_SIZE)
+            journal.gain_primacy()
+            fsm.start(None)
+            return journal, fsm
+
+        journal, fsm = boot(tmp_path / "j", tmp_path / "lsm1")
+        _apply_seeded_ops(fsm, 13, 120)
+        before = _walk(fsm)
+        fsm.stop()
+        journal.stop()
+
+        journal2, fsm2 = boot(tmp_path / "j", tmp_path / "lsm2")
+        try:
+            assert _walk(fsm2) == before
+        finally:
+            fsm2.stop()
+            journal2.stop()
+
+
+# --------------------------------------------------------------------------
+class TestLsmRecovery:
+    def _build(self, base, n=60):
+        """n sequenced single-record ops, memtable never flushed: the
+        WAL alone carries the state.  Returns per-prefix id->name
+        snapshots."""
+        store = LsmInodeStore(str(base), memtable_bytes=1 << 30,
+                              compaction=False)
+        states = [dict()]
+        cur = {}
+        rng = random.Random(5)
+        for i in range(n):
+            iid = rng.randrange(1, 16)
+            if iid in cur and rng.random() < 0.3:
+                store.remove(iid)
+                cur.pop(iid)
+            else:
+                store.put(Inode(id=iid, parent_id=0, name=f"n{i}"))
+                cur[iid] = f"n{i}"
+            states.append(dict(cur))
+        store._wal.flush()
+        wal_path = store._wal.path
+        # abandon WITHOUT close(): close would seal the memtable into
+        # a run and truncate the WAL — the crash we simulate never gets
+        # that courtesy
+        store._wal.close()
+        for r in store._runs:
+            r.close()
+        return states, wal_path
+
+    def test_wal_truncation_recovers_a_prefix(self, tmp_path):
+        base = tmp_path / "lsm"
+        states, wal_path = self._build(base)
+        size = os.path.getsize(wal_path)
+        assert size > 0
+        rng = random.Random(99)
+        cuts = [0, size] + [rng.randrange(1, size) for _ in range(6)]
+        for i, cut in enumerate(cuts):
+            crashed = tmp_path / f"crash{i}"
+            shutil.copytree(base, crashed)
+            with open(crashed / os.path.basename(wal_path), "r+b") as f:
+                f.truncate(cut)
+            store = LsmInodeStore(str(crashed), compaction=False)
+            try:
+                recovered = {ino.id: ino.name
+                             for ino in store.iter_inodes()}
+                # prefix-consistency: a torn tail may drop trailing
+                # records, but what replays is EXACTLY the first k ops
+                assert recovered in states, \
+                    f"cut at {cut}/{size} recovered a state that " \
+                    f"matches no op-prefix"
+            finally:
+                store.close()
+
+    def test_clean_restart_is_lossless(self, tmp_path):
+        states, _ = self._build(tmp_path / "lsm", n=40)
+        store = LsmInodeStore(str(tmp_path / "lsm"), compaction=False)
+        try:
+            assert {i.id: i.name for i in store.iter_inodes()} \
+                == states[-1]
+            assert store.stats()["inodes"] == len(states[-1])
+        finally:
+            store.close()
+
+    def test_flush_and_compaction_preserve_state(self, tmp_path):
+        store = LsmInodeStore(str(tmp_path / "lsm"),
+                              memtable_bytes=2048, compaction=False)
+        try:
+            expect = {}
+            for i in range(1, 300):
+                store.put(Inode(id=i, parent_id=0, name=f"f{i:04d}"))
+                expect[i] = f"f{i:04d}"
+                if i % 7 == 0:
+                    store.remove(i)
+                    expect.pop(i)
+            assert store.stats()["runs"] > 1
+            store.compact_now()
+            assert {i.id: i.name for i in store.iter_inodes()} == expect
+            assert store.stats()["inodes"] == len(expect)
+        finally:
+            store.close()
+
+
+# --------------------------------------------------------------------------
+class TestSnapshots:
+    def test_heap_snapshot_format_unchanged(self):
+        """atpu.master.metastore=HEAP must stay byte-identical to the
+        pre-LSM master: the checkpoint payload keeps the legacy
+        {"root_id", "inodes"} shape (rolling upgrades replay old
+        checkpoints and old masters must read new ones)."""
+        fsm = _make_fsm()
+        try:
+            fsm.create_file("/snap/f", recursive=True)
+            snap = fsm.inode_tree.snapshot()
+            assert set(snap.keys()) == {"root_id", "inodes",
+                                        "invalidation_version"}
+            assert isinstance(snap["inodes"], list)
+        finally:
+            fsm.stop()
+
+    def test_lsm_snapshot_restores_into_lsm(self, tmp_path):
+        store = create_inode_store("LSM", str(tmp_path / "a"),
+                                   cache_size=16,
+                                   lsm_options={"memtable_bytes": 4096})
+        fsm = _make_fsm(store)
+        _apply_seeded_ops(fsm, 3, 80)
+        before = _walk(fsm)
+        snap = fsm.inode_tree.snapshot()
+        assert snap.get("store_state", {}).get("format") == "lsm-runs"
+        fsm.stop()
+
+        store2 = create_inode_store("LSM", str(tmp_path / "b"),
+                                    cache_size=16)
+        fsm2 = _make_fsm(store2)
+        try:
+            fsm2.inode_tree.restore(snap)
+            assert _walk(fsm2) == before
+        finally:
+            fsm2.stop()
+
+    def test_lsm_snapshot_restores_cross_kind(self, tmp_path):
+        """An LSM checkpoint must hydrate a HEAP-backed tree (operator
+        rolls the backend conf back; the journal checkpoint can't be
+        held hostage by the backend that wrote it)."""
+        store = create_inode_store("LSM", str(tmp_path / "a"),
+                                   cache_size=16)
+        fsm = _make_fsm(store)
+        _apply_seeded_ops(fsm, 23, 60)
+        before = _walk(fsm)
+        snap = fsm.inode_tree.snapshot()
+        fsm.stop()
+
+        fsm2 = _make_fsm(HeapInodeStore())
+        try:
+            fsm2.inode_tree.restore(snap)
+            assert _walk(fsm2) == before
+        finally:
+            fsm2.stop()
+
+
+# --------------------------------------------------------------------------
+class TestWriteEdgeLocking:
+    def test_concurrent_sibling_creates_one_hot_dir(self):
+        fsm = _make_fsm()
+        try:
+            fsm.create_directory("/hot")
+            errs = []
+
+            def worker(t):
+                try:
+                    for i in range(20):
+                        fsm.create_file(f"/hot/t{t}-{i}")
+                except Exception as e:  # noqa: BLE001 surfaced below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert not errs
+            assert len(fsm.list_status("/hot")) == 80
+            # the always-on auditor must have seen the canonical order
+            # inode locks -> edge locks, and never the inversion
+            from alluxio_tpu.lint.pytest_lockaudit import _DELEGATE
+            aud = _DELEGATE.current
+            if aud is not None:
+                assert ("InodeTree.inode_lock",
+                        "InodeTree.edge_lock") in aud.edges
+                assert ("InodeTree.edge_lock",
+                        "InodeTree.inode_lock") not in aud.edges
+        finally:
+            fsm.stop()
+
+    def test_duplicate_create_excluded_by_edge_lock(self):
+        fsm = _make_fsm()
+        try:
+            fsm.create_directory("/dup")
+            results = []
+            barrier = threading.Barrier(2)
+
+            def racer():
+                barrier.wait()
+                try:
+                    fsm.create_file("/dup/same")
+                    results.append("ok")
+                except FileAlreadyExistsError:
+                    results.append("exists")
+
+            threads = [threading.Thread(target=racer) for _ in range(2)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert sorted(results) == ["exists", "ok"]
+            assert len(fsm.list_status("/dup")) == 1
+        finally:
+            fsm.stop()
+
+    def test_edge_locking_off_still_correct(self):
+        fsm = _make_fsm(edge_locking=False)
+        try:
+            assert not fsm.inode_tree.edge_locking
+            fsm.create_file("/a/b/f", recursive=True)
+            fsm.rename("/a/b/f", "/a/b/g")
+            fsm.delete("/a/b/g")
+            assert fsm.list_status("/a/b") == []
+        finally:
+            fsm.stop()
+
+
+# --------------------------------------------------------------------------
+class TestFactoryAndPaging:
+    def test_unknown_kind_is_typed_error(self, tmp_path):
+        with pytest.raises(InvalidArgumentError):
+            create_inode_store("ROCKSDB", str(tmp_path))
+
+    def test_caching_composes_over_lsm(self, tmp_path):
+        store = create_inode_store("CACHING:LSM", str(tmp_path),
+                                   cache_size=4)
+        try:
+            assert isinstance(store, CachingInodeStore)
+            assert isinstance(store.backing, LsmInodeStore)
+            assert store.stats()["kind"] == "CACHING:LSM"
+        finally:
+            store.close()
+
+    def test_list_status_page_cursor_walk(self, tmp_path):
+        store = create_inode_store("LSM", str(tmp_path), cache_size=8)
+        fsm = _make_fsm(store)
+        try:
+            for i in range(25):
+                fsm.create_file(f"/big/f{i:03d}", recursive=True)
+            seen, cursor, pages = [], None, 0
+            while True:
+                page = fsm.list_status_page("/big", start_after=cursor,
+                                            limit=10)
+                assert page["md_version"] >= 0
+                seen.extend(info["name"] for info in page["infos"])
+                pages += 1
+                if page["next"] is None:
+                    break
+                cursor = page["next"]
+            assert pages == 3
+            assert seen == sorted(f"f{i:03d}" for i in range(25))
+        finally:
+            fsm.stop()

@@ -15,12 +15,14 @@ lint-docs:  ## regenerate docs/configuration.md + docs/metrics.md from the regis
 	$(PY) -m alluxio_tpu.lint --write-docs
 
 test: lint
+	@$(PY) -c "import alluxio_tpu.native as n; n.lib() is None and print('native layer unavailable (no g++?): running pure-Python fallback paths only')"
 	$(PY) -m pytest tests/ -q
 
 test-fast:  ## skip multi-process (subprocess-spawning) tests
+	@$(PY) -c "import alluxio_tpu.native as n; n.lib() is None and print('native layer unavailable (no g++?): running pure-Python fallback paths only')"
 	$(PY) -m pytest tests/ -q -m "not slow"
 
-native:  ## force-rebuild the C++ layer
+native:  ## force-rebuild the C++ layer (-Wall -Werror)
 	rm -f alluxio_tpu/native/_libatpu_native.so
 	$(PY) -c "import alluxio_tpu.native as n; assert n.lib() is not None"
 
@@ -36,9 +38,10 @@ bench-obs:  ## observability gates: tracing + profiler overhead (<2% budget), cr
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs --row profile
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress obs --row critical-path --file-mb 2 --reads 80
 
-bench-smallread:  ## small-read plane: read_many coalescing (>=3x per-op ops/s), SHM zero-copy fidelity (buffer identity, no wire phase)
+bench-smallread:  ## small-read plane: read_many coalescing (>=3x per-op ops/s), SHM zero-copy fidelity (buffer identity, no wire phase), native fastpath batched scatter (>=5x pure-Python, byte-identical)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row batch
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row shm
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row native --min-speedup 5.0
 
 bench-health:  ## metrics-history ingestion: heartbeat hot-path overhead (<5% gate, fake clock)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress health

@@ -46,7 +46,8 @@ class BlockStoreClient:
                  shm_enabled: bool = True,
                  shm_cache_max: int = 64,
                  shm_renew_fraction: float = 0.5,
-                 batch_read: Optional[BatchReadConf] = None) -> None:
+                 batch_read: Optional[BatchReadConf] = None,
+                 native_fastpath: bool = True) -> None:
         """``streaming_chunk_size``: per-message chunk of the gRPC read
         streams (``atpu.user.streaming.reader.chunk.size.bytes``);
         ``streaming_writer_chunk_size``: per-message chunk of the write
@@ -58,7 +59,10 @@ class BlockStoreClient:
         zero-copy SHM plane — disabled, step 1 of the ladder is the
         byte-identical short-circuit path; ``batch_read``
         (``atpu.user.batch.read.*``): scatter/gather coalescing for
-        ``pread_many`` on remote streams."""
+        ``pread_many`` on remote streams; ``native_fastpath``
+        (``atpu.user.native.fastpath.enabled``): execute assembled
+        read plans in C++ with the GIL released — the SHM batch flag
+        lives here, the batch/striped flags ride their confs."""
         self._bm = block_master
         self._identity = identity or TieredIdentity.from_spec(
             None, hostname=socket.gethostname())
@@ -83,7 +87,8 @@ class BlockStoreClient:
         self.shm: Optional[ShmTransport] = ShmTransport(
             self.session_id, cache_max=shm_cache_max,
             renew_fraction=shm_renew_fraction,
-            host=socket.gethostname()) if shm_enabled else None
+            host=socket.gethostname(),
+            native_fastpath=native_fastpath) if shm_enabled else None
         #: scatter/gather coalescing conf shared by every remote stream
         self.batch_read = batch_read if batch_read is not None \
             else BatchReadConf()

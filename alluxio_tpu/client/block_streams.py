@@ -69,6 +69,10 @@ class BatchReadConf(NamedTuple):
     enabled: bool = True
     max_op_bytes: int = 64 << 10
     max_ops: int = 256
+    #: scatter read_many responses through the native plan executor
+    #: (``atpu.user.native.fastpath.enabled``); pure-Python fallback is
+    #: byte-identical
+    native_fastpath: bool = True
 
     @classmethod
     def from_conf(cls, conf) -> "BatchReadConf":
@@ -77,7 +81,9 @@ class BatchReadConf(NamedTuple):
         return cls(
             enabled=conf.get_bool(Keys.USER_BATCH_READ_ENABLED),
             max_op_bytes=conf.get_bytes(Keys.USER_BATCH_READ_MAX_OP_BYTES),
-            max_ops=max(1, conf.get_int(Keys.USER_BATCH_READ_MAX_OPS)))
+            max_ops=max(1, conf.get_int(Keys.USER_BATCH_READ_MAX_OPS)),
+            native_fastpath=conf.get_bool(
+                Keys.USER_NATIVE_FASTPATH_ENABLED))
 
 
 def is_local_worker(address: WorkerNetAddress, local_hostname: str) -> bool:
@@ -335,8 +341,7 @@ class GrpcBlockInStream(BlockInStream):
 
         m = _metrics()
         sp = current_span()
-        out: List[bytes] = []
-        total = 0
+        resps: List[dict] = []
         for i in range(0, len(offsets), max_ops):
             offs = list(offsets[i:i + max_ops])
             szs = [max(0, min(s, self.length - off))
@@ -345,18 +350,73 @@ class GrpcBlockInStream(BlockInStream):
             resp = self._worker.read_many(self.block_id, offs, szs)
             if sp is not None:
                 sp.phase("wire", (_time.perf_counter() - t0) * 1000.0)
+            resps.append(resp)
+            self.last_source = resp.get("source") or "REMOTE"
+            m.counter("Client.BatchReadBatches").inc()
+            m.counter("Client.BatchReadOps").inc(len(offs))
+        out = self._scatter_responses(resps)
+        total = sum(len(b) for b in out)
+        m.counter("Client.BatchReadBytes").inc(total)
+        _record_read(self.source_bucket(), total)
+        return out
+
+    def _scatter_responses(self, resps: List[dict]) -> List[bytes]:
+        """Cut the collected ``read_many`` payloads into per-op bytes.
+        With the fastpath on, all responses scatter into ONE dest
+        buffer through a single GIL-free native call; the pure-Python
+        slice loop below is the byte-identical fallback."""
+        nops = sum(len(r["lengths"]) for r in resps)
+        if self._batch is not None and self._batch.native_fastpath \
+                and nops > 1:
+            from alluxio_tpu.client import fastpath
+
+            if fastpath.available():
+                try:
+                    return self._native_scatter(resps, nops)
+                except fastpath.NativeExecError:
+                    pass  # Client.NativeFallbacks already counted
+            else:
+                fastpath.note_unavailable()
+        out: List[bytes] = []
+        for resp in resps:
             buf = memoryview(resp["data"])
             pos = 0
             for n in resp["lengths"]:
                 out.append(bytes(buf[pos:pos + n]))
                 pos += n
-                total += n
-            self.last_source = resp.get("source") or "REMOTE"
-            m.counter("Client.BatchReadBatches").inc()
-            m.counter("Client.BatchReadOps").inc(len(offs))
-        m.counter("Client.BatchReadBytes").inc(total)
-        _record_read(self.source_bucket(), total)
         return out
+
+    def _native_scatter(self, resps: List[dict], nops: int) -> List[bytes]:
+        from alluxio_tpu import native
+        from alluxio_tpu.client import fastpath
+
+        lens = np.fromiter((n for r in resps for n in r["lengths"]),
+                           dtype=np.int64, count=nops)
+        bounds = np.zeros(nops + 1, dtype=np.int64)
+        np.cumsum(lens, out=bounds[1:])
+        ops = fastpath.op_table(nops)
+        ops["len"] = lens  # kind zero-init == OP_COPY
+        ops["dst_off"] = bounds[:-1]
+        keep = []
+        row = 0
+        for resp in resps:
+            k = len(resp["lengths"])
+            loc = native._buffer_address(resp["data"])
+            if loc is None:
+                raise fastpath.NativeExecError("no payload address")
+            addr, n, ka = loc
+            keep.append(ka)
+            ops["src"][row:row + k] = addr
+            ops["src_len"][row:row + k] = n
+            # offsets within this response = global dest offsets
+            # rebased to the response's first op
+            ops["src_off"][row:row + k] = \
+                bounds[row:row + k] - bounds[row]
+            row += k
+        dest = bytearray(int(bounds[-1]))
+        fastpath.execute_table(ops, dest, host="batch")
+        del keep
+        return fastpath.slice_out(dest, bounds.tolist())
 
     def read_all_view(self) -> memoryview:
         """The whole block as a buffer view: striped reads hand back

@@ -1,0 +1,235 @@
+"""Native fastpath: execute an assembled small-read plan outside the GIL.
+
+``choose_route`` (``client/remote_read.py``) stays the planner; this
+module is the bridge to the engine (``native/plan_exec.cpp``). A caller
+packs its batch — SHM segment copies, ``read_many`` response scatter,
+stripe commits — into ONE numpy op table (48-byte records mirroring
+``struct AtpuPlanOp``), and :func:`execute_table` hands the whole table
+across the ctypes boundary in a single call: ctypes drops the GIL for
+the foreign call, so the entire batch runs at memcpy/pread speed with
+zero per-op Python frames and exactly one GIL release/acquire.
+
+Fallback contract (the route-ladder rule: the fastpath can only make
+reads faster, never fail them): any native problem — library missing,
+bounds rejection, I/O error, injected fault — surfaces as
+:exc:`NativeExecError` after incrementing ``Client.NativeFallbacks``,
+and the caller re-runs the same batch through its pure-Python path,
+which is byte-identical by construction. Partial writes from a failed
+native batch land in a buffer the caller then overwrites or discards.
+
+Observability: ``Client.NativeBatches`` / ``Client.NativeBatchOps`` /
+``Client.NativeBatchBytes`` count executed work, ``native_exec`` span
+phase time feeds the read-path microscope, and the
+``Client.NativeFallbacks`` rate (surfaced by ``fsadmin report
+metrics``) makes a missing toolchain in prod loud, not silent.
+Deterministic chaos rides ``atpu.debug.fault.native.exec.error.rate``:
+a taken fault poisons ONE op mid-table, so the drill exercises a real
+partial-write batch, not a clean pre-flight refusal.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from alluxio_tpu import native
+
+OP_COPY = native.OP_COPY
+OP_PREAD = native.OP_PREAD
+
+#: direct stripe-chunk commits below this ride the plain memoryview
+#: copy: a one-op table costs a few microseconds to build, which only
+#: pays for itself once the GIL-free memcpy is big enough to matter
+MIN_COPY_BYTES = 64 << 10
+
+#: an op kind plan_exec.cpp does not know — the mid-table poison the
+#: fault injector plants to drill genuine partial-write fallbacks
+_POISON_KIND = 0xDEAD
+
+
+class NativeExecError(Exception):
+    """A native batch did not complete; the caller falls back to the
+    byte-identical pure-Python path."""
+
+
+def available() -> bool:
+    """True when the compiled library is loadable (toolchain present
+    and the build is current)."""
+    return native.lib() is not None
+
+
+def op_table(nops: int):
+    """A zeroed op table ready for vectorized column fills."""
+    import numpy as np
+
+    return np.zeros(nops, dtype=native.op_dtype())
+
+
+def _metrics():
+    from alluxio_tpu.metrics import metrics
+
+    return metrics()
+
+
+def _maybe_poison(ops, host: str):
+    """Fault hook: when ``atpu.debug.fault.native.exec.error.rate``
+    takes this batch, poison one op in the MIDDLE of a copy of the
+    table — the native executor writes everything before it, then
+    rejects, so the fallback drill covers a genuinely partial buffer."""
+    from alluxio_tpu.utils import faults
+
+    if not faults.armed() or \
+            not faults.injector().take_native_exec_error(host):
+        return ops
+    ops = ops.copy()
+    ops["kind"][len(ops) // 2] = _POISON_KIND
+    return ops
+
+
+def execute_table(ops, dest, *, host: str = "") -> int:
+    """Run a packed op table against ``dest`` in one GIL-free native
+    call. Returns the bytes written; raises :exc:`NativeExecError`
+    (after counting ``Client.NativeFallbacks``) when the library is
+    unavailable or any op fails — the caller's Python path takes over.
+    ``dest`` may hold partial results after a failure; the fallback
+    overwrites every planned byte."""
+    nops = len(ops)
+    if nops == 0:
+        return 0
+    m = _metrics()
+    ops = _maybe_poison(ops, host)
+    t0 = time.perf_counter()
+    rc = native.exec_plan(ops, dest)
+    from alluxio_tpu.utils.tracing import current_span
+
+    sp = current_span()
+    if sp is not None:
+        sp.phase("native_exec", (time.perf_counter() - t0) * 1000.0)
+    if rc is None or rc < 0:
+        m.counter("Client.NativeFallbacks").inc()
+        raise NativeExecError(
+            f"native plan exec failed (rc={rc}, ops={nops})")
+    m.counter("Client.NativeBatches").inc()
+    m.counter("Client.NativeBatchOps").inc(nops)
+    m.counter("Client.NativeBatchBytes").inc(rc)
+    return rc
+
+
+def note_unavailable() -> None:
+    """The conf asked for the fastpath but the library is missing:
+    count a fallback so the condition shows up as a nonzero
+    ``Client.NativeFallbacks`` rate in ``fsadmin report metrics``."""
+    _metrics().counter("Client.NativeFallbacks").inc()
+
+
+def slice_out(dest, bounds: Sequence[int]) -> List[bytes]:
+    """Cut ``dest`` into per-op ``bytes`` at ``bounds`` (len N+1,
+    monotone) — the List[bytes] surface ``pread_many`` promises."""
+    mv = memoryview(dest)
+    return [bytes(mv[a:b]) for a, b in zip(bounds, bounds[1:])]
+
+
+def copy_into(dest, dst_off: int, src, *, host: str = "") -> bool:
+    """One GIL-free memcpy of ``src`` into ``dest[dst_off:]`` — the
+    stripe-commit form (multi-MB scratch buffers and direct chunks).
+    True when the native path ran; False (library missing, no zero-copy
+    address, injected fault, bounds rejection) means the caller does
+    the plain Python copy — byte-identical either way."""
+    handle = native.lib()
+    if handle is None:
+        return False
+    loc = native._buffer_address(src)
+    if loc is None:
+        return False
+    addr, n, keep = loc
+    if n == 0:
+        return True
+    ops = op_table(1)
+    ops[0] = (OP_COPY, -1, addr, 0, n, dst_off, n)
+    try:
+        execute_table(ops, dest, host=host)
+    except NativeExecError:
+        return False
+    finally:
+        del keep
+    return True
+
+
+class ReadPlan:
+    """Incremental plan builder for mixed-source batches (striped
+    scratch commits, tests). ``add_copy`` pins a zero-copy address of
+    each source buffer; :meth:`execute` runs the packed table natively
+    and :meth:`execute_python` is the byte-identical pure-Python
+    reference the property tests (and the fallback contract) hold the
+    native engine to."""
+
+    __slots__ = ("_rows", "_keep")
+
+    def __init__(self) -> None:
+        #: (kind, fd, src_obj, src_addr, src_off, src_len, dst_off, len)
+        self._rows: list = []
+        self._keep: list = []
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def add_copy(self, src, src_off: int, length: int,
+                 dst_off: int) -> bool:
+        """Plan ``dest[dst_off:dst_off+length] = src[src_off:...]``.
+        False when ``src`` yields no zero-copy address (caller keeps
+        that op on its Python path)."""
+        loc = native._buffer_address(src)
+        if loc is None:
+            return False
+        addr, n, keep = loc
+        self._keep.append(keep)
+        self._rows.append((OP_COPY, -1, src, addr, src_off, n,
+                           dst_off, length))
+        return True
+
+    def add_pread(self, fd: int, file_off: int, length: int,
+                  dst_off: int) -> None:
+        """Plan ``dest[dst_off:dst_off+length] = pread(fd, file_off)``."""
+        self._rows.append((OP_PREAD, fd, None, 0, file_off, 0,
+                           dst_off, length))
+
+    def table(self):
+        ops = op_table(len(self._rows))
+        for i, (kind, fd, _src, addr, soff, slen, doff, ln) in \
+                enumerate(self._rows):
+            ops[i] = (kind, fd, addr, soff, slen, doff, ln)
+        return ops
+
+    def execute(self, dest, *, host: str = "") -> int:
+        return execute_table(self.table(), dest, host=host)
+
+    def execute_python(self, dest) -> int:
+        """The reference interpreter: identical semantics to
+        ``atpu_plan_exec`` (same bounds checks, same in-order overlap
+        resolution, same error positions), one Python frame per op."""
+        import os
+
+        mv = memoryview(dest).cast("B")
+        total = 0
+        for i, (kind, fd, src, _addr, soff, slen, doff, ln) in \
+                enumerate(self._rows):
+            if ln == 0:
+                continue
+            if doff > len(mv) or ln > len(mv) - doff:
+                raise NativeExecError(f"python plan exec failed at op {i}")
+            if kind == OP_COPY:
+                if src is None or soff > slen or ln > slen - soff:
+                    raise NativeExecError(
+                        f"python plan exec failed at op {i}")
+                smv = memoryview(src).cast("B")
+                mv[doff:doff + ln] = smv[soff:soff + ln]
+            elif kind == OP_PREAD:
+                data = os.pread(fd, ln, soff)
+                if len(data) != ln:
+                    raise NativeExecError(
+                        f"python plan exec failed at op {i}")
+                mv[doff:doff + ln] = data
+            else:
+                raise NativeExecError(f"python plan exec failed at op {i}")
+            total += ln
+        return total

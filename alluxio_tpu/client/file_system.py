@@ -223,7 +223,9 @@ class FileSystem:
                 Keys.USER_SHM_SEGMENT_CACHE_MAX),
             shm_renew_fraction=self._conf.get_float(
                 Keys.USER_SHM_LEASE_RENEW_FRACTION),
-            batch_read=BatchReadConf.from_conf(self._conf))
+            batch_read=BatchReadConf.from_conf(self._conf),
+            native_fastpath=self._conf.get_bool(
+                Keys.USER_NATIVE_FASTPATH_ENABLED))
         # pull cluster defaults once at start (reference: clients load
         # cluster-default config via the meta master on first connect)
         self._path_conf: Dict[str, Dict[str, str]] = {}

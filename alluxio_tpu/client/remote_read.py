@@ -85,6 +85,11 @@ class RemoteReadConf:
     tenant_stripe_limit: int = 0
     #: the tenant these reads bill against (the client's principal)
     tenant: str = ""
+    #: commit large stripe chunks/scratch through the native plan
+    #: executor (``atpu.user.native.fastpath.enabled``): GIL-free
+    #: memcpy into the assembly buffer; plain memoryview copy is the
+    #: byte-identical fallback
+    native_fastpath: bool = True
 
     @classmethod
     def from_conf(cls, conf) -> "RemoteReadConf":
@@ -103,6 +108,8 @@ class RemoteReadConf:
             tenant_stripe_limit=max(0, conf.get_int(
                 Keys.USER_QOS_STRIPE_LIMIT)),
             tenant=get_client_user(conf),
+            native_fastpath=conf.get_bool(
+                Keys.USER_NATIVE_FASTPATH_ENABLED),
         )
 
     @property
@@ -572,6 +579,21 @@ class StripedRead:
                             pass
 
     # -- attempt side (executor threads) -------------------------------------
+    def _native_copy(self, dst_off: int, data) -> bool:
+        """Commit ``data`` into the assembly buffer at ``dst_off``
+        through the native executor — a GIL-free memcpy, so a multi-MB
+        stripe commit no longer stalls every other Python thread.
+        False (fastpath off, library missing, small chunk, any native
+        problem) means the caller does the plain memoryview copy,
+        which is byte-identical."""
+        if not self._conf.native_fastpath:
+            return False
+        from alluxio_tpu.client import fastpath
+
+        if len(data) < fastpath.MIN_COPY_BYTES or not fastpath.available():
+            return False
+        return fastpath.copy_into(self._buf, dst_off, data, host="stripe")
+
     def _note_first_byte(self) -> None:
         if self._first_byte_at is not None:
             return
@@ -628,7 +650,9 @@ class StripedRead:
                             with self._cond:
                                 self._attempt_gone_locked(a)
                             return
-                        buf[rel_off + pos:rel_off + pos + len(data)] = data
+                        if not self._native_copy(rel_off + pos, data):
+                            buf[rel_off + pos:
+                                rel_off + pos + len(data)] = data
                     with self._cond:
                         if pos + len(data) > self._progress[i]:
                             self._progress[i] = pos + len(data)
@@ -673,7 +697,7 @@ class StripedRead:
                     self._attempt_gone_locked(a)
                 return
             self._winner[i] = a
-            if not a.direct:
+            if not a.direct and not self._native_copy(rel_off, a.scratch):
                 memoryview(self._buf)[rel_off:rel_off + ln] = a.scratch
         latency = time.perf_counter() - a.started
         self._rt.stats.observe(a.source.key, latency)
@@ -717,7 +741,8 @@ class StripedRead:
             if self._winner[i] is None:
                 self._winner[i] = a
                 commit = True
-                if not a.direct and served > 0:
+                if not a.direct and served > 0 and not self._native_copy(
+                        rel_off, memoryview(a.scratch)[:served]):
                     memoryview(self._buf)[rel_off:rel_off + served] = \
                         memoryview(a.scratch)[:served]
         with self._cond:

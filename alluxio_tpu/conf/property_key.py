@@ -841,6 +841,19 @@ class Keys:
         description="Ops coalesced into one read_many RPC; a larger "
                     "batch is split into ceil(n/max) RPCs so one "
                     "response message stays bounded.")
+    USER_NATIVE_FASTPATH_ENABLED = _k(
+        "atpu.user.native.fastpath.enabled", KeyType.BOOL, default=True,
+        scope=Scope.CLIENT,
+        description="Native (C++) fastpath for assembled small-read "
+                    "plans: SHM batch copies, read_many response "
+                    "scatter, and stripe commits execute as one packed "
+                    "op table per batch with the GIL released for the "
+                    "whole call (docs/native.md). Takes effect only "
+                    "when the on-demand g++ build succeeds; a missing "
+                    "toolchain or any native error falls back to the "
+                    "byte-identical pure-Python path and counts "
+                    "Client.NativeFallbacks. Off: the client is "
+                    "byte-identical to a build without the subsystem.")
     USER_QOS_STRIPE_LIMIT = _k(
         "atpu.user.qos.stripe.limit", KeyType.INT, default=0,
         scope=Scope.CLIENT,
@@ -1317,6 +1330,14 @@ class Keys:
                     "denied as if the lease table were full — drills "
                     "lease-denied fallback without filling "
                     "atpu.worker.shm.max.leases.")
+    DEBUG_FAULT_NATIVE_EXEC_ERROR_RATE = _k(
+        "atpu.debug.fault.native.exec.error.rate", KeyType.FLOAT,
+        default=0.0, scope=Scope.CLIENT,
+        description="FAULT INJECTION (tests/chaos only): deterministic "
+                    "fraction (0..1) of native fastpath batches that "
+                    "fail mid-table (one op poisoned, earlier ops "
+                    "really write) — drills the byte-identical "
+                    "fallback to the pure-Python read path.")
     DEBUG_FAULT_SCOPE = _k(
         "atpu.debug.fault.scope", KeyType.STRING, default="",
         scope=Scope.WORKER,

@@ -16,7 +16,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from alluxio_tpu.lint import (
     conf_analyzer, exceptions_analyzer, locks_analyzer, metrics_analyzer,
-    phases_analyzer,
+    native_analyzer, phases_analyzer,
 )
 from alluxio_tpu.lint.collect import RepoFacts, collect
 from alluxio_tpu.lint.findings import (
@@ -30,6 +30,7 @@ ANALYZERS: Dict[str, Callable[[RepoModel, RepoFacts], List[Finding]]] = {
     "phase-names": phases_analyzer.analyze,
     "lock-discipline": locks_analyzer.analyze,
     "exceptions": exceptions_analyzer.analyze,
+    "native-abi": native_analyzer.analyze,
 }
 
 DEFAULT_BASELINE = "alluxio_tpu/lint/baseline.json"

@@ -9,35 +9,71 @@ native layer is an accelerator, never a dependency.
 from __future__ import annotations
 
 import ctypes
+import glob
 import logging
 import os
+import struct
 import subprocess
 import tempfile
 import threading
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 LOG = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "framing.cpp")
 _SO = os.path.join(_DIR, "_libatpu_native.so")
 
 _lock = threading.Lock()
 _lib: "ctypes.CDLL | None | bool" = None  # None=untried, False=failed
 
+# Every ctypes prototype the Python side relies on, as the single
+# source of truth: ``lib()`` attaches these, and the atpu-lint
+# ``native-abi`` rule cross-checks this table against the symbols the
+# compiled .so actually exports (both directions), so C++/Python
+# signature drift is a lint failure, not a runtime segfault.
+_PROTOTYPES: "Dict[str, Tuple[list, object]]" = {
+    "atpu_crc32": (
+        [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32],
+        ctypes.c_uint32),
+    "atpu_scan_frames": (
+        [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+         ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)],
+        ctypes.c_size_t),
+    "atpu_prefault": (
+        [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t],
+        ctypes.c_uint64),
+    "atpu_plan_exec": (
+        [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+         ctypes.c_size_t],
+        ctypes.c_int64),
+}
+
+
+def _sources() -> List[str]:
+    """All translation units, sorted for a deterministic compile line."""
+    return sorted(glob.glob(os.path.join(_DIR, "*.cpp")))
+
 
 def _build() -> Optional[str]:
     """Compile the shared library when missing or stale."""
     try:
+        srcs = _sources()
+        if not srcs:
+            return None
+        # stale when ANY source (*.cpp or *.h) is newer than the .so —
+        # keying on a single file once served a stale library after a
+        # new translation unit landed
+        deps = srcs + glob.glob(os.path.join(_DIR, "*.h"))
         if os.path.exists(_SO) and \
-                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+                os.path.getmtime(_SO) >= max(map(os.path.getmtime, deps)):
             return _SO
         # build into a temp file then rename: concurrent processes
         # (minicluster roles) must never dlopen a half-written .so
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
         os.close(fd)
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-               "-o", tmp, _SRC]
+               "-Wall", "-Werror", "-o", tmp] + srcs
         r = subprocess.run(cmd, capture_output=True, timeout=120)
         if r.returncode != 0:
             LOG.warning("native build failed: %s", r.stderr.decode()[:500])
@@ -66,18 +102,17 @@ def lib() -> Optional[ctypes.CDLL]:
         except OSError:
             _lib = False
             return None
-        handle.atpu_crc32.restype = ctypes.c_uint32
-        handle.atpu_crc32.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
-                                      ctypes.c_uint32]
-        handle.atpu_scan_frames.restype = ctypes.c_size_t
-        handle.atpu_scan_frames.argtypes = [
-            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint32),
-            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint64)]
-        handle.atpu_prefault.restype = ctypes.c_uint64
-        handle.atpu_prefault.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
-                                         ctypes.c_size_t]
+        try:
+            for name, (argtypes, restype) in _PROTOTYPES.items():
+                fn = getattr(handle, name)
+                fn.argtypes = argtypes
+                fn.restype = restype
+        except AttributeError:
+            # .so predates a declared symbol (e.g. stale build from a
+            # read-only checkout): unusable, fall back everywhere
+            LOG.warning("native library missing symbols; rebuild needed")
+            _lib = False
+            return None
         _lib = handle
         return handle
 
@@ -166,3 +201,95 @@ def prefault(view, stride: int = 4096) -> bool:
         handle.atpu_prefault(addr, n, stride)
     del keepalive
     return True
+
+
+# ---------------------------------------------------------------- plan exec
+
+# Mirrors struct AtpuPlanOp in plan_exec.cpp exactly: 48 bytes,
+# little-endian, naturally aligned (u32+i32 then five u64) — no
+# padding, so a C-contiguous structured array IS the C op table.
+OP_COPY = 0
+OP_PREAD = 1
+OP_DTYPE_FIELDS = [
+    ("kind", "<u4"), ("fd", "<i4"), ("src", "<u8"), ("src_off", "<u8"),
+    ("src_len", "<u8"), ("dst_off", "<u8"), ("len", "<u8"),
+]
+
+
+def op_dtype():
+    import numpy as np
+
+    dt = np.dtype(OP_DTYPE_FIELDS)
+    assert dt.itemsize == 48, "op dtype drifted from plan_exec.cpp"
+    return dt
+
+
+def exec_plan(ops, dest) -> Optional[int]:
+    """Run a packed op table (a C-contiguous structured array of
+    ``op_dtype()`` records) against ``dest`` (writable buffer) in ONE
+    native call — the GIL is released for the whole batch. Returns the
+    executor's result (total bytes written >= 0, or ``-(i+1)`` when op
+    ``i`` failed), or ``None`` when the native library is unavailable
+    (caller falls back to Python)."""
+    handle = lib()
+    if handle is None:
+        return None
+    nops = len(ops)
+    if nops == 0:
+        return 0
+    dst = _buffer_address(dest)
+    if dst is None:
+        return None
+    dst_addr, dst_len, dst_keep = dst
+    rc = handle.atpu_plan_exec(ops.ctypes.data, nops, dst_addr, dst_len)
+    del dst_keep
+    return rc
+
+
+# ------------------------------------------------------------- ELF symbols
+
+def exported_symbols(path: Optional[str] = None) -> Optional[List[str]]:
+    """Defined ``atpu_*`` function symbols exported by the compiled
+    library, read from the ELF ``.dynsym`` table directly (no ``nm``
+    dependency). Returns ``None`` when the .so is missing or not a
+    64-bit little-endian ELF — used by the atpu-lint ``native-abi``
+    rule to diff the C++ export surface against ``_PROTOTYPES``."""
+    so = path or (_build() if os.path.exists(_DIR) else None)
+    if so is None or not os.path.exists(so):
+        return None
+    try:
+        with open(so, "rb") as f:
+            data = f.read()
+        if data[:4] != b"\x7fELF" or data[4] != 2 or data[5] != 1:
+            return None  # not ELF64 little-endian
+        e_shoff, = struct.unpack_from("<Q", data, 0x28)
+        e_shentsize, e_shnum = struct.unpack_from("<HH", data, 0x3A)
+        dynsym = dynstr = None
+        for i in range(e_shnum):
+            base = e_shoff + i * e_shentsize
+            sh_type, = struct.unpack_from("<I", data, base + 4)
+            sh_offset, sh_size = struct.unpack_from("<QQ", data, base + 24)
+            sh_link, = struct.unpack_from("<I", data, base + 40)
+            sh_entsize, = struct.unpack_from("<Q", data, base + 56)
+            if sh_type == 11:  # SHT_DYNSYM
+                dynsym = (sh_offset, sh_size, sh_entsize, sh_link)
+        if dynsym is None:
+            return None
+        str_base = e_shoff + dynsym[3] * e_shentsize
+        str_off, str_size = struct.unpack_from("<QQ", data, str_base + 24)
+        dynstr = data[str_off:str_off + str_size]
+        out: List[str] = []
+        off, size, entsize, _ = dynsym
+        for pos in range(off, off + size, entsize or 24):
+            st_name, st_info = struct.unpack_from("<IB", data, pos)
+            st_shndx, = struct.unpack_from("<H", data, pos + 6)
+            if (st_info & 0xF) != 2 or st_shndx == 0:  # STT_FUNC, defined
+                continue
+            end = dynstr.index(b"\0", st_name)
+            name = dynstr[st_name:end].decode("ascii", "replace")
+            if name.startswith("atpu_"):
+                out.append(name)
+        return sorted(out)
+    except Exception:  # noqa: BLE001 - malformed ELF: lint rule skips
+        LOG.debug("exported_symbols parse failed", exc_info=True)
+        return None

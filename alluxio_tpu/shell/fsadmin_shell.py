@@ -200,6 +200,15 @@ class ReportCommand(Command):
                       f"{int(snap.get('Master.ReplicationJobsInflight', 0))}"
                       f" in flight) — expected during mass recovery, "
                       f"raise the cap if it never drains")
+        native_fb = snap.get("Cluster.NativeFallbacks", 0)
+        if native_fb:
+            ctx.print(f"WARN: {int(native_fb)} native fastpath batches "
+                      f"fell back to the pure-Python read path — a "
+                      f"client without a working g++ toolchain loses "
+                      f"the GIL-free plan executor quietly; check "
+                      f"client hosts against docs/native.md, or set "
+                      f"atpu.user.native.fastpath.enabled=false if "
+                      f"that is intended")
         shed = snap.get("Master.RpcAdmissionShed", 0)
         if shed:
             # next to the other drop counters on purpose: shed RPCs

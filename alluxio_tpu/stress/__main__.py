@@ -116,13 +116,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "over real gRPC vs per-op RPCs, and "
                              "same-host SHM zero-copy fidelity "
                              "(buffer identity, no wire phase)")
-    sr.add_argument("--row", choices=("batch", "shm"), default="batch",
+    sr.add_argument("--row", choices=("batch", "shm", "native"),
+                    default="batch",
                     help="which row: read_many coalescing speedup "
-                         "(default) or SHM zero-copy fidelity")
+                         "(default), SHM zero-copy fidelity, or native "
+                         "fastpath batched scatter speedup")
     sr.add_argument("--file-mb", type=int, default=2)
     sr.add_argument("--ops", type=int, default=None,
                     help="random preads measured (default: 400 batch "
-                         "row, 200 shm row)")
+                         "row, 200 shm row, 2000 native row)")
     sr.add_argument("--read-bytes", type=int, default=4096)
     sr.add_argument("--min-speedup", type=float, default=3.0,
                     help="batch row: fail below this batched/per-op "
@@ -311,6 +313,8 @@ SUITE = (
                            "--file-mb", "2", "--reads", "80"]),
     ("smallread-batch", ["smallread", "--row", "batch"]),
     ("smallread-shm-zerocopy", ["smallread", "--row", "shm"]),
+    ("smallread-native-fastpath", ["smallread", "--row", "native",
+                                   "--min-speedup", "5.0"]),
     ("health-ingest-overhead", ["health"]),
     ("selfheal-remediation", ["selfheal"]),
     ("ufs-cold-read", ["ufscold"]),
@@ -511,6 +515,13 @@ def main(argv=None) -> int:
             r = run_shm(file_mb=args.file_mb,
                         ops=args.ops if args.ops is not None else 200,
                         read_bytes=args.read_bytes)
+        elif args.row == "native":
+            from alluxio_tpu.stress.smallread_bench import run_native
+
+            r = run_native(file_mb=args.file_mb,
+                           ops=args.ops if args.ops is not None else 2000,
+                           read_bytes=args.read_bytes,
+                           min_speedup=args.min_speedup)
         else:
             from alluxio_tpu.stress.smallread_bench import run_batch
 

@@ -18,6 +18,14 @@ Two gated rows for the zero-copy/batching subsystem
   not just "fast"), and a traced read burst must record ZERO
   ``wire``/``serialize`` phase time (the wire never ran; cf. the
   ``obs-critical-path`` row next to which this sits in the suite).
+- ``smallread-native-fastpath`` — the same-host batched random-4k
+  drill run twice: ``atpu.user.native.fastpath.enabled`` on (one
+  packed op table per ``pread_many`` batch, GIL released for the
+  whole call) vs off (the per-op pure-Python loop, i.e. the path
+  before the native core existed). FAILS below ``--min-speedup``
+  (default 5x) native-vs-python ops/s, on any byte difference between
+  the two outputs and the written data, or when the native layer did
+  not actually execute (``Client.NativeBatches`` must move).
 """
 
 from __future__ import annotations
@@ -92,6 +100,104 @@ def run_batch(*, file_mb: int = 2, ops: int = 400,
                 "read_bytes": read_bytes, "min_speedup": min_speedup},
         metrics={"per_op_ops_per_s": round(per_op_ops, 1),
                  "batched_ops_per_s": round(batched_ops, 1),
+                 "speedup": round(speedup, 2),
+                 "mismatches": mismatches,
+                 "speedup_ok": ok},
+        errors=0 if ok else 1,
+        duration_s=time.monotonic() - t_start)
+
+
+def run_native(*, file_mb: int = 2, ops: int = 2000,
+               read_bytes: int = 4096,
+               min_speedup: float = 5.0) -> BenchResult:
+    """``smallread-native-fastpath``: batched random-4k ops/s with the
+    native plan executor on vs the pure-Python per-op path, byte
+    identity asserted between both outputs and the source data."""
+    import random
+    import tempfile
+
+    from alluxio_tpu.client import fastpath
+    from alluxio_tpu.client.file_system import FileSystem
+    from alluxio_tpu.conf import Keys
+    from alluxio_tpu.metrics import metrics
+    from alluxio_tpu.minicluster.local_cluster import LocalCluster
+
+    t_start = time.monotonic()
+    rng = random.Random(0x6D)
+    size = file_mb << 20
+    reps = 5
+    native_ok = fastpath.available()
+    shm_stream = False
+    batches_moved = False
+    mismatches = -1
+    native_s = python_s = 0.0
+    with tempfile.TemporaryDirectory(prefix="atpu-native-") as base:
+        with LocalCluster(base, num_workers=1,
+                          worker_mem_bytes=8 * size) as c:
+            conf_off = c.conf.copy()
+            conf_off.set(Keys.USER_NATIVE_FASTPATH_ENABLED, False)
+            fs_on = c.file_system()
+            fs_off = FileSystem(c.master.address, conf=conf_off)
+            try:
+                path = "/smallread-native.bin"
+                payload = bytes(rng.randrange(256) for _ in range(4096))
+                data = payload * (size // 4096)
+                fs_on.write_all(path, data, write_type="MUST_CACHE")
+                results = {}
+                for tag, fs in (("native", fs_on), ("python", fs_off)):
+                    with fs.open_file(path) as f:
+                        bs = f.block_stream(0)
+                        bs.pread(0, read_bytes)  # map the segment
+                        if tag == "native":
+                            shm_stream = bs.last_source == "SHM"
+                            offsets = _rand_offsets(rng, bs.length,
+                                                    read_bytes, ops)
+                            sizes = [read_bytes] * ops
+                        bs.pread_many(offsets[:8], sizes[:8])  # warm
+                        before = metrics().counter(
+                            "Client.NativeBatches").count
+                        best = float("inf")
+                        for _ in range(reps):
+                            t0 = time.perf_counter()
+                            out = bs.pread_many(offsets, sizes)
+                            best = min(best,
+                                       time.perf_counter() - t0)
+                        results[tag] = out
+                        if tag == "native":
+                            native_s = best
+                            batches_moved = metrics().counter(
+                                "Client.NativeBatches").count > before
+                        else:
+                            python_s = best
+                # byte identity: native == fallback == the written data
+                expect = [data[o:o + read_bytes] for o in offsets]
+                mismatches = sum(
+                    1 for a, b, e in zip(results["native"],
+                                         results["python"], expect)
+                    if a != b or a != e)
+            finally:
+                fs_on.close()
+                fs_off.close()
+    native_ops = ops / native_s if native_s > 0 else 0.0
+    python_ops = ops / python_s if python_s > 0 else 0.0
+    speedup = (native_ops / python_ops) if python_ops > 0 else 0.0
+    ok = (native_ok and shm_stream and batches_moved
+          and mismatches == 0 and speedup >= min_speedup)
+    if not ok:
+        print(f"[smallread] native fastpath row failed: "
+              f"available={native_ok} shm_stream={shm_stream} "
+              f"native_ran={batches_moved} mismatches={mismatches} "
+              f"speedup {speedup:.2f}x vs the {min_speedup}x gate",
+              file=sys.stderr)
+    return BenchResult(
+        bench="smallread-native-fastpath",
+        params={"file_mb": file_mb, "ops": ops,
+                "read_bytes": read_bytes, "min_speedup": min_speedup},
+        metrics={"native_available": native_ok,
+                 "shm_stream": shm_stream,
+                 "native_exec_ran": batches_moved,
+                 "python_ops_per_s": round(python_ops, 1),
+                 "native_ops_per_s": round(native_ops, 1),
                  "speedup": round(speedup, 2),
                  "mismatches": mismatches,
                  "speedup_ok": ok},

@@ -27,7 +27,11 @@ substring so a multi-worker cluster can break exactly one node:
 - **SHM lease deny rate** — a deterministic fraction of worker
   ``shm_open`` grants is denied as if the lease table were full,
   drilling lease-denied fallback without actually filling
-  ``atpu.worker.shm.max.leases``.
+  ``atpu.worker.shm.max.leases``;
+- **native exec error rate** — a deterministic fraction of native
+  fastpath batches fails mid-table (one op is poisoned, so earlier
+  ops really write), drilling the byte-identical fallback from
+  ``plan_exec.cpp`` to the pure-Python read path.
 
 The HA chaos drill (docs/ha.md) adds four programmatic faults — set by
 the minicluster / :class:`FaultPlan`, not by conf, since they only make
@@ -69,6 +73,7 @@ class FaultInjector:
         self.rpc_reject_retry_after_s: float = 0.05
         self.shm_map_error_rate: float = 0.0
         self.shm_lease_deny_rate: float = 0.0
+        self.native_exec_error_rate: float = 0.0
         self.scope: str = ""
         #: HA chaos faults (programmatic; see module docstring)
         self.tailer_freeze_scope: str = ""
@@ -79,6 +84,7 @@ class FaultInjector:
         self.injected = {"read_latency": 0, "heartbeat_freeze": 0,
                          "ufs_error": 0, "rpc_reject": 0,
                          "shm_map_error": 0, "shm_lease_deny": 0,
+                         "native_exec_error": 0,
                          "tailer_freeze": 0, "election_freeze": 0,
                          "partition_drop": 0, "fsync_error": 0}
         self._ufs_reads = 0
@@ -89,6 +95,8 @@ class FaultInjector:
         self._shm_map_failed = 0
         self._shm_grants = 0
         self._shm_denied = 0
+        self._native_execs = 0
+        self._native_failed = 0
 
     # ----------------------------------------------------------- config
     def configure(self, conf) -> None:
@@ -107,6 +115,8 @@ class FaultInjector:
                 Keys.DEBUG_FAULT_SHM_MAP_ERROR_RATE),
             shm_lease_deny_rate=conf.get_float(
                 Keys.DEBUG_FAULT_SHM_LEASE_DENY_RATE),
+            native_exec_error_rate=conf.get_float(
+                Keys.DEBUG_FAULT_NATIVE_EXEC_ERROR_RATE),
             scope=str(conf.get(Keys.DEBUG_FAULT_SCOPE) or ""))
 
     def set(self, *, read_latency_s: Optional[float] = None,
@@ -115,6 +125,7 @@ class FaultInjector:
             rpc_reject_rate: Optional[float] = None,
             shm_map_error_rate: Optional[float] = None,
             shm_lease_deny_rate: Optional[float] = None,
+            native_exec_error_rate: Optional[float] = None,
             scope: Optional[str] = None,
             tailer_freeze_scope: Optional[str] = None,
             election_freeze_scope: Optional[str] = None,
@@ -138,6 +149,9 @@ class FaultInjector:
             if shm_lease_deny_rate is not None:
                 self.shm_lease_deny_rate = min(1.0, max(
                     0.0, float(shm_lease_deny_rate)))
+            if native_exec_error_rate is not None:
+                self.native_exec_error_rate = min(1.0, max(
+                    0.0, float(native_exec_error_rate)))
             if scope is not None:
                 self.scope = str(scope)
             if tailer_freeze_scope is not None:
@@ -157,6 +171,7 @@ class FaultInjector:
                       or self.ufs_error_rate or self.rpc_reject_rate
                       or self.shm_map_error_rate
                       or self.shm_lease_deny_rate
+                      or self.native_exec_error_rate
                       or self.tailer_freeze_scope
                       or self.election_freeze_scope
                       or self.partitioned or self.fsync_errors)
@@ -170,6 +185,7 @@ class FaultInjector:
             self.rpc_reject_rate = 0.0
             self.shm_map_error_rate = 0.0
             self.shm_lease_deny_rate = 0.0
+            self.native_exec_error_rate = 0.0
             self.scope = ""
             self.tailer_freeze_scope = ""
             self.election_freeze_scope = ""
@@ -183,6 +199,8 @@ class FaultInjector:
             self._shm_map_failed = 0
             self._shm_grants = 0
             self._shm_denied = 0
+            self._native_execs = 0
+            self._native_failed = 0
             for k in self.injected:
                 self.injected[k] = 0
             _armed = False
@@ -294,6 +312,23 @@ class FaultInjector:
             if self._shm_denied < rate * self._shm_grants:
                 self._shm_denied += 1
                 self.injected["shm_lease_deny"] += 1
+                return True
+        return False
+
+    def take_native_exec_error(self, host: str) -> bool:
+        """True when this native fastpath batch should fail mid-table
+        (one op poisoned before the call, so earlier ops genuinely
+        write before the executor rejects). Same deterministic
+        failed/total pacing as the UFS hook — rate 0.5 fails exactly
+        batches 1, 3, 5, ..."""
+        rate = self.native_exec_error_rate
+        if rate <= 0 or not self._in_scope(host):
+            return False
+        with self._lock:
+            self._native_execs += 1
+            if self._native_failed < rate * self._native_execs:
+                self._native_failed += 1
+                self.injected["native_exec_error"] += 1
                 return True
         return False
 

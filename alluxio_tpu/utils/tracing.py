@@ -72,6 +72,7 @@ PHASES = (
     "shm_map",      # mmap-ing a leased same-host SHM segment
     "lease_wait",   # client-observed shm_open/shm_renew lease RPC wait
     "batch_read",   # server-side scatter/gather assembly of a read_many
+    "native_exec",  # GIL-free native execution of a packed read plan
 )
 
 

@@ -1,0 +1,358 @@
+"""Native fastpath core: GIL-free execution of packed read plans.
+
+Covers the contracts in docs/native.md: property sweep of random plans
+(python-vs-native byte identity, including overlapping destinations and
+zero-length ops), error-position identity, the one-GIL-release claim (a
+background thread keeps running during a large native batch), the
+build-failure fallback ladder, deterministic mid-batch chaos via
+``atpu.debug.fault.native.exec.error.rate`` over a real minicluster,
+disabled-path byte identity, and the atpu-lint ``native-abi`` rule.
+"""
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from alluxio_tpu import native
+from alluxio_tpu.client import fastpath
+from alluxio_tpu.client.fastpath import NativeExecError, ReadPlan
+from alluxio_tpu.conf import Keys
+from alluxio_tpu.metrics import metrics
+from alluxio_tpu.minicluster import LocalCluster
+from alluxio_tpu.utils import faults
+
+KB = 1024
+BLOCK = 64 * KB
+
+
+@pytest.fixture(scope="module")
+def lib():
+    handle = native.lib()
+    if handle is None:
+        pytest.skip("no native toolchain")
+    return handle
+
+
+def _patterned(n, seed):
+    rng = random.Random(seed)
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+# ------------------------------------------------ property sweep
+class TestPlanProperty:
+    def _random_plan(self, rng, dest_len, sources, fd, file_len):
+        """Mixed COPY/PREAD plan with overlapping dests and a sprinkle
+        of zero-length ops; returns the plan (always packable: every
+        source yields a zero-copy address)."""
+        plan = ReadPlan()
+        for _ in range(rng.randrange(1, 40)):
+            ln = rng.choice([0, rng.randrange(1, 3 * KB)])
+            dst_off = rng.randrange(0, max(1, dest_len - ln + 1))
+            if rng.random() < 0.5:
+                src = rng.choice(sources)
+                src_off = rng.randrange(0, max(1, len(src) - ln + 1))
+                assert plan.add_copy(src, src_off, ln, dst_off)
+            else:
+                file_off = rng.randrange(0, max(1, file_len - ln + 1))
+                plan.add_pread(fd, file_off, ln, dst_off)
+        return plan
+
+    def test_random_plans_byte_identical(self, lib, tmp_path):
+        np = pytest.importorskip("numpy")
+        file_data = _patterned(32 * KB, 0xF11E)
+        path = tmp_path / "pread-src.bin"
+        path.write_bytes(file_data)
+        sources = [
+            _patterned(8 * KB, 1),                      # bytes
+            bytearray(_patterned(8 * KB, 2)),           # bytearray
+            np.frombuffer(_patterned(8 * KB, 3), dtype=np.uint8),
+        ]
+        fd = os.open(str(path), os.O_RDONLY)
+        try:
+            rng = random.Random(0xFA57)
+            for case in range(60):
+                dest_len = rng.randrange(4 * KB, 16 * KB)
+                plan = self._random_plan(rng, dest_len, sources, fd,
+                                         len(file_data))
+                dn, dp = bytearray(dest_len), bytearray(dest_len)
+                rc_native = plan.execute(dn)
+                rc_python = plan.execute_python(dp)
+                assert dn == dp, f"case {case}: native != python"
+                assert rc_native == rc_python
+        finally:
+            os.close(fd)
+
+    def test_overlap_resolves_in_op_order(self, lib):
+        a, b = b"A" * KB, b"B" * KB
+        plan = ReadPlan()
+        assert plan.add_copy(a, 0, KB, 0)
+        assert plan.add_copy(b, 0, KB, 512)  # later op wins the overlap
+        dest = bytearray(2 * KB)
+        plan.execute(dest)
+        assert dest[:512] == a[:512] and dest[512:512 + KB] == b
+
+    def test_error_positions_match_python(self, lib, tmp_path):
+        path = tmp_path / "short.bin"
+        path.write_bytes(b"x" * 100)
+        fd = os.open(str(path), os.O_RDONLY)
+        try:
+            cases = []
+            p = ReadPlan()                       # dest overrun at op 1
+            assert p.add_copy(b"ok" * 64, 0, 64, 0)
+            assert p.add_copy(b"zz" * 64, 0, 128, KB - 64)
+            cases.append(p)
+            p = ReadPlan()                       # src overrun at op 0
+            assert p.add_copy(b"tiny", 0, 64, 0)
+            cases.append(p)
+            p = ReadPlan()                       # EOF before extent
+            p.add_pread(fd, 90, 64, 0)
+            cases.append(p)
+            for plan in cases:
+                dn, dp = bytearray(KB), bytearray(KB)
+                with pytest.raises(NativeExecError):
+                    plan.execute(dn)
+                with pytest.raises(NativeExecError):
+                    plan.execute_python(dp)
+        finally:
+            os.close(fd)
+
+    def test_zero_length_plan_is_free(self, lib):
+        plan = ReadPlan()
+        assert plan.add_copy(b"abc", 0, 0, 0)
+        dest = bytearray(4)
+        assert plan.execute(dest) == 0
+        assert dest == bytearray(4)
+
+    def test_counters_and_phase_account_the_batch(self, lib):
+        from alluxio_tpu.utils.tracing import (
+            set_tracing_enabled, tracer,
+        )
+
+        m = metrics()
+        before = (m.counter("Client.NativeBatches").count,
+                  m.counter("Client.NativeBatchOps").count,
+                  m.counter("Client.NativeBatchBytes").count)
+        plan = ReadPlan()
+        assert plan.add_copy(b"q" * KB, 0, KB, 0)
+        assert plan.add_copy(b"r" * KB, 0, KB, KB)
+        set_tracing_enabled(True)
+        try:
+            with tracer().span("client.read-step") as sp:
+                plan.execute(bytearray(2 * KB))
+        finally:
+            set_tracing_enabled(False)
+        assert m.counter("Client.NativeBatches").count == before[0] + 1
+        assert m.counter("Client.NativeBatchOps").count == before[1] + 2
+        assert m.counter("Client.NativeBatchBytes").count == \
+            before[2] + 2 * KB
+        assert "native_exec" in [n for n, _ in (sp.phases or [])]
+
+
+# ------------------------------------------------- GIL release proof
+class TestGilRelease:
+    def test_background_thread_progresses_during_batch(self, lib):
+        """The whole batch runs inside ONE ctypes call with the GIL
+        dropped: a pure-Python spinner thread must keep accumulating
+        iterations while the main thread is blocked in native code."""
+        src = bytearray(8 * (1 << 20))
+        dest = bytearray(len(src))
+        plan = ReadPlan()
+        for _ in range(400):  # ~3.2 GB of memcpy, all dst_off=0
+            assert plan.add_copy(src, 0, len(src), 0)
+        spins = [0]
+        stop = threading.Event()
+
+        def spinner():
+            while not stop.is_set():
+                spins[0] += 1
+
+        t = threading.Thread(target=spinner, daemon=True)
+        t.start()
+        time.sleep(0.05)  # let the spinner reach steady state
+        spins_before = spins[0]
+        plan.execute(dest)
+        spins_during = spins[0] - spins_before
+        stop.set()
+        t.join()
+        # with the GIL held across the batch the spinner would be
+        # frozen (ctypes only yields at call boundaries); a released
+        # GIL lets it run thousands of iterations
+        assert spins_during > 100, f"spinner starved: {spins_during}"
+
+
+# ---------------------------------------------- build-failure fallback
+class TestBuildFailureFallback:
+    @pytest.fixture()
+    def no_lib(self, monkeypatch):
+        monkeypatch.setattr(native, "_lib", False)
+        yield
+
+    def test_available_and_exec_report_unavailable(self, no_lib):
+        assert not fastpath.available()
+        assert native.exec_plan(fastpath.op_table(0), bytearray(1)) is None
+
+    def test_execute_table_counts_fallback_and_raises(self, no_lib):
+        np = pytest.importorskip("numpy")
+        ops = fastpath.op_table(1)
+        ops["len"] = np.uint64(4)
+        before = metrics().counter("Client.NativeFallbacks").count
+        with pytest.raises(NativeExecError):
+            fastpath.execute_table(ops, bytearray(4))
+        assert metrics().counter("Client.NativeFallbacks").count == \
+            before + 1
+
+    def test_copy_into_declines_quietly(self, no_lib):
+        dest = bytearray(8)
+        assert fastpath.copy_into(dest, 0, b"abcd") is False
+        assert dest == bytearray(8)  # caller does the Python copy
+
+    def test_note_unavailable_is_loud(self, no_lib):
+        before = metrics().counter("Client.NativeFallbacks").count
+        fastpath.note_unavailable()
+        assert metrics().counter("Client.NativeFallbacks").count == \
+            before + 1
+
+
+# ----------------------------------------------------- minicluster e2e
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = str(tmp_path_factory.mktemp("native-cluster"))
+    with LocalCluster(base, num_workers=1, block_size=BLOCK,
+                      worker_mem_bytes=4 * 1024 * KB) as c:
+        yield c
+
+
+class TestChaosMidBatchFallback:
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        faults.injector().reset()
+        yield
+        faults.injector().reset()
+
+    def test_poisoned_batches_still_serve_identical_bytes(self, cluster,
+                                                          lib):
+        """rate=0.5 poisons one op mid-table in every other batch: the
+        executor genuinely writes the ops before the poison, rejects,
+        and the Python rung must overwrite the partial buffer with the
+        exact same bytes."""
+        data = _patterned(BLOCK, 0xC05)
+        fs = cluster.file_system()
+        try:
+            fs.write_all("/chaos-native", data, write_type="MUST_CACHE")
+            rng = random.Random(0xC05)
+            with fs.open_file("/chaos-native") as f:
+                bs = f.block_stream(0)
+                assert type(bs).__name__ == "ShmBlockInStream"
+                m = metrics()
+                fallbacks = m.counter("Client.NativeFallbacks").count
+                faults.injector().set(native_exec_error_rate=0.5)
+                for _ in range(8):
+                    offs = [rng.randrange(0, BLOCK - 256)
+                            for _ in range(32)]
+                    szs = [rng.randrange(0, 256) for _ in offs]
+                    got = bs.pread_many(offs, szs)
+                    assert got == [data[o:o + s]
+                                   for o, s in zip(offs, szs)]
+            assert faults.injector().injected["native_exec_error"] >= 4
+            assert m.counter("Client.NativeFallbacks").count >= \
+                fallbacks + 4
+        finally:
+            fs.close()
+
+    def test_fault_key_configures_from_conf(self):
+        from alluxio_tpu.conf import Configuration
+
+        conf = Configuration()
+        conf.set(Keys.DEBUG_FAULT_NATIVE_EXEC_ERROR_RATE, 0.25)
+        inj = faults.injector()
+        inj.configure(conf)
+        assert inj.native_exec_error_rate == 0.25
+        assert faults.armed()
+
+    def test_pacing_is_deterministic(self):
+        faults.injector().set(native_exec_error_rate=0.5)
+        taken = [faults.injector().take_native_exec_error("shm")
+                 for _ in range(10)]
+        assert taken == [True, False] * 5
+
+
+class TestDisabledByteIdentity:
+    def test_conf_off_serves_identical_bytes(self, cluster):
+        """`atpu.user.native.fastpath.enabled=false` must be
+        byte-identical to the fastpath client over the same cluster —
+        the gate for the 'client unchanged at HEAD' criterion."""
+        data = _patterned(BLOCK, 0x0FF)
+        fs_on = cluster.file_system()
+        conf = cluster.conf.copy()
+        conf.set(Keys.USER_NATIVE_FASTPATH_ENABLED, False)
+        from alluxio_tpu.client.file_system import FileSystem
+
+        fs_off = FileSystem(cluster.master.address, conf=conf)
+        try:
+            fs_on.write_all("/native-parity", data,
+                            write_type="MUST_CACHE")
+            rng = random.Random(0x0FF)
+            offs = [rng.randrange(0, BLOCK - 512) for _ in range(64)]
+            szs = [rng.randrange(0, 512) for _ in offs]
+            with fs_on.open_file("/native-parity") as f:
+                got_on = f.block_stream(0).pread_many(offs, szs)
+            with fs_off.open_file("/native-parity") as f:
+                got_off = f.block_stream(0).pread_many(offs, szs)
+            expect = [data[o:o + s] for o, s in zip(offs, szs)]
+            assert got_on == expect and got_off == expect
+        finally:
+            fs_off.close()
+            fs_on.close()
+
+
+# ------------------------------------------------------ atpu-lint rule
+class TestNativeAbiLint:
+    _LOADER = "alluxio_tpu/native/__init__.py"
+
+    def _model_facts(self):
+        from alluxio_tpu.lint.collect import collect
+        from alluxio_tpu.lint.model import build_model
+
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(fastpath.__file__))))
+        model = build_model(root, only_paths={self._LOADER})
+        return model, collect(model)
+
+    def test_shipped_abi_is_clean(self, lib):
+        from alluxio_tpu.lint import native_analyzer
+
+        model, facts = self._model_facts()
+        assert native_analyzer.analyze(model, facts) == []
+
+    def test_missing_symbol_is_flagged(self, lib, monkeypatch):
+        from alluxio_tpu.lint import native_analyzer
+
+        bogus = dict(native._PROTOTYPES)
+        bogus["atpu_bogus"] = ([], None)
+        monkeypatch.setattr(native, "_PROTOTYPES", bogus)
+        model, facts = self._model_facts()
+        found = native_analyzer.analyze(model, facts)
+        assert [f.rule for f in found] == ["native-abi-missing-symbol"]
+        assert found[0].anchor == "atpu_bogus"
+
+    def test_undeclared_symbol_is_flagged(self, lib, monkeypatch):
+        from alluxio_tpu.lint import native_analyzer
+
+        real = native.exported_symbols()
+        monkeypatch.setattr(native, "exported_symbols",
+                            lambda path=None: real + ["atpu_stray"])
+        model, facts = self._model_facts()
+        found = native_analyzer.analyze(model, facts)
+        assert [f.rule for f in found] == ["native-abi-undeclared-symbol"]
+        assert found[0].anchor == "atpu_stray"
+
+    def test_no_toolchain_stays_silent(self, monkeypatch):
+        from alluxio_tpu.lint import native_analyzer
+
+        monkeypatch.setattr(native, "exported_symbols",
+                            lambda path=None: None)
+        model, facts = self._model_facts()
+        assert native_analyzer.analyze(model, facts) == []

@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native bench bench-prefetch bench-obs bench-smallread bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata bench-ha sdist clean lint lint-changed lint-docs
+.PHONY: test test-fast native bench bench-prefetch bench-obs bench-smallread bench-table bench-health bench-selfheal bench-ufs-cold bench-remote-read bench-qos bench-metadata bench-ha sdist clean lint lint-changed lint-docs
 
 lint:  ## atpu-lint: conf-key/metric-name/lock/exception discipline (<30s budget)
 	$(PY) -m alluxio_tpu.lint --budget-s 30
@@ -42,6 +42,10 @@ bench-smallread:  ## small-read plane: read_many coalescing (>=3x per-op ops/s),
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row batch
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row shm
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress smallread --row native --min-speedup 5.0
+
+bench-table:  ## table reads: projection composite (>=4x full-scan/projection) + planned-vs-legacy pushdown (>=2x, byte-identity asserted in-bench)
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress table
+	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress table --row pushdown
 
 bench-health:  ## metrics-history ingestion: heartbeat hot-path overhead (<5% gate, fake clock)
 	JAX_PLATFORMS=cpu $(PY) -m alluxio_tpu.stress health

@@ -378,6 +378,13 @@ class FileSystem:
         metrics().counter("Client.ConfOverlayApplied").inc()
         self._overlay_active = applied
 
+    @property
+    def conf(self):
+        """This client's resolved :class:`Configuration` (read-only use;
+        layered services — e.g. the table reader — key their behavior
+        off client conf without reaching into privates)."""
+        return self._conf
+
     # ------------------------------------------------------------- metadata
     def get_status(self, path: "str | AlluxioURI") -> FileInfo:
         p = AlluxioURI(path).path

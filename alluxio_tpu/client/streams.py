@@ -212,6 +212,89 @@ class FileInStream:
         mmap whole blocks instead of byte-copy reads."""
         return self._block_stream(index)
 
+    def pread_ranges(self, ranges: "List[tuple]", *,
+                     route_stats: Optional[Dict[str, int]] = None
+                     ) -> List[bytes]:
+        """Scatter/gather positioned reads over a list of ``(offset,
+        length)`` file ranges — the range-list entry point of the
+        ``choose_route`` ladder (docs/table_reads.md). Ranges are split
+        at block boundaries, grouped per block, and each block group is
+        served by the best transport in ONE pass: same-host SHM blocks
+        hand back zero-copy ``memoryview`` slices, wire-crossing groups
+        ride ``pread_many`` (small ops coalesce into ``read_many``
+        scatter batches through the native plan executor, large ops take
+        the striped plane) — instead of one RPC per seek.
+
+        Results come back in request order as buffer objects (``bytes``
+        or ``memoryview``); a range past EOF truncates exactly like
+        :meth:`pread`. Any block-group failure falls back to the per-op
+        :meth:`pread` path, which carries the failed-worker retry
+        ladder — the router can only make reads faster, never fail them.
+        ``route_stats``: optional dict the served byte counts are added
+        into, keyed by route (``shm``/``batch``/``striped``/``stream``).
+        """
+        from alluxio_tpu.client.remote_read import choose_route
+
+        bs = self.info.block_size_bytes or self.length or 1
+        # split ranges at block boundaries: (block, off_in_block, n,
+        # range_index) preserving request order within each range
+        by_block: "Dict[int, List[tuple]]" = {}
+        parts_per_range: List[List[Optional[bytes]]] = []
+        for r_i, (off, n) in enumerate(ranges):
+            off = max(0, int(off))
+            n = max(0, min(int(n), self.length - off))
+            slots: List[Optional[bytes]] = []
+            while n > 0:
+                index = off // bs
+                off_in_block = off % bs
+                take = min(n, bs - off_in_block)
+                by_block.setdefault(index, []).append(
+                    (off_in_block, take, r_i, len(slots)))
+                slots.append(None)
+                off += take
+                n -= take
+            parts_per_range.append(slots)
+        rt = self._store.remote_read
+        striped_conf = rt.conf if rt is not None and rt.enabled else None
+        batch_conf = getattr(self._store, "batch_read", None)
+        for index in sorted(by_block):
+            ops = by_block[index]
+            try:
+                stream = self._block_stream(index)
+                if hasattr(stream, "pread_view"):
+                    # same-host SHM segment: every op is a zero-copy view
+                    for off_in_block, take, r_i, slot in ops:
+                        view = stream.pread_view(off_in_block, take)
+                        parts_per_range[r_i][slot] = view
+                        self._note_route(route_stats, "shm", len(view))
+                    continue
+                outs = stream.pread_many([o[0] for o in ops],
+                                         [o[1] for o in ops])
+            except Exception:  # noqa: BLE001 - per-op ladder handles retry
+                outs = [self.pread(index * bs + o[0], o[1]) for o in ops]
+            for (off_in_block, take, r_i, slot), out in zip(ops, outs):
+                parts_per_range[r_i][slot] = out
+                self._note_route(
+                    route_stats,
+                    choose_route(take, batch=batch_conf,
+                                 batch_ops=len(ops),
+                                 striped=striped_conf), len(out))
+        out: List[bytes] = []
+        for slots in parts_per_range:
+            if not slots:
+                out.append(b"")
+            elif len(slots) == 1:
+                out.append(slots[0])
+            else:
+                out.append(b"".join(slots))
+        return out
+
+    @staticmethod
+    def _note_route(route_stats: Optional[Dict[str, int]], route: str,
+                    nbytes: int) -> None:
+        if route_stats is not None:
+            route_stats[route] = route_stats.get(route, 0) + nbytes
+
     def close(self) -> None:
         for index in list(self._streams):
             self._drop_stream(index)

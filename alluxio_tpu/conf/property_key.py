@@ -854,6 +854,60 @@ class Keys:
                     "byte-identical pure-Python path and counts "
                     "Client.NativeFallbacks. Off: the client is "
                     "byte-identical to a build without the subsystem.")
+    USER_TABLE_PUSHDOWN_ENABLED = _k(
+        "atpu.user.table.pushdown.enabled", KeyType.BOOL, default=True,
+        scope=Scope.CLIENT,
+        description="Projection-aware Parquet reads (docs/table_reads.md): "
+                    "the table reader parses the footer once (cached), "
+                    "plans the exact column-chunk byte ranges of the "
+                    "projection per row group, and executes them through "
+                    "the choose_route ladder — same-host chunks as SHM "
+                    "zero-copy views, small wire-crossing chunks "
+                    "coalesced into read_many batches, large chunks as "
+                    "striped reads — with decode of row group k "
+                    "overlapped against transfer of k+1. Off: reads go "
+                    "through the legacy seek+read pyarrow path, "
+                    "byte-identical to a build without the subsystem.")
+    USER_TABLE_PIPELINE_DEPTH = _k(
+        "atpu.user.table.pipeline.depth", KeyType.INT, default=2,
+        scope=Scope.CLIENT,
+        description="Row groups in flight ahead of the decoder in the "
+                    "planned table-read pipeline: transfer of row group "
+                    "k+depth is issued while k decodes, so decode time "
+                    "hides under transfer time. 1 serializes transfer "
+                    "and decode (no overlap); the depth bounds buffered "
+                    "row-group bytes.")
+    USER_TABLE_READ_PARALLELISM = _k(
+        "atpu.user.table.read.parallelism", KeyType.INT, default=4,
+        scope=Scope.CLIENT,
+        description="Files a multi-file projection (read_columns over a "
+                    "partitioned table) opens/plans/reads concurrently: "
+                    "partition-spanning projections overlap their footer "
+                    "fetches and row-group pipelines instead of running "
+                    "file-serial. 1 restores the serial loop.")
+    USER_TABLE_COALESCE_SLACK_BYTES = _k(
+        "atpu.user.table.coalesce.slack.bytes", KeyType.BYTES,
+        default="256KB", scope=Scope.CLIENT,
+        description="Adjacent planned column-chunk ranges whose gap is "
+                    "at or under this slack merge into one read — the "
+                    "discarded gap bytes buy fewer round trips (gap "
+                    "bytes are fetched and dropped). 0 never merges "
+                    "across a gap (only touching ranges coalesce).")
+    USER_TABLE_FOOTER_CACHE_MAX = _k(
+        "atpu.user.table.footer.cache.max", KeyType.INT, default=256,
+        scope=Scope.CLIENT,
+        description="Parsed Parquet footers held per client process "
+                    "(LRU), keyed on path + metadata version so a "
+                    "rewritten file re-parses: a warm projection re-plans "
+                    "from the cache with zero footer I/O.")
+    USER_TABLE_FOOTER_READ_BYTES = _k(
+        "atpu.user.table.footer.read.bytes", KeyType.BYTES, default="64KB",
+        scope=Scope.CLIENT,
+        description="First-guess tail read for a Parquet footer: one "
+                    "range read of this many bytes replaces pyarrow's "
+                    "probe-seek sequence of tiny reads; a footer larger "
+                    "than the guess costs exactly one more ranged read "
+                    "(sized from the footer-length trailer).")
     USER_QOS_STRIPE_LIMIT = _k(
         "atpu.user.qos.stripe.limit", KeyType.INT, default=0,
         scope=Scope.CLIENT,

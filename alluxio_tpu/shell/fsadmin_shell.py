@@ -472,6 +472,9 @@ class ReportCommand(Command):
             ctx.print("    no input-stall samples recorded — run a "
                       "DeviceBlockLoader epoch with metrics collection "
                       "enabled (atpu.user.metrics.collection.enabled)")
+            # table reads stall no loader; their route split still tells
+            # whether planned projections landed on the fast planes
+            self._stall_table_routes(ctx, snap)
             return 0
         ctx.print(f"    {'tier':<10s} {'waits':>8s} {'stalled':>12s} "
                   f"{'bytes':>12s} {'share':>7s}")
@@ -524,6 +527,7 @@ class ReportCommand(Command):
                 n = int(cross_counts.get(f"{t}.le4k", 0))
                 ctx.print(f"      {t:<8s} {n:>8d} {s:>11.3f}s "
                           f"{share:>6.1f}%")
+        self._stall_table_routes(ctx, snap)
         # cluster mean first (the fleet view, averaged across reporting
         # clients); the master's own gauge only exists when a loader
         # ran in-process and would shadow the fleet with a stale 0.0
@@ -539,6 +543,30 @@ class ReportCommand(Command):
                   f"stall) — "
                   f"{BUCKET_ADVICE.get(top, 'no advice for this tier')}")
         return 0
+
+    @staticmethod
+    def _stall_table_routes(ctx, snap):
+        # the table-read plane check: planned projection bytes re-cut by
+        # serving route. shm-heavy means same-host zero-copy landed;
+        # stream-heavy means the range executor never engaged the batch
+        # or striped planes (docs/table_reads.md)
+        route_bytes = {}
+        for prefix in ("Cluster.TableProjectionRouteBytes.",
+                       "Client.TableProjectionRouteBytes."):
+            for k, v in snap.items():
+                if k.startswith(prefix) and v:
+                    route_bytes[k[len(prefix):]] = v
+            if route_bytes:
+                break
+        if route_bytes:
+            route_total = sum(route_bytes.values())
+            ctx.print(f"    table projection by route "
+                      f"({human_size(route_total)} planned):")
+            for r, nbytes in sorted(route_bytes.items(),
+                                    key=lambda kv: -kv[1]):
+                share = 100.0 * nbytes / route_total
+                ctx.print(f"      {r:<8s} {human_size(int(nbytes)):>12s} "
+                          f"{share:>6.1f}%")
 
     def _readpath(self, ctx):
         """Read-path microscope: ranked per-phase critical-path profile

@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--master", default=None)
     t.add_argument("--partitions", type=int, default=4)
     t.add_argument("--rows", type=int, default=40_000)
+    t.add_argument("--row", choices=["projection", "pushdown"],
+                   default="projection",
+                   help="pushdown: planned-vs-legacy gated comparison "
+                        "(docs/table_reads.md)")
+    t.add_argument("--min-speedup", type=float, default=None,
+                   help="gate: pushdown row fails below this planned/"
+                        "legacy ratio (default 2.0); projection row "
+                        "below this full-scan/projection ratio "
+                        "(default 4.0)")
 
     wr = sub.add_parser("write", help="write-through eviction (config #5)")
     wr.add_argument("--threads", type=int, default=4)
@@ -306,6 +315,7 @@ SUITE = (
                               "--num-files", "4", "--file-mb", "8",
                               "--epochs", "2"]),
     ("table-projection", ["table"]),
+    ("table-projection-pushdown", ["table", "--row", "pushdown"]),
     ("write-eviction", ["write"]),
     ("obs-tracing-overhead", ["obs"]),
     ("obs-profile-overhead", ["obs", "--row", "profile"]),
@@ -475,10 +485,21 @@ def main(argv=None) -> int:
                     replication=args.replication, pressure=args.pressure,
                     kill_worker=args.kill_worker)
     elif args.bench == "table":
-        from alluxio_tpu.stress.table_bench import run
+        if args.row == "pushdown":
+            from alluxio_tpu.stress.table_bench import run_pushdown
 
-        r = run(master=args.master, partitions=args.partitions,
-                rows_per_partition=args.rows)
+            r = run_pushdown(master=args.master,
+                             partitions=args.partitions,
+                             rows_per_partition=args.rows,
+                             min_speedup=args.min_speedup
+                             if args.min_speedup is not None else 2.0)
+        else:
+            from alluxio_tpu.stress.table_bench import run
+
+            r = run(master=args.master, partitions=args.partitions,
+                    rows_per_partition=args.rows,
+                    min_speedup=args.min_speedup
+                    if args.min_speedup is not None else 4.0)
     elif args.bench == "write":
         from alluxio_tpu.stress.write_bench import run
 

@@ -11,6 +11,10 @@ compact transform runs as a job-service plan.
 """
 
 from alluxio_tpu.table.master import TableMaster  # noqa: F401
+from alluxio_tpu.table.plan import (  # noqa: F401
+    ColumnRange, FooterCache, ParquetPlanError, RowGroupPlan, cached_plan,
+    coalesce, footer_cache, plan_row_groups, read_footer,
+)
 from alluxio_tpu.table.udb import (  # noqa: F401
     FsUnderDatabase, UdbPartition, UdbTable, UnderDatabase, udb_factory,
 )

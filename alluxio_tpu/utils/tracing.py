@@ -73,6 +73,8 @@ PHASES = (
     "lease_wait",   # client-observed shm_open/shm_renew lease RPC wait
     "batch_read",   # server-side scatter/gather assembly of a read_many
     "native_exec",  # GIL-free native execution of a packed read plan
+    "table_plan",   # parquet footer fetch/parse + projection range planning
+    "table_decode", # pyarrow decode of a planned row group's column chunks
 )
 
 
